@@ -1,0 +1,28 @@
+"""go_libp2p_pubsub_tpu: a TPU-native pubsub framework.
+
+A from-scratch re-design of the capabilities of go-libp2p-pubsub
+(floodsub / randomsub / gossipsub v1.1 with peer scoring) built in two halves:
+
+- a **functional core**: pure-Python deterministic discrete-event runtime with
+  the full application API (Join/Subscribe/Publish/validators/tracing), used
+  node-by-node for correctness and API parity with the reference
+  (see /root/reference: pubsub.go, gossipsub.go, score.go, ...);
+- a **batched simulation engine** (`sim/`, `ops/`, `parallel/`): the same
+  router semantics vectorized over all N peers as pytrees of JAX arrays,
+  stepped under jit/shard_map on TPU meshes — the performance product
+  (heartbeat + scoring + propagation as batched sparse-graph computation).
+
+Nothing here is a port: the reference is single-node, goroutine-based Go; this
+package is array-programming-first, with a virtual clock, fixed-capacity
+padded state, and XLA collectives where the reference had libp2p streams.
+"""
+
+__version__ = "0.1.0"
+
+from .core.params import (  # noqa: F401
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+    score_parameter_decay,
+)

@@ -1,0 +1,9 @@
+from .clock import VirtualClock, MILLISECOND, SECOND, MINUTE, HOUR  # noqa: F401
+from .params import (  # noqa: F401
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+    score_parameter_decay,
+)
+from .types import AcceptStatus, Message, RPC, ControlMessage  # noqa: F401

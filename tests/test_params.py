@@ -1,0 +1,146 @@
+"""Parameter validation matrix tests.
+
+Scenario structure mirrors the reference's score_params_test.go (atomic vs
+selective validation, legal/illegal combinations) without porting its code.
+"""
+
+import math
+
+import pytest
+
+from go_libp2p_pubsub_tpu.core.params import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+    score_parameter_decay,
+)
+
+
+def test_default_gossipsub_params():
+    p = GossipSubParams()
+    assert (p.d, p.dlo, p.dhi, p.dscore, p.dout) == (6, 5, 12, 4, 2)
+    assert p.history_length == 5 and p.history_gossip == 3
+    assert p.heartbeat_interval == 1.0
+    assert p.prune_backoff == 60.0
+    assert p.max_ihave_length == 5000
+
+
+def test_thresholds_valid():
+    PeerScoreThresholds(
+        gossip_threshold=-1, publish_threshold=-2, graylist_threshold=-3,
+        accept_px_threshold=10, opportunistic_graft_threshold=2,
+    ).validate()
+
+
+@pytest.mark.parametrize("kw", [
+    dict(gossip_threshold=1),
+    dict(publish_threshold=1),
+    dict(gossip_threshold=-1, publish_threshold=-0.5),  # publish > gossip
+    dict(gossip_threshold=-1, publish_threshold=-2, graylist_threshold=-1.5),
+    dict(accept_px_threshold=-1),
+    dict(opportunistic_graft_threshold=-1),
+    dict(gossip_threshold=math.nan),
+    dict(gossip_threshold=-1, publish_threshold=-2, graylist_threshold=-math.inf),
+])
+def test_thresholds_invalid(kw):
+    with pytest.raises(ValueError):
+        PeerScoreThresholds(**kw).validate()
+
+
+def test_thresholds_skip_atomic():
+    # with skip_atomic_validation, untouched groups are not validated
+    PeerScoreThresholds(skip_atomic_validation=True).validate()
+    PeerScoreThresholds(skip_atomic_validation=True, accept_px_threshold=5).validate()
+    with pytest.raises(ValueError):
+        PeerScoreThresholds(skip_atomic_validation=True, accept_px_threshold=-5).validate()
+
+
+def _valid_topic_params() -> TopicScoreParams:
+    return TopicScoreParams(
+        topic_weight=1,
+        time_in_mesh_weight=0.01, time_in_mesh_quantum=1.0, time_in_mesh_cap=10,
+        first_message_deliveries_weight=1, first_message_deliveries_decay=0.5,
+        first_message_deliveries_cap=10,
+        mesh_message_deliveries_weight=-1, mesh_message_deliveries_decay=0.5,
+        mesh_message_deliveries_cap=10, mesh_message_deliveries_threshold=5,
+        mesh_message_deliveries_window=0.01, mesh_message_deliveries_activation=1.0,
+        mesh_failure_penalty_weight=-1, mesh_failure_penalty_decay=0.5,
+        invalid_message_deliveries_weight=-1, invalid_message_deliveries_decay=0.5,
+    )
+
+
+def test_topic_params_valid():
+    _valid_topic_params().validate()
+
+
+@pytest.mark.parametrize("field,value", [
+    ("topic_weight", -1),
+    ("time_in_mesh_weight", -1),
+    ("time_in_mesh_quantum", 0),
+    ("time_in_mesh_cap", -3),
+    ("first_message_deliveries_weight", -1),
+    ("first_message_deliveries_decay", 2),
+    ("first_message_deliveries_cap", -3),
+    ("mesh_message_deliveries_weight", 1),
+    ("mesh_message_deliveries_decay", 2),
+    ("mesh_message_deliveries_cap", -3),
+    ("mesh_message_deliveries_threshold", -3),
+    ("mesh_message_deliveries_window", -1),
+    ("mesh_message_deliveries_activation", 0.5),
+    ("mesh_failure_penalty_weight", 1),
+    ("mesh_failure_penalty_decay", 2),
+    ("invalid_message_deliveries_weight", 1),
+    ("invalid_message_deliveries_decay", 2),
+    ("invalid_message_deliveries_decay", math.nan),
+])
+def test_topic_params_invalid(field, value):
+    tp = _valid_topic_params()
+    setattr(tp, field, value)
+    with pytest.raises(ValueError):
+        tp.validate()
+
+
+def test_topic_params_selective():
+    # zeroed groups skipped in selective mode
+    TopicScoreParams(skip_atomic_validation=True).validate()
+    tp = TopicScoreParams(skip_atomic_validation=True, first_message_deliveries_weight=1)
+    with pytest.raises(ValueError):  # group touched -> full group validation
+        tp.validate()
+    tp.first_message_deliveries_decay = 0.5
+    tp.first_message_deliveries_cap = 10
+    tp.validate()
+
+
+def test_peer_score_params():
+    p = PeerScoreParams(
+        app_specific_score=lambda pid: 0.0,
+        decay_interval=1.0, decay_to_zero=0.01,
+        ip_colocation_factor_weight=-1, ip_colocation_factor_threshold=1,
+        behaviour_penalty_weight=-1, behaviour_penalty_decay=0.5,
+    )
+    p.validate()
+    with pytest.raises(ValueError):
+        PeerScoreParams(decay_interval=1.0, decay_to_zero=0.01).validate()  # missing app score
+    # skip_atomic fills in a default app score
+    ps = PeerScoreParams(skip_atomic_validation=True)
+    ps.validate()
+    assert ps.app_specific_score("x") == 0.0
+    with pytest.raises(ValueError):
+        PeerScoreParams(app_specific_score=lambda pid: 0.0, decay_interval=0.5,
+                        decay_to_zero=0.01).validate()
+    with pytest.raises(ValueError):
+        PeerScoreParams(app_specific_score=lambda pid: 0.0, decay_interval=1.0,
+                        decay_to_zero=0.01, ip_colocation_factor_weight=-1).validate()
+    with pytest.raises(ValueError):
+        PeerScoreParams(app_specific_score=lambda pid: 0.0, decay_interval=1.0,
+                        decay_to_zero=0.01, topic_score_cap=-1).validate()
+    p.topics["bad"] = TopicScoreParams(topic_weight=-1)
+    with pytest.raises(ValueError):
+        p.validate()
+
+
+def test_score_parameter_decay():
+    # decaying over 10 ticks to 0.01: factor = 0.01^(1/10)
+    assert abs(score_parameter_decay(10.0) - 0.01 ** 0.1) < 1e-12
+    assert abs(score_parameter_decay(1.0) - 0.01) < 1e-12
