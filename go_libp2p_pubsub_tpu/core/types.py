@@ -42,8 +42,8 @@ class Message:
     received_from: PeerID | None = None
     validator_data: object = None
     local: bool = False
-    # cached canonical id (midgen.go:39-52)
-    _id: str | None = None
+    # cached canonical id (midgen.go:39-52); cache state, not identity
+    _id: str | None = field(default=None, compare=False, repr=False)
 
     def get_from(self) -> PeerID | None:
         return self.from_peer

@@ -1,0 +1,247 @@
+"""Unit tests for the pure state machines (mcache, timecache, backoff,
+blacklist, midgen, subscription filters).
+
+Scenarios mirror the reference's mcache_test.go / backoff_test.go /
+timecache tests / blacklist_test.go / subscription_filter_test.go coverage.
+"""
+
+import random
+
+import pytest
+
+from go_libp2p_pubsub_tpu.core.clock import VirtualClock
+from go_libp2p_pubsub_tpu.core.types import Message, SubOpts
+from go_libp2p_pubsub_tpu.utils import (
+    AllowlistSubscriptionFilter,
+    Backoff,
+    LimitSubscriptionFilter,
+    MapBlacklist,
+    MaxBackoffAttemptsError,
+    MessageCache,
+    MsgIdGenerator,
+    RegexpSubscriptionFilter,
+    Strategy,
+    TimeCache,
+    TimeCachedBlacklist,
+    TooManySubscriptionsError,
+    default_msg_id_fn,
+    filter_subscriptions,
+)
+from go_libp2p_pubsub_tpu.utils.backoff import (
+    MAX_BACKOFF_DELAY,
+    MIN_BACKOFF_DELAY,
+    TIME_TO_LIVE,
+)
+
+
+def _msg(i: int, topic="test") -> Message:
+    return Message(from_peer=f"peer-{i}", seqno=i.to_bytes(8, "big"), data=b"d" * i, topic=topic)
+
+
+# --- mcache (mcache_test.go semantics) ---
+
+class TestMessageCache:
+    def test_put_get_window(self):
+        mc = MessageCache(3, 5)
+        msgs = [_msg(i) for i in range(60)]
+        for m in msgs[:10]:
+            mc.put(m)
+        for m in msgs[:10]:
+            assert mc.get(default_msg_id_fn(m)) is m
+        gids = mc.get_gossip_ids("test")
+        assert len(gids) == 10
+
+        mc.shift()
+        for m in msgs[10:20]:
+            mc.put(m)
+        assert len(mc.get_gossip_ids("test")) == 20
+
+        # fill all history slots
+        for k in range(2, 6):
+            mc.shift()
+            for m in msgs[k * 10:(k + 1) * 10]:
+                mc.put(m)
+        # gossip window only covers the newest 3 slots
+        gids = mc.get_gossip_ids("test")
+        assert len(gids) == 30
+        # oldest slot evicted after enough shifts
+        mc.shift()
+        assert mc.get(default_msg_id_fn(msgs[10])) is None
+        assert mc.get(default_msg_id_fn(msgs[50])) is not None
+
+    def test_topic_filter(self):
+        mc = MessageCache(2, 3)
+        mc.put(_msg(1, topic="a"))
+        mc.put(_msg(2, topic="b"))
+        assert len(mc.get_gossip_ids("a")) == 1
+        assert len(mc.get_gossip_ids("c")) == 0
+
+    def test_get_for_peer_counts(self):
+        mc = MessageCache(2, 3)
+        m = _msg(1)
+        mc.put(m)
+        mid = default_msg_id_fn(m)
+        for expect in (1, 2, 3):
+            got, count = mc.get_for_peer(mid, "p1")
+            assert got is m and count == expect
+        _, count = mc.get_for_peer(mid, "p2")
+        assert count == 1
+        got, count = mc.get_for_peer("missing", "p1")
+        assert got is None and count == 0
+
+    def test_gossip_gt_history_rejected(self):
+        with pytest.raises(ValueError):
+            MessageCache(5, 3)
+
+
+# --- timecache ---
+
+class TestTimeCache:
+    def test_first_seen(self):
+        clk = VirtualClock()
+        tc = TimeCache(120.0, clk.now)
+        assert tc.add("a")
+        assert not tc.add("a")  # already present
+        assert tc.has("a")
+        clk.advance_to(121.0)
+        tc.sweep()
+        assert not tc.has("a")
+
+    def test_expiry_needs_sweep(self):
+        # faithful to Go: has() alone does not expire
+        clk = VirtualClock()
+        tc = TimeCache(10.0, clk.now)
+        tc.add("a")
+        clk.advance_to(50.0)
+        assert tc.has("a")
+        tc.sweep()
+        assert not tc.has("a")
+
+    def test_last_seen_slides(self):
+        clk = VirtualClock()
+        tc = TimeCache(10.0, clk.now, Strategy.LAST_SEEN)
+        tc.add("a")
+        clk.advance_to(8.0)
+        assert tc.has("a")  # refreshes expiry to t=18
+        clk.advance_to(15.0)
+        tc.sweep()
+        assert tc.has("a")
+        clk.advance_to(30.0)
+        tc.sweep()
+        assert not tc.has("a")
+
+    def test_last_seen_add_refreshes(self):
+        clk = VirtualClock()
+        tc = TimeCache(10.0, clk.now, Strategy.LAST_SEEN)
+        assert tc.add("a")
+        clk.advance_to(5.0)
+        assert not tc.add("a")  # not new, but refreshed to t=15
+        clk.advance_to(12.0)
+        tc.sweep()
+        assert tc.has("a")
+
+
+# --- backoff (backoff_test.go semantics) ---
+
+class TestBackoff:
+    def test_schedule(self):
+        clk = VirtualClock()
+        b = Backoff(clk.now, rng=random.Random(314159))
+        # first attempt: immediate
+        assert b.update_and_get("p") == 0.0
+        # second: min delay
+        assert b.update_and_get("p") == MIN_BACKOFF_DELAY
+        # subsequent: doubling + jitter, capped
+        prev = MIN_BACKOFF_DELAY
+        d = b.update_and_get("p")
+        assert 2 * prev <= d <= 2 * prev + 0.1
+        d2 = b.update_and_get("p")
+        assert 2 * d <= d2 <= min(2 * d + 0.1, MAX_BACKOFF_DELAY)
+        # max attempts reached
+        with pytest.raises(MaxBackoffAttemptsError):
+            b.update_and_get("p")
+
+    def test_ttl_resets_history(self):
+        clk = VirtualClock()
+        b = Backoff(clk.now, rng=random.Random(1))
+        for _ in range(4):
+            b.update_and_get("p")
+        clk.advance_to(TIME_TO_LIVE + 1.0)
+        assert b.update_and_get("p") == 0.0  # fresh history
+
+    def test_cleanup(self):
+        clk = VirtualClock()
+        b = Backoff(clk.now, rng=random.Random(1))
+        b.update_and_get("p")
+        clk.advance_to(TIME_TO_LIVE + 1.0)
+        b.cleanup()
+        assert len(b) == 0
+
+
+# --- blacklist (blacklist_test.go semantics) ---
+
+class TestBlacklist:
+    def test_map(self):
+        bl = MapBlacklist()
+        assert not bl.contains("p")
+        bl.add("p")
+        assert bl.contains("p")
+
+    def test_timecached(self):
+        clk = VirtualClock()
+        bl = TimeCachedBlacklist(10.0, clk.now)
+        assert bl.add("p")
+        assert not bl.add("p")  # duplicate add returns False
+        assert bl.contains("p")
+        clk.advance_to(11.0)
+        bl.sweep()
+        assert not bl.contains("p")
+
+
+# --- midgen ---
+
+class TestMsgIdGenerator:
+    def test_default_and_override(self):
+        g = MsgIdGenerator()
+        m = _msg(1)
+        assert g.id(m) == "peer-1" + (1).to_bytes(8, "big").decode("latin-1")
+        g.set("other", lambda msg: "X")
+        assert g.raw_id(_msg(1, topic="other")) == "X"
+        # cached id short-circuits
+        m2 = _msg(2)
+        g.id(m2)
+        g.set("test", lambda msg: "Y")
+        assert g.id(m2) != "Y"  # cache wins
+        assert g.raw_id(_msg(3)) == "Y"
+
+
+# --- subscription filters (subscription_filter_test.go semantics) ---
+
+class TestSubscriptionFilters:
+    def test_allowlist(self):
+        f = AllowlistSubscriptionFilter("test1", "test2")
+        assert f.can_subscribe("test1")
+        assert not f.can_subscribe("test3")
+        out = f.filter_incoming_subscriptions("p", [
+            SubOpts(True, "test1"), SubOpts(True, "test2"), SubOpts(True, "test3")])
+        assert [s.topicid for s in out] == ["test1", "test2"]
+
+    def test_regexp(self):
+        f = RegexpSubscriptionFilter(r"^test[12]$")
+        assert f.can_subscribe("test1")
+        assert not f.can_subscribe("test3")
+
+    def test_dedup_and_cancel(self):
+        out = filter_subscriptions([
+            SubOpts(True, "a"), SubOpts(True, "a"),       # duplicate kept once
+            SubOpts(True, "b"), SubOpts(False, "b"),      # contradictory -> dropped
+            SubOpts(True, "c"), SubOpts(False, "c"), SubOpts(True, "c"),  # re-enters
+        ], lambda t: True)
+        assert [(s.topicid, s.subscribe) for s in out] == [("a", True), ("c", True)]
+
+    def test_limit(self):
+        f = LimitSubscriptionFilter(AllowlistSubscriptionFilter("a"), 2)
+        subs = [SubOpts(True, "a")] * 3
+        with pytest.raises(TooManySubscriptionsError):
+            f.filter_incoming_subscriptions("p", subs)
+        assert len(f.filter_incoming_subscriptions("p", subs[:2])) == 1
