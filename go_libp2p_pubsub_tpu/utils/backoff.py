@@ -39,13 +39,12 @@ class _History:
 
 
 class Backoff:
-    def __init__(self, now: Callable[[], float],
-                 max_attempts: int = MAX_BACKOFF_ATTEMPTS,
-                 rng: random.Random | None = None):
+    def __init__(self, now: Callable[[], float], rng: random.Random,
+                 max_attempts: int = MAX_BACKOFF_ATTEMPTS):
         self._now = now
         self._info: dict[str, _History] = {}
         self._max_attempts = max_attempts
-        self._rng = rng or random.Random(0)
+        self._rng = rng
 
     def update_and_get(self, peer: str) -> float:
         """Next delay for ``peer`` (backoff.go:52-82). Raises after max attempts."""

@@ -1,0 +1,381 @@
+"""Score engine tests: virtual-clock versions of score_test.go's scenarios.
+
+Each test drives AddPeer/Graft/Deliver/refresh_scores by hand and asserts
+exact numeric P1-P7 values. Unlike the reference's sleep-based tests, the
+virtual clock makes every expectation exact.
+"""
+
+import pytest
+
+from go_libp2p_pubsub_tpu.core.clock import VirtualClock
+from go_libp2p_pubsub_tpu.core.params import PeerScoreParams, TopicScoreParams
+from go_libp2p_pubsub_tpu.core.types import Message
+from go_libp2p_pubsub_tpu.routers.score import PeerScore
+from go_libp2p_pubsub_tpu.trace import events as ev
+
+TOPIC = "mytopic"
+
+
+def make_params(**topic_kw) -> PeerScoreParams:
+    defaults = dict(time_in_mesh_quantum=1.0)
+    defaults.update(topic_kw)
+    return PeerScoreParams(
+        app_specific_score=lambda p: 0.0,
+        topics={TOPIC: TopicScoreParams(**defaults)},
+    )
+
+
+def _msg(i: int, received_from: str) -> Message:
+    return Message(from_peer="author", seqno=i.to_bytes(8, "big"), topic=TOPIC,
+                   received_from=received_from)
+
+
+def test_time_in_mesh():
+    clk = VirtualClock()
+    params = make_params(topic_weight=0.5, time_in_mesh_weight=1,
+                         time_in_mesh_quantum=1e-3, time_in_mesh_cap=3600)
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    assert ps.score("A") == 0
+    ps.graft("A", TOPIC)
+    clk.advance_to(0.2)  # 200 quanta
+    ps.refresh_scores()
+    assert ps.score("A") == pytest.approx(0.5 * 1 * 200)
+
+
+def test_time_in_mesh_cap():
+    clk = VirtualClock()
+    params = make_params(topic_weight=0.5, time_in_mesh_weight=1,
+                         time_in_mesh_quantum=1e-3, time_in_mesh_cap=10)
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    clk.advance_to(0.04)  # 40 quanta, cap 10
+    ps.refresh_scores()
+    assert ps.score("A") == pytest.approx(0.5 * 1 * 10)
+
+
+def test_first_message_deliveries():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, first_message_deliveries_weight=1,
+                         first_message_deliveries_decay=1.0,
+                         first_message_deliveries_cap=2000)
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    for i in range(100):
+        m = _msg(i, "A")
+        ps.validate_message(m)
+        ps.deliver_message(m)
+    ps.refresh_scores()
+    assert ps.score("A") == pytest.approx(100.0)
+
+
+def test_first_message_deliveries_cap():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, first_message_deliveries_weight=1,
+                         first_message_deliveries_decay=1.0,
+                         first_message_deliveries_cap=50)
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    for i in range(100):
+        m = _msg(i, "A")
+        ps.validate_message(m)
+        ps.deliver_message(m)
+    ps.refresh_scores()
+    assert ps.score("A") == pytest.approx(50.0)
+
+
+def test_first_message_deliveries_decay():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, first_message_deliveries_weight=1,
+                         first_message_deliveries_decay=0.9,
+                         first_message_deliveries_cap=2000)
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    for i in range(100):
+        m = _msg(i, "A")
+        ps.validate_message(m)
+        ps.deliver_message(m)
+    ps.refresh_scores()
+    expected = 0.9 * 100
+    assert ps.score("A") == pytest.approx(expected)
+    for _ in range(10):
+        ps.refresh_scores()
+        expected *= 0.9
+    assert ps.score("A") == pytest.approx(expected)
+
+
+def test_mesh_message_deliveries():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, mesh_message_deliveries_weight=-1,
+                         mesh_message_deliveries_activation=1.0,
+                         mesh_message_deliveries_window=0.01,
+                         mesh_message_deliveries_threshold=20,
+                         mesh_message_deliveries_cap=100,
+                         mesh_message_deliveries_decay=1.0)
+    ps = PeerScore(params, clk.now)
+    for p in "ABC":
+        ps.add_peer(p, "proto")
+        ps.graft(p, TOPIC)
+    # before activation: no penalty
+    ps.refresh_scores()
+    assert all(ps.score(p) >= 0 for p in "ABC")
+    # pass the activation window
+    clk.advance_to(1.5)
+    ps.refresh_scores()  # sets mesh_time > activation -> active
+    # A delivers first, B duplicates in-window, C duplicates out-of-window
+    t = clk.now()
+    for i in range(100):
+        m = _msg(i, "A")
+        ps.validate_message(m)
+        ps.deliver_message(m)
+        m_b = _msg(i, "B")
+        ps.duplicate_message(m_b)
+    t += 0.05  # 50ms later: outside the 10ms window
+    clk.advance_to(t)
+    for i in range(100):
+        ps.duplicate_message(_msg(i, "C"))
+    ps.refresh_scores()
+    assert ps.score("A") >= 0
+    assert ps.score("B") >= 0
+    assert ps.score("C") == pytest.approx(-(20.0 ** 2))
+
+
+def test_mesh_failure_penalty():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, mesh_failure_penalty_weight=-1,
+                         mesh_failure_penalty_decay=1.0,
+                         mesh_message_deliveries_activation=1.0,
+                         mesh_message_deliveries_window=0.01,
+                         mesh_message_deliveries_threshold=20,
+                         mesh_message_deliveries_cap=100,
+                         mesh_message_deliveries_decay=1.0)
+    # NOTE: mesh_message_deliveries_weight stays 0 so only P3b counts
+    ps = PeerScore(params, clk.now)
+    for p in "AB":
+        ps.add_peer(p, "proto")
+        ps.graft(p, TOPIC)
+    clk.advance_to(1.5)
+    ps.refresh_scores()  # activate
+    # prune B while it has a deficit -> sticky penalty
+    ps.prune("B", TOPIC)
+    ps.refresh_scores()
+    assert ps.score("A") == 0.0
+    assert ps.score("B") == pytest.approx(-(20.0 ** 2))
+
+
+def test_invalid_message_deliveries():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, invalid_message_deliveries_weight=-1,
+                         invalid_message_deliveries_decay=1.0)
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    for i in range(100):
+        m = _msg(i, "A")
+        ps.reject_message(m, ev.REJECT_INVALID_SIGNATURE)
+    ps.refresh_scores()
+    assert ps.score("A") == pytest.approx(-(100.0 ** 2))
+
+
+def test_invalid_message_deliveries_decay():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, invalid_message_deliveries_weight=-1,
+                         invalid_message_deliveries_decay=0.9)
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    for i in range(100):
+        ps.reject_message(_msg(i, "A"), ev.REJECT_INVALID_SIGNATURE)
+    ps.refresh_scores()
+    expected = -((0.9 * 100) ** 2)
+    assert ps.score("A") == pytest.approx(expected)
+
+
+def test_reject_message_deliveries_status_machine():
+    """Once rejected as invalid, later duplicates also get penalized;
+    ignored/throttled rejections penalize nobody (score_test.go:536-668)."""
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, invalid_message_deliveries_weight=-1,
+                         invalid_message_deliveries_decay=1.0)
+    ps = PeerScore(params, clk.now)
+    for p in "AB":
+        ps.add_peer(p, "proto")
+    # A delivers, validation pending; B duplicates; then the message is rejected
+    m = _msg(0, "A")
+    ps.validate_message(m)
+    ps.duplicate_message(_msg(0, "B"))
+    ps.reject_message(m, ev.REJECT_VALIDATION_FAILED)
+    assert ps.score("A") == pytest.approx(-1.0)
+    assert ps.score("B") == pytest.approx(-1.0)
+    # duplicate after the fact also penalized
+    ps.duplicate_message(_msg(0, "B"))
+    assert ps.score("B") == pytest.approx(-4.0)
+
+    # ignored: no penalties
+    ps2 = PeerScore(make_params(topic_weight=1, invalid_message_deliveries_weight=-1,
+                                invalid_message_deliveries_decay=1.0), clk.now)
+    for p in "AB":
+        ps2.add_peer(p, "proto")
+    m = _msg(1, "A")
+    ps2.validate_message(m)
+    ps2.duplicate_message(_msg(1, "B"))
+    ps2.reject_message(m, ev.REJECT_VALIDATION_IGNORED)
+    assert ps2.score("A") == 0.0 and ps2.score("B") == 0.0
+    # throttled likewise
+    m = _msg(2, "A")
+    ps2.validate_message(m)
+    ps2.reject_message(m, ev.REJECT_VALIDATION_THROTTLED)
+    assert ps2.score("A") == 0.0
+
+
+def test_application_score():
+    clk = VirtualClock()
+    app_score = {"value": 0.0}
+    params = PeerScoreParams(app_specific_score=lambda p: app_score["value"],
+                             app_specific_weight=0.5, topics={})
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    for v in (-100.0, 0.0, 42.0):
+        app_score["value"] = v
+        assert ps.score("A") == pytest.approx(0.5 * v)
+
+
+def test_ip_colocation():
+    clk = VirtualClock()
+    ips = {"A": ["1.2.3.4"], "B": ["2.3.4.5"], "C": ["2.3.4.5"], "D": ["2.3.4.5"]}
+    params = PeerScoreParams(app_specific_score=lambda p: 0.0,
+                             ip_colocation_factor_weight=-1,
+                             ip_colocation_factor_threshold=1, topics={})
+    ps = PeerScore(params, clk.now, get_ips=lambda p: ips[p])
+    for p in "ABCD":
+        ps.add_peer(p, "proto")
+    assert ps.score("A") == 0.0
+    # B, C, D share an IP: 3 peers, threshold 1 -> surplus 2 -> penalty 4 each
+    for p in "BCD":
+        assert ps.score(p) == pytest.approx(-4.0)
+
+
+def test_ip_colocation_whitelist():
+    clk = VirtualClock()
+    ips = {"B": ["2.3.4.5"], "C": ["2.3.4.5"]}
+    params = PeerScoreParams(app_specific_score=lambda p: 0.0,
+                             ip_colocation_factor_weight=-1,
+                             ip_colocation_factor_threshold=1,
+                             ip_colocation_factor_whitelist=["2.3.0.0/16"], topics={})
+    ps = PeerScore(params, clk.now, get_ips=lambda p: ips[p])
+    for p in "BC":
+        ps.add_peer(p, "proto")
+    assert ps.score("B") == 0.0 and ps.score("C") == 0.0
+
+
+def test_behaviour_penalty():
+    clk = VirtualClock()
+    params = PeerScoreParams(app_specific_score=lambda p: 0.0,
+                             behaviour_penalty_weight=-1,
+                             behaviour_penalty_threshold=1,
+                             behaviour_penalty_decay=0.99, topics={})
+    ps = PeerScore(params, clk.now)
+    # penalty for unknown peer is a no-op
+    ps.add_penalty("A", 2)
+    assert ps.score("A") == 0.0
+    ps.add_peer("A", "proto")
+    ps.add_penalty("A", 2)
+    # excess = 2 - 1 = 1 -> -1
+    assert ps.score("A") == pytest.approx(-1.0)
+    ps.add_penalty("A", 2)
+    # counter 4, excess 3 -> -9
+    assert ps.score("A") == pytest.approx(-9.0)
+    ps.refresh_scores()
+    # counter 3.96, excess 2.96
+    assert ps.score("A") == pytest.approx(-(2.96 ** 2))
+
+
+def test_score_retention():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, invalid_message_deliveries_weight=-1,
+                         invalid_message_deliveries_decay=1.0)
+    params.retain_score = 10.0
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    ps.reject_message(_msg(0, "A"), ev.REJECT_INVALID_SIGNATURE)
+    assert ps.score("A") < 0
+    # disconnect: negative score is retained, does not decay
+    ps.remove_peer("A")
+    clk.advance_to(5.0)
+    ps.refresh_scores()
+    assert ps.score("A") == pytest.approx(-1.0)
+    # after the retention period the record is purged
+    clk.advance_to(11.0)
+    ps.refresh_scores()
+    assert ps.score("A") == 0.0
+    assert "A" not in ps.peer_stats
+
+
+def test_positive_score_not_retained():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, first_message_deliveries_weight=1,
+                         first_message_deliveries_decay=1.0,
+                         first_message_deliveries_cap=100)
+    params.retain_score = 10.0
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    m = _msg(0, "A")
+    ps.validate_message(m)
+    ps.deliver_message(m)
+    assert ps.score("A") > 0
+    ps.remove_peer("A")
+    assert "A" not in ps.peer_stats  # positive scores are dropped immediately
+
+
+def test_recap_topic_params():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1, first_message_deliveries_weight=1,
+                         first_message_deliveries_decay=1.0,
+                         first_message_deliveries_cap=100)
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", TOPIC)
+    for i in range(80):
+        m = _msg(i, "A")
+        ps.validate_message(m)
+        ps.deliver_message(m)
+    assert ps.score("A") == pytest.approx(80.0)
+    # lower the cap: counters are recapped
+    newp = TopicScoreParams(topic_weight=1, first_message_deliveries_weight=1,
+                            first_message_deliveries_decay=1.0,
+                            first_message_deliveries_cap=50,
+                            time_in_mesh_quantum=1.0)
+    ps.set_topic_score_params(TOPIC, newp)
+    assert ps.score("A") == pytest.approx(50.0)
+
+
+def test_delivery_record_gc():
+    clk = VirtualClock()
+    params = make_params(topic_weight=1)
+    params.seen_msg_ttl = 5.0
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    for i in range(10):
+        ps.validate_message(_msg(i, "A"))
+    assert len(ps.deliveries.records) == 10
+    clk.advance_to(6.0)
+    ps.gc_delivery_records()
+    assert len(ps.deliveries.records) == 0
+
+
+def test_unscored_topic_ignored():
+    clk = VirtualClock()
+    params = PeerScoreParams(app_specific_score=lambda p: 0.0, topics={})
+    ps = PeerScore(params, clk.now)
+    ps.add_peer("A", "proto")
+    ps.graft("A", "unknown-topic")
+    m = Message(from_peer="x", seqno=b"1", topic="unknown-topic", received_from="A")
+    ps.validate_message(m)
+    ps.deliver_message(m)
+    assert ps.score("A") == 0.0

@@ -146,7 +146,7 @@ class TestTimeCache:
 class TestBackoff:
     def test_schedule(self):
         clk = VirtualClock()
-        b = Backoff(clk.now, rng=random.Random(314159))
+        b = Backoff(clk.now, random.Random(314159))
         # first attempt: immediate
         assert b.update_and_get("p") == 0.0
         # second: min delay
@@ -163,7 +163,7 @@ class TestBackoff:
 
     def test_ttl_resets_history(self):
         clk = VirtualClock()
-        b = Backoff(clk.now, rng=random.Random(1))
+        b = Backoff(clk.now, random.Random(1))
         for _ in range(4):
             b.update_and_get("p")
         clk.advance_to(TIME_TO_LIVE + 1.0)
@@ -171,7 +171,7 @@ class TestBackoff:
 
     def test_cleanup(self):
         clk = VirtualClock()
-        b = Backoff(clk.now, rng=random.Random(1))
+        b = Backoff(clk.now, random.Random(1))
         b.update_and_get("p")
         clk.advance_to(TIME_TO_LIVE + 1.0)
         b.cleanup()
