@@ -1,0 +1,56 @@
+"""Headline benchmark: simulated gossipsub heartbeats/sec at large N.
+
+Runs the full batched network step (publish + decay + heartbeat mesh
+maintenance + scoring + propagation + gossip) on the default accelerator and
+prints ONE JSON line. ``vs_baseline`` is value / 1000 — the BASELINE.json
+north-star target of >= 1000 full-network heartbeats/sec at 100k peers
+(the reference router runs 1 heartbeat/sec/node in real time and publishes
+no benchmarks; see BASELINE.md).
+
+Env overrides: BENCH_N (peers, default 100_000), BENCH_TICKS (default 30).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+TARGET_HBPS = 1000.0
+
+
+def main() -> None:
+    import jax
+
+    n = int(os.environ.get("BENCH_N", 100_000))
+    ticks = int(os.environ.get("BENCH_TICKS", 30))
+
+    from __graft_entry__ import _build
+    from go_libp2p_pubsub_tpu.sim.engine import run
+
+    cfg, tp, st = _build(n_peers=n, k_slots=32, degree=12, msg_window=64,
+                         msg_chunk=16, publishers=8)
+    key = jax.random.PRNGKey(0)
+
+    # warmup: compile + converge the mesh a little
+    st = run(st, cfg, tp, key, 5)
+    st.tick.block_until_ready()
+
+    t0 = time.perf_counter()
+    st = run(st, cfg, tp, key, ticks)
+    st.tick.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    hbps = ticks / dt
+    platform = jax.devices()[0].platform
+    print(json.dumps({
+        "metric": f"gossipsub_network_heartbeats_per_sec@{n}peers[{platform}]",
+        "value": round(hbps, 2),
+        "unit": "heartbeats/s",
+        "vs_baseline": round(hbps / TARGET_HBPS, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Batched gossipsub heartbeat: mesh maintenance for all N peers at once.
+
+Vectorized re-design of GossipSubRouter.heartbeat (gossipsub.go:1345-1606):
+every per-node map walk becomes a masked reduction over the K slot axis, the
+shuffles become gumbel selections, and GRAFT/PRUNE exchange resolves in the
+same round via edge gathers (the (n,k)->(j,reverse_slot) mapping is a
+permutation of directed edge slots, so receiver-side views are gathers, not
+scatters).
+
+Round semantics: every decision reads the pre-round state (SURVEY.md §7
+"Order-sensitivity vs batching" — canonical order with stable tie-breaks).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..sim.config import SimConfig, TopicParams
+from ..sim.state import SimState
+from .score_ops import apply_prune_penalty, compute_scores
+from .selection import masked_median, select_random, select_top
+
+
+def edge_gather(x: jnp.ndarray, state: SimState, fill=False) -> jnp.ndarray:
+    """incoming[j, t, s] = x[neighbors[j,s], t, reverse_slot[j,s]].
+
+    The receiver-side view of per-edge state: what the peer in my slot s has
+    recorded about me. Invalid slots read ``fill``.
+    """
+    n, t, k = x.shape
+    j = jnp.clip(state.neighbors, 0, n - 1)[:, None, :]
+    rk = jnp.clip(state.reverse_slot, 0, k - 1)[:, None, :]
+    tt = jnp.arange(t)[None, :, None]
+    y = x[j, tt, rk]
+    valid = ((state.neighbors >= 0) & (state.reverse_slot >= 0))[:, None, :]
+    return jnp.where(valid, y, fill)
+
+
+class HeartbeatOut(NamedTuple):
+    state: SimState
+    scores: jnp.ndarray      # [N, K] pre-maintenance scores (score cache,
+                             # gossipsub.go:1375-1381)
+    gossip_sel: jnp.ndarray  # [N, T, K] emitGossip target edges
+
+
+def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
+              key: jax.Array) -> HeartbeatOut:
+    n, t, k = state.mesh.shape
+    tick = state.tick
+    ks = jax.random.split(key, 7)
+
+    scores = compute_scores(state, cfg, tp)          # [N, K]
+    s = scores[:, None, :]                           # broadcast over T
+    sb = jnp.broadcast_to(s, (n, t, k))
+    joined = state.subscribed[:, :, None]
+    conn = state.connected[:, None, :]
+    out3 = state.outbound[:, None, :]
+    direct3 = state.direct[:, None, :]
+    nbr = jnp.clip(state.neighbors, 0, n - 1)
+    nbr_sub = jnp.transpose(state.subscribed[nbr], (0, 2, 1))  # [N,T,K]
+    nbr_sub = nbr_sub & conn
+    backoff_ok = tick >= state.backoff
+    backoff_active = ~backoff_ok
+
+    mesh = state.mesh & joined
+    # graft candidates (gossipsub.go:1413-1427): connected topic peers outside
+    # the mesh with non-negative score, no backoff, not direct
+    candidate = conn & nbr_sub & ~mesh & backoff_ok & (s >= 0) & ~direct3 & joined
+
+    # 1. prune all negative-score mesh members (gossipsub.go:1404-1410)
+    prune_neg = mesh & (s < 0)
+    mesh1 = mesh & ~prune_neg
+    candidate = candidate & ~prune_neg
+
+    # 2. undersubscribed: graft random candidates up to D (gossipsub.go:1413-1427)
+    n_mesh = jnp.sum(mesh1, axis=-1)
+    need = jnp.where(n_mesh < cfg.dlo, cfg.d - n_mesh, 0)
+    graft1 = select_random(candidate, need, ks[0])
+    mesh2 = mesh1 | graft1
+
+    # 3. oversubscribed: keep top-Dscore by score + random rest to D, then
+    # bubble up to Dout outbound among the kept (gossipsub.go:1430-1490)
+    n2 = jnp.sum(mesh2, axis=-1)
+    over = (n2 > cfg.dhi)[..., None]
+    protected = select_top(sb, mesh2, jnp.full((n, t), cfg.dscore))
+    rest = mesh2 & ~protected
+    keep_rand = select_random(rest, jnp.full((n, t), cfg.d - cfg.dscore), ks[1])
+    kept = protected | keep_rand
+    n_out_kept = jnp.sum(kept & out3, axis=-1)
+    deficit_out = jnp.clip(cfg.dout - n_out_kept, 0)
+    add_out = select_random(mesh2 & ~kept & out3, deficit_out, ks[2])
+    remove_nonout = select_random(keep_rand & ~out3,
+                                  jnp.sum(add_out, axis=-1), ks[3])
+    kept = (kept | add_out) & ~remove_nonout
+    mesh3 = jnp.where(over, kept, mesh2)
+    prune_over = mesh2 & ~mesh3
+
+    # 4. outbound quota top-up in the [Dlo, Dhi] regime (gossipsub.go:1493-1518)
+    n3 = jnp.sum(mesh3, axis=-1)
+    n_out = jnp.sum(mesh3 & out3, axis=-1)
+    need_out = jnp.where((n3 >= cfg.dlo) & ~over[..., 0] & (n_out < cfg.dout),
+                         cfg.dout - n_out, 0)
+    graft_out = select_random(candidate & out3 & ~mesh3, need_out, ks[4])
+    mesh4 = mesh3 | graft_out
+
+    # 5. opportunistic grafting every OpportunisticGraftTicks when the median
+    # mesh score sags below the threshold (gossipsub.go:1521-1552)
+    og_tick = (tick % cfg.opportunistic_graft_ticks) == 0
+    med = masked_median(sb, mesh4)                    # [N, T]
+    og_cond = og_tick & (jnp.sum(mesh4, -1) > 1) & \
+        (med < cfg.opportunistic_graft_threshold)
+    og_need = jnp.where(og_cond, cfg.opportunistic_graft_peers, 0)
+    og_sel = select_random(candidate & (sb > med[..., None]) & ~mesh4,
+                           og_need, ks[5])
+    mesh5 = mesh4 | og_sel
+
+    grafts = graft1 | graft_out | og_sel
+    prunes = prune_neg | prune_over
+
+    # --- cross-peer exchange, all against pre-round state ---
+    inc_graft = edge_gather(grafts, state)
+    inc_prune = edge_gather(prunes, state)
+
+    # receiver-side GRAFT vetting (gossipsub.go:741-837): refuse when not
+    # joined, in backoff, sender score negative, mesh full (unless outbound),
+    # or a direct peer
+    mesh_count_pre = jnp.sum(state.mesh, axis=-1, keepdims=True)
+    refuse = inc_graft & (~joined | backoff_active | (s < 0)
+                          | ((mesh_count_pre >= cfg.dhi) & ~out3) | direct3)
+    accept = inc_graft & ~refuse
+    # graft-during-backoff behaviour penalty (gossipsub.go:781-795)
+    bp_add = jnp.sum(inc_graft & backoff_active, axis=1).astype(jnp.float32)
+    behaviour_penalty = state.behaviour_penalty + bp_add
+
+    refused_back = edge_gather(refuse, state)
+
+    new_mesh = ((mesh5 | accept) & ~inc_prune & ~refused_back) & joined
+    pruned_any = prunes | inc_prune | refused_back
+    new_backoff = jnp.where(pruned_any,
+                            tick + cfg.prune_backoff_ticks, state.backoff)
+
+    # score hooks: Graft (score.go:649-667) on newly added edges, Prune
+    # (score.go:669-694) on removed ones
+    newly = new_mesh & ~state.mesh
+    removed = state.mesh & ~new_mesh
+
+    st = state._replace(mesh=new_mesh, backoff=new_backoff,
+                        behaviour_penalty=behaviour_penalty)
+    st = apply_prune_penalty(st, removed, tp)
+    st = st._replace(
+        graft_tick=jnp.where(newly, tick, st.graft_tick),
+        mesh_active=jnp.where(newly, False, st.mesh_active))
+
+    # emitGossip peer selection (gossipsub.go:1711-1775): non-mesh topic peers
+    # with score >= gossip threshold; target max(Dlazy, factor * candidates)
+    gossip_cand = conn & nbr_sub & ~new_mesh & ~direct3 & \
+        (s >= cfg.gossip_threshold) & joined
+    n_cand = jnp.sum(gossip_cand, axis=-1)
+    target = jnp.maximum(cfg.dlazy,
+                         jnp.floor(cfg.gossip_factor * n_cand).astype(jnp.int32))
+    gossip_sel = select_random(gossip_cand, target, ks[6])
+
+    return HeartbeatOut(state=st, scores=scores, gossip_sel=gossip_sel)

@@ -1,0 +1,197 @@
+"""Batched message propagation: publish, eager mesh forwarding, lazy gossip.
+
+Models the reference's data path — Publish fan-out (gossipsub.go:975-1045),
+per-hop forwarding through mesh members, IHAVE emission over the mcache
+gossip window + IWANT pull (gossipsub.go:630-739, 1711-1775) — as frontier
+expansion over the padded adjacency:
+
+- Message "wire transfer" between heartbeats is ``prop_substeps`` frontier
+  hops per tick (a message crosses the mesh in milliseconds between 1s
+  heartbeats; the hop bound plays the role of network latency).
+- The mcache ring (mcache.go) is derived state: a message is in a peer's
+  gossip window iff it was delivered within ``history_gossip`` ticks.
+- IWANT pulls resolve with a one-tick delay through ``iwant_pending``
+  (slot of the chosen IHAVE sender, lowest-slot deterministic choice vs the
+  reference's random pick, gossip_tracer.go:53).
+- Delivery bookkeeping feeds the score counters exactly where the reference's
+  RawTracer hooks fire: first deliveries (score.go:920-947), same-window
+  duplicates from mesh members (score.go:949-981).
+
+Memory: all [N, K, M] temporaries are chunked over M (``msg_chunk``), and
+per-(topic)-scatters are one-hot matmuls over the small T axis (MXU-friendly,
+no scatter in the hot loop).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..sim.config import SimConfig, TopicParams
+from ..sim.state import NEVER, SimState
+from .heartbeat import edge_gather
+
+
+def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
+            topics: jnp.ndarray) -> SimState:
+    """Start ``P`` new messages this tick, rotating through message slots.
+
+    publishers: [P] int32 peer ids; topics: [P] int32 topic ids. Slot reuse
+    resets the per-peer seen state (the timecache TTL analogue: a slot lives
+    msg_window // publishers_per_tick ticks).
+    """
+    p = publishers.shape[0]
+    m = cfg.msg_window
+    slots = (state.tick * p + jnp.arange(p)) % m
+
+    msg_topic = state.msg_topic.at[slots].set(topics)
+    msg_publish_tick = state.msg_publish_tick.at[slots].set(state.tick)
+    # reset recycled slots, then mark the publisher as having it
+    have = state.have.at[:, slots].set(False)
+    have = have.at[publishers, slots].set(True)
+    deliver_tick = state.deliver_tick.at[:, slots].set(NEVER)
+    deliver_tick = deliver_tick.at[publishers, slots].set(state.tick)
+    iwant_pending = state.iwant_pending.at[:, slots].set(-1)
+    return state._replace(msg_topic=msg_topic, msg_publish_tick=msg_publish_tick,
+                          have=have, deliver_tick=deliver_tick,
+                          iwant_pending=iwant_pending)
+
+
+def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
+    """[N, T, K] receiver-view forwarding mask: slot s's peer would forward a
+    topic-t message to me. Router-variant dispatch (static)."""
+    n, t, k = state.mesh.shape
+    conn = state.connected[:, None, :]
+    my_sub = state.subscribed[:, :, None]
+    if cfg.router == "gossipsub":
+        # sender forwards along ITS mesh edges (gossipsub.go:1020-1035)
+        return edge_gather(state.mesh, state)
+    if cfg.router == "floodsub":
+        # sender forwards to every subscribed neighbor (floodsub.go:76-100)
+        return conn & my_sub
+    if cfg.router == "randomsub":
+        # sender forwards to max(D, ceil(sqrt N)) random topic peers
+        # (randomsub.go:124-143): statistical model via per-edge Bernoulli
+        # with matching expected degree
+        target = jnp.maximum(cfg.d, jnp.ceil(jnp.sqrt(float(cfg.n_peers))))
+        deg = jnp.maximum(jnp.sum(state.connected, -1), 1)[:, None, None]
+        prob = jnp.minimum(target / deg, 1.0)
+        draw = jax.random.uniform(key, (n, t, k)) < prob
+        return conn & my_sub & draw
+    raise ValueError(f"unknown router {cfg.router!r}")
+
+
+def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
+                 gossip_sel: jnp.ndarray, key: jax.Array) -> SimState:
+    """One tick of data-plane traffic: resolve last tick's IWANTs, run
+    ``prop_substeps`` forwarding hops, then emit this tick's IHAVE/IWANT."""
+    n, t, k = state.mesh.shape
+    m = cfg.msg_window
+    nbr = jnp.clip(state.neighbors, 0, n - 1)
+    alive = (state.tick - state.msg_publish_tick) < cfg.history_length  # [M]
+    t_m = jnp.clip(state.msg_topic, 0, t - 1)                           # [M]
+    onehot_t = jax.nn.one_hot(t_m, t, dtype=jnp.float32) * \
+        (state.msg_topic >= 0)[:, None]                                  # [M,T]
+
+    fwd_mask = _edge_forward_mask(state, cfg, key)   # [N,T,K] receiver view
+    my_mesh = state.mesh                             # [N,T,K] my own mesh view
+    caps = tp.first_message_deliveries_cap[None, :, None], \
+        tp.mesh_message_deliveries_cap[None, :, None]
+
+    # -- step 1: resolve pending IWANTs from last tick (gossipsub.go:698-739:
+    # the sender answers from its mcache; delivery counts as a first delivery
+    # from a non-mesh peer) --
+    pend = state.iwant_pending                       # [N,M] slot or -1
+    # pend indexes slots per (peer, message); gather sender peer ids:
+    src = nbr[jnp.arange(n)[:, None], jnp.clip(pend, 0, k - 1)]       # [N,M]
+    src_has = state.have[src, jnp.arange(m)[None, :]]                 # [N,M]
+    got = (pend >= 0) & src_has & alive[None, :] & ~state.have
+    have = state.have | got
+    deliver_tick = jnp.where(got, state.tick, state.deliver_tick)
+    # first-delivery credit to the gossip sender: scatter via one-hot matmuls
+    slot_onehot = jax.nn.one_hot(jnp.clip(pend, 0, k - 1), k, dtype=jnp.float32)
+    fmd_add = jnp.einsum("nm,mt,nmk->ntk", got.astype(jnp.float32), onehot_t, slot_onehot)
+    fmd = jnp.minimum(state.first_message_deliveries + fmd_add, caps[0])
+    state = state._replace(have=have, deliver_tick=deliver_tick,
+                           first_message_deliveries=fmd,
+                           iwant_pending=jnp.full_like(pend, -1),
+                           delivered_total=state.delivered_total + jnp.sum(got))
+
+    # -- step 2: eager forwarding, prop_substeps hops, chunked over messages --
+    def hop(carry, _):
+        have, deliver_tick, frontier, fmd, mmd = carry
+
+        def chunk_body(c0, sl):
+            have_c, dt_c, fr_c, fmd_i, mmd_i = c0
+            msl = sl  # [Mc] message indices
+            fr_nbr = frontier[:, msl][nbr]            # [N,K,Mc] sender frontier
+            # edge forward mask for each chunk message's topic:
+            em = jnp.transpose(fwd_mask[:, t_m[msl], :], (0, 2, 1))  # [N,K,Mc]
+            senders = fr_nbr & em & alive[msl][None, None, :]
+            recv = jnp.any(senders, axis=1)           # [N,Mc]
+            had = have_c[:, msl]
+            new = recv & ~had
+            # first-sender attribution: lowest active slot
+            first_slot = jnp.argmax(senders, axis=1)  # [N,Mc]
+            slot_oh = jax.nn.one_hot(first_slot, k, dtype=jnp.float32)
+            new_f = new.astype(jnp.float32)
+            fmd_add = jnp.einsum("nm,mt,nmk->ntk", new_f, onehot_t[msl], slot_oh)
+            # mesh-delivery credit: first delivery from a peer in MY mesh
+            # (score.go:938-947), plus same-window duplicates from mesh
+            # members (score.go:949-981; window < 1 tick -> same tick)
+            in_my_mesh = jnp.transpose(my_mesh[:, t_m[msl], :], (0, 2, 1))  # [N,K,Mc]
+            dup = senders & (had | new)[:, None, :] & in_my_mesh
+            # exclude the first-delivery slot from dup, count it via new_f
+            dup = dup & ~(slot_oh.transpose(0, 2, 1).astype(bool) & new[:, None, :])
+            mmd_add = jnp.einsum("nkm,mt->ntk", dup.astype(jnp.float32), onehot_t[msl])
+            first_in_mesh = jnp.einsum(
+                "nm,mt,nmk->ntk", new_f, onehot_t[msl],
+                slot_oh * jnp.transpose(in_my_mesh, (0, 2, 1)))
+            have_c = have_c.at[:, msl].set(had | recv)
+            dt_c = dt_c.at[:, msl].set(jnp.where(new, state.tick, dt_c[:, msl]))
+            fr_c = fr_c.at[:, msl].set(new)
+            return (have_c, dt_c, fr_c,
+                    fmd_i + fmd_add, mmd_i + mmd_add + first_in_mesh), 0
+
+        slices = jnp.arange(m).reshape(-1, cfg.msg_chunk)
+        new_frontier = jnp.zeros_like(frontier)
+        (have, deliver_tick, new_frontier, fmd_d, mmd_d), _ = jax.lax.scan(
+            chunk_body, (have, deliver_tick, new_frontier,
+                         jnp.zeros((n, t, k), jnp.float32),
+                         jnp.zeros((n, t, k), jnp.float32)), slices)
+        return (have, deliver_tick, new_frontier, fmd + fmd_d, mmd + mmd_d), 0
+
+    frontier0 = state.deliver_tick == state.tick     # published/just received
+    carry0 = (state.have, state.deliver_tick, frontier0,
+              jnp.zeros((n, t, k), jnp.float32), jnp.zeros((n, t, k), jnp.float32))
+    (have, deliver_tick, _, fmd_add, mmd_add), _ = jax.lax.scan(
+        hop, carry0, None, length=cfg.prop_substeps)
+
+    delivered = jnp.sum(have) - jnp.sum(state.have)
+    fmd = jnp.minimum(state.first_message_deliveries + fmd_add, caps[0])
+    mmd = jnp.minimum(state.mesh_message_deliveries + mmd_add, caps[1])
+    state = state._replace(have=have, deliver_tick=deliver_tick,
+                           first_message_deliveries=fmd,
+                           mesh_message_deliveries=mmd,
+                           delivered_total=state.delivered_total + delivered)
+
+    # -- step 3: IHAVE/IWANT for next tick (gossipsub.go:1711-1775) --
+    # receiver view of gossip edges: slot s's peer gossips topic t to me
+    inc_gossip = edge_gather(gossip_sel, state)      # [N,T,K]
+    window = state.have & ((state.tick - state.deliver_tick) < cfg.history_gossip) \
+        & alive[None, :]                              # [N,M] sender gossip window
+
+    def iwant_chunk(c, sl):
+        pend = c
+        w_nbr = window[:, sl][nbr]                   # [N,K,Mc]
+        eg = jnp.transpose(inc_gossip[:, t_m[sl], :], (0, 2, 1))  # [N,K,Mc]
+        offer = w_nbr & eg
+        wanted = jnp.any(offer, axis=1) & ~state.have[:, sl]
+        best_slot = jnp.argmax(offer, axis=1).astype(jnp.int32)   # lowest slot
+        pend = pend.at[:, sl].set(jnp.where(wanted, best_slot, -1))
+        return pend, 0
+
+    slices = jnp.arange(m).reshape(-1, cfg.msg_chunk)
+    iwant_pending, _ = jax.lax.scan(iwant_chunk,
+                                    jnp.full((n, m), -1, jnp.int32), slices)
+    return state._replace(iwant_pending=iwant_pending)

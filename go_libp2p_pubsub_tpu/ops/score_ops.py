@@ -1,0 +1,111 @@
+"""Batched peer scoring: P1-P7 over [N, T, K] counters.
+
+Vectorized twin of routers/score.py (itself mirroring score.go:265-342
+``score()`` and score.go:504-565 ``refreshScores``). The observer axis is N,
+the observed neighbor lives in slot k; topic axis T carries the [T]-shaped
+TopicParams. One fused elementwise pass; XLA fuses the reductions.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..sim.config import SimConfig, TopicParams
+from ..sim.state import SimState
+
+
+def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams) -> jnp.ndarray:
+    """Score of the peer in slot k as seen by observer n -> [N, K] f32.
+
+    Mirrors score.go:265-342; disconnected/empty slots score 0.
+    """
+    if not cfg.scoring_enabled:
+        return jnp.zeros(state.behaviour_penalty.shape, jnp.float32)
+
+    # per-(n,t,k) topic components; tp broadcast as [1,T,1]
+    def t_(x):
+        return x[None, :, None]
+
+    in_mesh = state.mesh
+    mesh_time = jnp.where(in_mesh, (state.tick - state.graft_tick).astype(jnp.float32), 0.0)
+    # P1: floor(mesh_time/quantum), capped (score.go:285-291)
+    p1 = jnp.minimum(jnp.floor(mesh_time / t_(tp.time_in_mesh_quantum_ticks) + 1e-9),
+                     t_(tp.time_in_mesh_cap))
+    topic_score = jnp.where(in_mesh, p1 * t_(tp.time_in_mesh_weight), 0.0)
+    # P2
+    topic_score += state.first_message_deliveries * t_(tp.first_message_deliveries_weight)
+    # P3: squared deficit once activated (score.go:297-303)
+    deficit = t_(tp.mesh_message_deliveries_threshold) - state.mesh_message_deliveries
+    p3 = jnp.where(state.mesh_active & (deficit > 0), deficit * deficit, 0.0)
+    topic_score += p3 * t_(tp.mesh_message_deliveries_weight)
+    # P3b
+    topic_score += state.mesh_failure_penalty * t_(tp.mesh_failure_penalty_weight)
+    # P4: squared counter
+    topic_score += (state.invalid_message_deliveries ** 2) * \
+        t_(tp.invalid_message_deliveries_weight)
+
+    score = jnp.sum(topic_score * t_(tp.topic_weight), axis=1)  # [N, K]
+    if cfg.topic_score_cap > 0:
+        score = jnp.minimum(score, cfg.topic_score_cap)
+
+    nbr = jnp.clip(state.neighbors, 0, None)
+    # P5: app-specific (score.go:326-327)
+    if cfg.app_specific_weight != 0.0:
+        score += cfg.app_specific_weight * state.app_score[nbr]
+    # P6: IP colocation surplus^2 (score.go:329-331, 344-385); group census is
+    # global — the batched analogue of every observer seeing the same conns
+    if cfg.ip_colocation_factor_weight != 0.0:
+        counts = jnp.bincount(state.ip_group, length=cfg.n_ip_groups)
+        surplus = (counts[state.ip_group] - cfg.ip_colocation_factor_threshold
+                   ).astype(jnp.float32)
+        p6 = jnp.where(surplus > 0, surplus * surplus, 0.0)
+        score += cfg.ip_colocation_factor_weight * p6[nbr]
+    # P7: behaviour penalty excess^2 (score.go:334-339)
+    if cfg.behaviour_penalty_weight != 0.0:
+        excess = state.behaviour_penalty - cfg.behaviour_penalty_threshold
+        score += jnp.where(excess > 0, excess * excess, 0.0) * cfg.behaviour_penalty_weight
+
+    return jnp.where(state.connected, score, 0.0)
+
+
+def decay_counters(state: SimState, cfg: SimConfig, tp: TopicParams) -> SimState:
+    """refreshScores' decay pass (score.go:504-565), one tick == DecayInterval.
+
+    Also advances the P3 activation latch (mesh_time > activation).
+    """
+    def t_(x):
+        return x[None, :, None]
+
+    def dec(v, factor):
+        v = v * factor
+        return jnp.where(v < cfg.decay_to_zero, 0.0, v)
+
+    fmd = dec(state.first_message_deliveries, t_(tp.first_message_deliveries_decay))
+    mmd = dec(state.mesh_message_deliveries, t_(tp.mesh_message_deliveries_decay))
+    mfp = dec(state.mesh_failure_penalty, t_(tp.mesh_failure_penalty_decay))
+    imd = dec(state.invalid_message_deliveries, t_(tp.invalid_message_deliveries_decay))
+    bp = state.behaviour_penalty * cfg.behaviour_penalty_decay
+    bp = jnp.where(bp < cfg.decay_to_zero, 0.0, bp)
+    mesh_time = (state.tick - state.graft_tick).astype(jnp.float32)
+    active = state.mesh_active | (
+        state.mesh & (mesh_time > t_(tp.mesh_message_deliveries_activation_ticks)))
+    return state._replace(
+        first_message_deliveries=fmd, mesh_message_deliveries=mmd,
+        mesh_failure_penalty=mfp, invalid_message_deliveries=imd,
+        behaviour_penalty=bp, mesh_active=active)
+
+
+def apply_prune_penalty(state: SimState, pruned: jnp.ndarray,
+                        tp: TopicParams) -> SimState:
+    """P3b sticky failure penalty on prune (score.go:672-694): where an edge
+    is pruned while the P3 penalty is active and under threshold, add the
+    squared deficit; then clear the activation latch for the slot."""
+    def t_(x):
+        return x[None, :, None]
+
+    deficit = t_(tp.mesh_message_deliveries_threshold) - state.mesh_message_deliveries
+    add = jnp.where(pruned & state.mesh_active & (deficit > 0), deficit * deficit, 0.0)
+    return state._replace(
+        mesh_failure_penalty=state.mesh_failure_penalty + add,
+        mesh_active=jnp.where(pruned, False, state.mesh_active),
+        graft_tick=jnp.where(pruned, jnp.int32(2**30), state.graft_tick))

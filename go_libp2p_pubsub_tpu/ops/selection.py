@@ -1,0 +1,60 @@
+"""Masked selection primitives for the batched router.
+
+The reference's peer-selection idioms — random shuffles + "pick first D"
+(gossipsub.go:1954-1973), score-ordered keeps (gossipsub.go:1430-1490) —
+become masked (gumbel-)top-k over the K neighbor-slot axis. ``count`` may be
+a traced per-row scalar; selection is rank-based so the whole thing is one
+sort per call, MXU/VPU friendly, no data-dependent shapes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-1e30)
+
+
+def ranks_desc(keys: jnp.ndarray) -> jnp.ndarray:
+    """Rank (0 = largest) of each element along the last axis."""
+    order = jnp.argsort(-keys, axis=-1)
+    return jnp.argsort(order, axis=-1)
+
+
+def select_random(mask: jnp.ndarray, count: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+    """Uniformly choose up to ``count`` True positions per row of ``mask``.
+
+    count broadcasts against mask.shape[:-1]. Ties impossible w.p. 1.
+    """
+    noise = jax.random.uniform(key, mask.shape)
+    keys = jnp.where(mask, noise, NEG_INF)
+    r = ranks_desc(keys)
+    return (r < count[..., None]) & mask
+
+
+def select_top(score: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
+    """Choose up to ``count`` highest-score True positions per row.
+
+    Deterministic tie-break by slot index (lower slot wins), mirroring the
+    sorted-iteration determinism the batched engine guarantees.
+    """
+    k = mask.shape[-1]
+    tiebreak = -jnp.arange(k, dtype=jnp.float32) * 1e-9
+    keys = jnp.where(mask, score + tiebreak, NEG_INF)
+    r = ranks_desc(keys)
+    return (r < count[..., None]) & mask
+
+
+def masked_median(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
+    """Median of masked values along the last axis (gossipsub.go:1527-1542
+    computes the median mesh score for opportunistic grafting).
+
+    Matches Go's integer midpoint: element at index n//2 of the ascending
+    sorted masked values. Rows with an empty mask return +inf (no graft).
+    """
+    big = jnp.float32(1e30)
+    padded = jnp.where(mask, values, big)
+    srt = jnp.sort(padded, axis=-1)
+    n = jnp.sum(mask, axis=-1)
+    idx = jnp.clip(n // 2, 0, values.shape[-1] - 1)
+    return jnp.take_along_axis(srt, idx[..., None], axis=-1)[..., 0]

@@ -1,0 +1,79 @@
+"""Peer-axis sharding over a device mesh.
+
+The scaling axis of this framework is the peer dimension (SURVEY.md §5.7):
+all [N, ...] state shards along a 1-D ``peers`` mesh axis the way sequence-
+parallel schemes shard the sequence axis. Cross-shard mesh edges surface as
+gathers over the neighbor table; under jit's SPMD partitioner those lower to
+XLA collectives riding ICI (the TPU-native replacement for the reference's
+libp2p streams, SURVEY.md §2.3).
+
+No shard_map needed at this layer: annotate in/out shardings and let the
+compiler insert all_gathers/collective-permutes for the (sparse, Dhi-bounded)
+cross-shard edges.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..sim.config import SimConfig, TopicParams
+from ..sim.state import SimState
+
+PEER_AXIS = "peers"
+
+
+def make_mesh(devices=None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (PEER_AXIS,))
+
+
+def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
+    """A SimState-shaped pytree of NamedShardings: peer-major arrays shard on
+    axis 0, the global message table replicates, scalars replicate."""
+    n = cfg.n_peers
+
+    def spec_for(leaf_name: str, ndim: int, leading_n: bool):
+        if leading_n:
+            return NamedSharding(mesh, P(PEER_AXIS, *([None] * (ndim - 1))))
+        return NamedSharding(mesh, P(*([None] * ndim)))
+
+    # field -> (ndim, leading axis is N)
+    layout = dict(
+        tick=(0, False), neighbors=(2, True), connected=(2, True),
+        outbound=(2, True), reverse_slot=(2, True), subscribed=(2, True),
+        direct=(2, True), ip_group=(1, True), app_score=(1, True),
+        mesh=(3, True), fanout=(3, True), fanout_lastpub=(2, True),
+        backoff=(3, True), graft_tick=(3, True), mesh_active=(3, True),
+        first_message_deliveries=(3, True), mesh_message_deliveries=(3, True),
+        mesh_failure_penalty=(3, True), invalid_message_deliveries=(3, True),
+        behaviour_penalty=(2, True), msg_topic=(1, False),
+        msg_publish_tick=(1, False), have=(2, True), deliver_tick=(2, True),
+        iwant_pending=(2, True), delivered_total=(0, False),
+    )
+    assert set(layout) == set(SimState._fields), "layout drifted from SimState"
+    assert n % mesh.devices.size == 0, \
+        f"n_peers {n} must divide the {mesh.devices.size}-device mesh"
+    return SimState(**{f: spec_for(f, nd, ln) for f, (nd, ln) in layout.items()})
+
+
+def shard_state(state: SimState, mesh: Mesh, cfg: SimConfig) -> SimState:
+    shardings = state_shardings(mesh, cfg)
+    return jax.tree.map(jax.device_put, state, shardings)
+
+
+def make_sharded_step(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
+    """jit the full network step with explicit peer-sharded in/out state."""
+    from ..sim.engine import step
+
+    shardings = state_shardings(mesh, cfg)
+    key_sh = NamedSharding(mesh, P())
+
+    @partial(jax.jit, in_shardings=(shardings, key_sh), out_shardings=shardings)
+    def sharded_step(state: SimState, key: jax.Array) -> SimState:
+        return step(state, cfg, tp, key)
+
+    return sharded_step

@@ -126,7 +126,8 @@ class PeerScore(ev.RawTracerBase):
         self.peer_ips: dict[str, set[str]] = {}
         seen_ttl = params.seen_msg_ttl or TIME_CACHE_DURATION
         self.deliveries = _MessageDeliveries(seen_ttl, now)
-        self._whitelist_nets = [ipaddress.ip_network(c) for c in params.ip_colocation_factor_whitelist]
+        self._whitelist_nets = [ipaddress.ip_network(c, strict=False)
+                                for c in params.ip_colocation_factor_whitelist]
         # debugging inspection (score.go:127-180); called by the node's scheduler
         self.inspect: Callable[[dict[str, float]], None] | None = None
         self.inspect_period: float = 0.0
@@ -145,7 +146,9 @@ class PeerScore(ev.RawTracerBase):
             topic_score = 0.0
             # P1: time in mesh, quantized by integer division (score.go:285-291)
             if ts.in_mesh:
-                p1 = float(int(ts.mesh_time / tp.time_in_mesh_quantum))
+                # epsilon guards decimal float quanta (0.3/0.1 -> 2.999...)
+                # so truncation matches Go's integer-nanosecond division
+                p1 = float(int(ts.mesh_time / tp.time_in_mesh_quantum + 1e-9))
                 p1 = min(p1, tp.time_in_mesh_cap)
                 topic_score += p1 * tp.time_in_mesh_weight
             # P2: first message deliveries
@@ -246,7 +249,7 @@ class PeerScore(ev.RawTracerBase):
         """Re-resolve IPs of connected peers (score.go:567-585)."""
         for peer, pstats in self.peer_stats.items():
             if pstats.connected:
-                ips = self._get_ips(peer)
+                ips = list(self._get_ips(peer))
                 self._set_ips(peer, ips, pstats.ips)
                 pstats.ips = ips
 
@@ -262,7 +265,7 @@ class PeerScore(ev.RawTracerBase):
     def add_peer(self, peer: str, proto: str) -> None:
         pstats = self.peer_stats.setdefault(peer, _PeerStats())
         pstats.connected = True
-        ips = self._get_ips(peer)
+        ips = list(self._get_ips(peer))
         self._set_ips(peer, ips, pstats.ips)
         pstats.ips = ips
 

@@ -1,0 +1,15 @@
+from .config import SimConfig, TopicParams  # noqa: F401
+from .state import SimState, init_state  # noqa: F401
+from . import topology  # noqa: F401
+
+_ENGINE_EXPORTS = ("delivery_fraction", "mesh_degrees", "run", "step", "step_jit",
+                   "choose_publishers")
+
+
+def __getattr__(name):
+    # engine depends on ops/, which depends back on sim.config — lazy import
+    # keeps `import go_libp2p_pubsub_tpu.ops.heartbeat` cycle-free
+    if name in _ENGINE_EXPORTS:
+        from . import engine
+        return getattr(engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,175 @@
+"""Static configuration for the batched simulation engine.
+
+``SimConfig`` holds jit-static integers/floats (shapes, degree bounds, tick
+conversions) derived from GossipSubParams (gossipsub.go:32-60) plus the
+simulation capacities (SURVEY.md §7 "Dynamic sparse structures on TPU":
+fixed-capacity padded buffers with occupancy masks everywhere).
+
+``TopicParams`` holds the per-topic score parameters as [T]-shaped device
+arrays (score_params.go:117-170 vectorized over topics).
+
+All durations are expressed in heartbeat ticks: the virtual-clock domain is
+quantized so DecayInterval (1s default) == HeartbeatInterval == 1 tick
+(score_params.go:401, SURVEY.md §7 "Time").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.params import GossipSubParams, PeerScoreThresholds, TopicScoreParams
+
+
+@dataclasses.dataclass(frozen=True)
+class SimConfig:
+    """Jit-static simulation shape + gossipsub knobs (in ticks)."""
+
+    n_peers: int
+    k_slots: int              # max neighbors per peer (adjacency capacity)
+    n_topics: int = 1
+    msg_window: int = 128     # active message slots (rotating)
+    publishers_per_tick: int = 4
+    # router variant: "gossipsub" (mesh), "floodsub" (all topic peers,
+    # floodsub.go:76-100), "randomsub" (random max(D, sqrt N), randomsub.go:99-160)
+    router: str = "gossipsub"
+    prop_substeps: int = 8    # intra-tick forwarding hops (mesh diameter bound)
+    msg_chunk: int = 32       # message-axis chunk to bound [N,K,chunk] temps
+
+    # overlay degree bounds (gossipsub.go:32-40)
+    d: int = 6
+    dlo: int = 5
+    dhi: int = 12
+    dscore: int = 4
+    dout: int = 2
+    dlazy: int = 6
+    gossip_factor: float = 0.25
+
+    # windows, in ticks (gossipsub.go:37-58 durations / 1s heartbeat)
+    history_length: int = 5
+    history_gossip: int = 3
+    fanout_ttl_ticks: int = 60
+    prune_backoff_ticks: int = 60
+    unsubscribe_backoff_ticks: int = 10
+    opportunistic_graft_ticks: int = 60
+    opportunistic_graft_peers: int = 2
+    graft_flood_ticks: int = 10
+
+    # score thresholds (score_params.go:12-35)
+    gossip_threshold: float = 0.0
+    publish_threshold: float = 0.0
+    graylist_threshold: float = 0.0
+    accept_px_threshold: float = 0.0
+    opportunistic_graft_threshold: float = 0.0
+
+    # global score params (score_params.go:66-115)
+    topic_score_cap: float = 0.0
+    app_specific_weight: float = 0.0
+    ip_colocation_factor_weight: float = 0.0
+    ip_colocation_factor_threshold: int = 1
+    n_ip_groups: int = 1      # static bound for colocation bincount
+    behaviour_penalty_weight: float = 0.0
+    behaviour_penalty_threshold: float = 0.0
+    behaviour_penalty_decay: float = 0.999
+    decay_to_zero: float = 0.01
+    retain_score_ticks: int = 0
+
+    # P3 window in ticks; default 10ms << 1 tick -> same-round only
+    mesh_message_deliveries_window_ticks: int = 0
+
+    scoring_enabled: bool = True
+
+    @staticmethod
+    def from_params(n_peers: int, k_slots: int, n_topics: int = 1,
+                    params: GossipSubParams | None = None,
+                    thresholds: PeerScoreThresholds | None = None,
+                    **overrides) -> "SimConfig":
+        p = params or GossipSubParams()
+        th = thresholds or PeerScoreThresholds()
+        hb = p.heartbeat_interval
+        kw = dict(
+            n_peers=n_peers, k_slots=k_slots, n_topics=n_topics,
+            d=p.d, dlo=p.dlo, dhi=p.dhi, dscore=p.dscore, dout=p.dout,
+            dlazy=p.dlazy, gossip_factor=p.gossip_factor,
+            history_length=p.history_length, history_gossip=p.history_gossip,
+            fanout_ttl_ticks=max(1, int(p.fanout_ttl / hb)),
+            prune_backoff_ticks=max(1, int(p.prune_backoff / hb)),
+            unsubscribe_backoff_ticks=max(1, int(p.unsubscribe_backoff / hb)),
+            opportunistic_graft_ticks=int(p.opportunistic_graft_ticks),
+            opportunistic_graft_peers=p.opportunistic_graft_peers,
+            graft_flood_ticks=max(1, int(p.graft_flood_threshold / hb)),
+            gossip_threshold=th.gossip_threshold,
+            publish_threshold=th.publish_threshold,
+            graylist_threshold=th.graylist_threshold,
+            accept_px_threshold=th.accept_px_threshold,
+            opportunistic_graft_threshold=th.opportunistic_graft_threshold,
+        )
+        kw.update(overrides)
+        return SimConfig(**kw)
+
+
+class TopicParams(NamedTuple):
+    """[T]-shaped per-topic score parameters (score_params.go:117-170)."""
+
+    topic_weight: jnp.ndarray
+    time_in_mesh_weight: jnp.ndarray
+    time_in_mesh_quantum_ticks: jnp.ndarray   # >=1, integer ticks
+    time_in_mesh_cap: jnp.ndarray
+    first_message_deliveries_weight: jnp.ndarray
+    first_message_deliveries_decay: jnp.ndarray
+    first_message_deliveries_cap: jnp.ndarray
+    mesh_message_deliveries_weight: jnp.ndarray
+    mesh_message_deliveries_decay: jnp.ndarray
+    mesh_message_deliveries_cap: jnp.ndarray
+    mesh_message_deliveries_threshold: jnp.ndarray
+    mesh_message_deliveries_activation_ticks: jnp.ndarray
+    mesh_failure_penalty_weight: jnp.ndarray
+    mesh_failure_penalty_decay: jnp.ndarray
+    invalid_message_deliveries_weight: jnp.ndarray
+    invalid_message_deliveries_decay: jnp.ndarray
+
+    @staticmethod
+    def from_topic_params(topics: list[TopicScoreParams],
+                          heartbeat_interval: float = 1.0) -> "TopicParams":
+        """Pack a list of per-topic params into [T] arrays (ticks domain)."""
+        def arr(get, dtype=np.float32):
+            return jnp.asarray(np.array([get(t) for t in topics], dtype=dtype))
+
+        hb = heartbeat_interval
+        return TopicParams(
+            topic_weight=arr(lambda t: t.topic_weight),
+            time_in_mesh_weight=arr(lambda t: t.time_in_mesh_weight),
+            time_in_mesh_quantum_ticks=arr(
+                lambda t: max(t.time_in_mesh_quantum / hb, 1e-9)),
+            time_in_mesh_cap=arr(lambda t: t.time_in_mesh_cap),
+            first_message_deliveries_weight=arr(lambda t: t.first_message_deliveries_weight),
+            first_message_deliveries_decay=arr(
+                lambda t: t.first_message_deliveries_decay if t.first_message_deliveries_decay else 1.0),
+            first_message_deliveries_cap=arr(
+                lambda t: t.first_message_deliveries_cap if t.first_message_deliveries_cap else math.inf),
+            mesh_message_deliveries_weight=arr(lambda t: t.mesh_message_deliveries_weight),
+            mesh_message_deliveries_decay=arr(
+                lambda t: t.mesh_message_deliveries_decay if t.mesh_message_deliveries_decay else 1.0),
+            mesh_message_deliveries_cap=arr(
+                lambda t: t.mesh_message_deliveries_cap if t.mesh_message_deliveries_cap else math.inf),
+            mesh_message_deliveries_threshold=arr(lambda t: t.mesh_message_deliveries_threshold),
+            mesh_message_deliveries_activation_ticks=arr(
+                lambda t: t.mesh_message_deliveries_activation / hb),
+            mesh_failure_penalty_weight=arr(lambda t: t.mesh_failure_penalty_weight),
+            mesh_failure_penalty_decay=arr(
+                lambda t: t.mesh_failure_penalty_decay if t.mesh_failure_penalty_decay else 1.0),
+            invalid_message_deliveries_weight=arr(lambda t: t.invalid_message_deliveries_weight),
+            invalid_message_deliveries_decay=arr(
+                lambda t: t.invalid_message_deliveries_decay if t.invalid_message_deliveries_decay else 1.0),
+        )
+
+    @staticmethod
+    def disabled(n_topics: int) -> "TopicParams":
+        """All-zero-weight params (scoring effectively off) for T topics."""
+        return TopicParams.from_topic_params(
+            [TopicScoreParams(skip_atomic_validation=True, time_in_mesh_quantum=1.0)
+             for _ in range(n_topics)])

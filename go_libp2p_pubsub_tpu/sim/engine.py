@@ -1,0 +1,84 @@
+"""The simulation engine: one jitted step = decay + heartbeat + traffic.
+
+Composes the batched kernels into the per-tick transition the reference runs
+per node per second (gossipsub.go:1320-1343 heartbeat timer, score.go:408-445
+decay ticker, plus the continuous data plane):
+
+    step: (state, key) -> state
+      1. publish            P scenario-chosen messages enter the network
+      2. decay_counters     refreshScores' decay pass (DecayInterval == tick)
+      3. heartbeat          mesh maintenance + GRAFT/PRUNE exchange + gossip
+                            peer selection
+      4. forward_tick       IWANT resolution, mesh forwarding hops, IHAVE emit
+
+The Go router interleaves these nondeterministically across goroutines; the
+engine fixes the canonical order above (SURVEY.md §7 "Order-sensitivity").
+
+``run`` lax.scans the step for n_ticks entirely on device.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.heartbeat import heartbeat
+from ..ops.propagate import forward_tick, publish
+from ..ops.score_ops import decay_counters
+from .config import SimConfig, TopicParams
+from .state import SimState
+
+
+def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
+                      ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Default scenario: P random subscribed peers publish to random topics."""
+    kp, kt = jax.random.split(key)
+    p = cfg.publishers_per_tick
+    topics = jax.random.randint(kt, (p,), 0, cfg.n_topics)
+    peers = jax.random.randint(kp, (p,), 0, cfg.n_peers)
+    return peers, topics
+
+
+def step(state: SimState, cfg: SimConfig, tp: TopicParams,
+         key: jax.Array) -> SimState:
+    if cfg.msg_window % cfg.msg_chunk != 0:
+        raise ValueError("msg_window must be a multiple of msg_chunk")
+    k_pub, k_hb, k_fwd = jax.random.split(key, 3)
+    peers, topics = choose_publishers(state, cfg, k_pub)
+    state = publish(state, cfg, peers, topics)
+    state = decay_counters(state, cfg, tp)
+    hb = heartbeat(state, cfg, tp, k_hb)
+    state = forward_tick(hb.state, cfg, tp, hb.gossip_sel, k_fwd)
+    return state._replace(tick=state.tick + 1)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_ticks"))
+def run(state: SimState, cfg: SimConfig, tp: TopicParams, key: jax.Array,
+        n_ticks: int) -> SimState:
+    """Advance the whole network ``n_ticks`` heartbeats on device."""
+
+    def body(carry, k):
+        return step(carry, cfg, tp, k), None
+
+    keys = jax.random.split(key, n_ticks)
+    state, _ = jax.lax.scan(body, state, keys)
+    return state
+
+
+step_jit = jax.jit(step, static_argnames=("cfg",))
+
+
+def mesh_degrees(state: SimState) -> jnp.ndarray:
+    """[N, T] current mesh degree (for convergence checks)."""
+    return jnp.sum(state.mesh, axis=-1)
+
+
+def delivery_fraction(state: SimState, cfg: SimConfig) -> jnp.ndarray:
+    """Fraction of (subscribed peer, alive message) pairs delivered."""
+    alive = (state.tick - state.msg_publish_tick) < cfg.history_length
+    t_m = jnp.clip(state.msg_topic, 0, cfg.n_topics - 1)
+    should = state.subscribed[:, t_m] & alive[None, :] & (state.msg_topic >= 0)[None, :]
+    got = state.have & should
+    return jnp.sum(got) / jnp.maximum(jnp.sum(should), 1)

@@ -1,0 +1,109 @@
+"""Device-resident simulation state (SURVEY.md §7 "State layout").
+
+One ``SimState`` holds the entire N-peer network as a pytree of arrays —
+peer-major, fixed-capacity, mask-annotated. Checkpointing the network is
+saving this pytree (SURVEY.md §5.4: the simulator gains what the reference
+lacks — exact, free checkpoints).
+
+Array roles (reference state being modeled):
+- mesh/fanout/backoff per (peer, topic, slot): gossipsub.go:424-432 maps
+- score counters per (peer, topic, slot): score.go:17-62 topicStats, kept by
+  the *observing* peer about the neighbor in that slot
+- message window: mcache.go ring + timecache seen-set, modeled as per-peer
+  deliver-tick over a rotating window of message slots
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SimConfig
+from .topology import Topology
+
+# sentinel for "never" ticks
+NEVER = jnp.int32(2**30)
+
+
+class SimState(NamedTuple):
+    tick: jnp.ndarray                 # scalar int32 heartbeat counter
+
+    # --- static-ish topology (churn applied between steps) ---
+    neighbors: jnp.ndarray            # [N, K] int32, -1 padded
+    connected: jnp.ndarray            # [N, K] bool
+    outbound: jnp.ndarray             # [N, K] bool
+    reverse_slot: jnp.ndarray         # [N, K] int32
+    subscribed: jnp.ndarray           # [N, T] bool
+    direct: jnp.ndarray               # [N, K] bool (direct peers, gossipsub.go:425)
+    ip_group: jnp.ndarray             # [N] int32 (P6 colocation groups)
+    app_score: jnp.ndarray            # [N] float32 (P5 per-peer app score)
+
+    # --- router state ---
+    mesh: jnp.ndarray                 # [N, T, K] bool
+    fanout: jnp.ndarray               # [N, T, K] bool
+    fanout_lastpub: jnp.ndarray       # [N, T] int32 tick, NEVER if none
+    backoff: jnp.ndarray              # [N, T, K] int32 expiry tick
+
+    # --- score state (observer-major: what peer n thinks of slot k) ---
+    graft_tick: jnp.ndarray           # [N, T, K] int32
+    mesh_active: jnp.ndarray          # [N, T, K] bool (P3 activation latch)
+    first_message_deliveries: jnp.ndarray   # [N, T, K] f32
+    mesh_message_deliveries: jnp.ndarray    # [N, T, K] f32
+    mesh_failure_penalty: jnp.ndarray       # [N, T, K] f32
+    invalid_message_deliveries: jnp.ndarray # [N, T, K] f32
+    behaviour_penalty: jnp.ndarray    # [N, K] f32
+
+    # --- message window (rotating slots) ---
+    msg_topic: jnp.ndarray            # [M] int32 topic of message slot, -1 idle
+    msg_publish_tick: jnp.ndarray     # [M] int32
+    have: jnp.ndarray                 # [N, M] bool (seen/validated)
+    deliver_tick: jnp.ndarray         # [N, M] int32, NEVER if not delivered
+    iwant_pending: jnp.ndarray        # [N, M] int32 source peer for pending
+                                      #   gossip pull, -1 if none
+
+    # --- stats accumulated per step (observability) ---
+    delivered_total: jnp.ndarray      # scalar int64-ish f32 count
+
+
+def init_state(cfg: SimConfig, topo: Topology,
+               subscribed: np.ndarray | None = None,
+               ip_group: np.ndarray | None = None,
+               app_score: np.ndarray | None = None) -> SimState:
+    n, k, t, m = cfg.n_peers, cfg.k_slots, cfg.n_topics, cfg.msg_window
+    if subscribed is None:
+        subscribed = np.ones((n, t), dtype=bool)
+    f32 = lambda *shape: jnp.zeros(shape, jnp.float32)  # noqa: E731
+    i32 = lambda *shape, fill=0: jnp.full(shape, fill, jnp.int32)  # noqa: E731
+    b = lambda *shape: jnp.zeros(shape, bool)  # noqa: E731
+    return SimState(
+        tick=jnp.int32(0),
+        neighbors=jnp.asarray(topo.neighbors),
+        connected=jnp.asarray(topo.neighbors >= 0),
+        outbound=jnp.asarray(topo.outbound),
+        reverse_slot=jnp.asarray(topo.reverse_slot),
+        subscribed=jnp.asarray(subscribed),
+        direct=b(n, k),
+        ip_group=jnp.asarray(ip_group if ip_group is not None
+                             else np.zeros(n, np.int32)),
+        app_score=jnp.asarray(app_score if app_score is not None
+                              else np.zeros(n, np.float32)),
+        mesh=b(n, t, k),
+        fanout=b(n, t, k),
+        fanout_lastpub=i32(n, t, fill=int(NEVER)),
+        backoff=i32(n, t, k),
+        graft_tick=i32(n, t, k, fill=int(NEVER)),
+        mesh_active=b(n, t, k),
+        first_message_deliveries=f32(n, t, k),
+        mesh_message_deliveries=f32(n, t, k),
+        mesh_failure_penalty=f32(n, t, k),
+        invalid_message_deliveries=f32(n, t, k),
+        behaviour_penalty=f32(n, k),
+        msg_topic=i32(m, fill=-1),
+        msg_publish_tick=i32(m, fill=int(NEVER)),
+        have=b(n, m),
+        deliver_tick=i32(n, m, fill=int(NEVER)),
+        iwant_pending=i32(n, m, fill=-1),
+        delivered_total=jnp.float32(0.0),
+    )

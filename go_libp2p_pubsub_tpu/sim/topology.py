@@ -1,0 +1,94 @@
+"""Adjacency constructors for the simulated swarm.
+
+Mirrors the reference test harness's topology builders (floodsub_test.go:58-100
+``connect/sparseConnect/denseConnect/connectAll`` and the star topologies in
+gossipsub_test.go:1044-1127) as padded CSR-ish arrays:
+
+- ``neighbors [N, K] int32``: peer index per slot, -1 for empty
+- ``outbound  [N, K] bool``: True where this side dialed (gossipsub.go:467-476
+  outbound-direction tracking feeds the Dout quota)
+- ``reverse_slot [N, K] int32``: slot of me in my neighbor's table, -1 padding
+  (precomputed inverse so cross-peer effects are scatter-able on device)
+
+Builders are host-side numpy (topology churn is a scenario event, not a hot
+op); results go to device once per scenario.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+
+class Topology(NamedTuple):
+    neighbors: np.ndarray      # [N, K] int32, -1 padded
+    outbound: np.ndarray       # [N, K] bool
+    reverse_slot: np.ndarray   # [N, K] int32, -1 padded
+    degree: np.ndarray         # [N] int32
+
+
+def _finalize(n: int, k: int, adj: list[set[int]], dialed: set[tuple[int, int]]) -> Topology:
+    neighbors = np.full((n, k), -1, dtype=np.int32)
+    outbound = np.zeros((n, k), dtype=bool)
+    slot_of: dict[tuple[int, int], int] = {}
+    degree = np.zeros(n, dtype=np.int32)
+    for i in range(n):
+        nbrs = sorted(adj[i])[:k]
+        degree[i] = len(nbrs)
+        for s, j in enumerate(nbrs):
+            neighbors[i, s] = j
+            outbound[i, s] = (i, j) in dialed
+            slot_of[(i, j)] = s
+    reverse_slot = np.full((n, k), -1, dtype=np.int32)
+    for (i, j), s in slot_of.items():
+        rs = slot_of.get((j, i))
+        if rs is not None:
+            reverse_slot[i, s] = rs
+    return Topology(neighbors, outbound, reverse_slot, degree)
+
+
+def sparse(n: int, k: int, degree: int = 3, seed: int = 314159) -> Topology:
+    """Random graph, ``degree`` dials per peer (floodsub_test.go:75-82)."""
+    rng = np.random.default_rng(seed)
+    adj: list[set[int]] = [set() for _ in range(n)]
+    dialed: set[tuple[int, int]] = set()
+    for i in range(n):
+        choices = rng.permutation(n)
+        added = 0
+        for j in choices:
+            j = int(j)
+            if j == i or j in adj[i]:
+                continue
+            if len(adj[i]) >= k or len(adj[j]) >= k:
+                continue
+            adj[i].add(j)
+            adj[j].add(i)
+            dialed.add((i, j))
+            added += 1
+            if added >= degree:
+                break
+    return _finalize(n, k, adj, dialed)
+
+
+def dense(n: int, k: int, degree: int = 10, seed: int = 314159) -> Topology:
+    """Random graph, 10 dials per peer (floodsub_test.go:84-91)."""
+    return sparse(n, k, degree=degree, seed=seed)
+
+
+def full(n: int, k: int) -> Topology:
+    """Complete graph (connectAll, floodsub_test.go:93-100). Requires k >= n-1."""
+    adj = [set(range(n)) - {i} for i in range(n)]
+    dialed = {(i, j) for i in range(n) for j in range(i + 1, n)}
+    return _finalize(n, k, adj, dialed)
+
+
+def star(n: int, k: int) -> Topology:
+    """Peer 0 is the hub (gossipsub_test.go:1044-1127)."""
+    adj: list[set[int]] = [set() for _ in range(n)]
+    dialed = set()
+    for i in range(1, n):
+        adj[0].add(i)
+        adj[i].add(0)
+        dialed.add((i, 0))
+    return _finalize(n, k, adj, dialed)

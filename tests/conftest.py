@@ -1,16 +1,26 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before jax is imported anywhere; pytest loads conftest first, so
-setting the env vars here is sufficient as long as test modules import jax
-lazily (i.e. not at conftest-collection time in other plugins).
+This image routes every JAX process to the single remote TPU via an axon
+sitecustomize hook; the TPU admits one client at a time, so tests must NOT
+touch it. The hook registers the backend at interpreter start (jax is already
+imported by the time conftest runs) but nothing is *initialized* until the
+first jax.devices()/dispatch — so overriding jax_platforms via jax.config
+here, before any test imports run, reliably pins the whole session to CPU.
+XLA_FLAGS is also read at backend init, so setting it here still works.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
