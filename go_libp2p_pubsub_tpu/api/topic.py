@@ -1,0 +1,178 @@
+"""Topic handle: subscribe/publish/relay/events (topic.go).
+
+Join/subscribe lifecycle per SURVEY.md §3.5: the first subscription (or
+relay) announces to all peers and calls router.join; the last cancel
+announces unsubscription and calls router.leave (pubsub.go:800-848).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+from ..core.types import Message, PeerID
+from .subscription import Subscription
+from .validation import ValidationError
+
+if TYPE_CHECKING:
+    from .pubsub import PubSub
+
+
+class PeerEvent:
+    __slots__ = ("type", "peer")
+
+    def __init__(self, type_: str, peer: PeerID):
+        self.type = type_    # "join" | "leave"
+        self.peer = peer
+
+
+class TopicEventHandler:
+    """Coalescing join/leave event log (topic.go:392-477): rapid join+leave
+    pairs for the same peer cancel out, mirroring the reference's
+    peer-event coalescing."""
+
+    def __init__(self):
+        self._pending: dict[PeerID, str] = {}
+        self._order: list[PeerID] = []
+
+    def _push(self, ev: PeerEvent) -> None:
+        cur = self._pending.get(ev.peer)
+        if cur is None:
+            self._pending[ev.peer] = ev.type
+            self._order.append(ev.peer)
+        elif cur != ev.type:
+            del self._pending[ev.peer]
+            self._order.remove(ev.peer)
+
+    def next_peer_event(self) -> PeerEvent | None:
+        while self._order:
+            peer = self._order.pop(0)
+            typ = self._pending.pop(peer, None)
+            if typ is not None:
+                return PeerEvent(typ, peer)
+        return None
+
+    def cancel(self) -> None:
+        self._pending.clear()
+        self._order.clear()
+
+
+class Topic:
+    """topic.go:26-35."""
+
+    def __init__(self, p: "PubSub", name: str):
+        self.p = p
+        self.name = name
+        self._subs: list[Subscription] = []
+        self._event_handlers: list[TopicEventHandler] = []
+        self._relay_count = 0
+        self._closed = False
+
+    # -- lifecycle --
+
+    def _check_closed(self) -> None:
+        if self._closed:
+            raise RuntimeError("this Topic handle was closed")
+
+    def subscribe(self, buffer_size: int = 32,
+                  on_message: Callable[[Message], None] | None = None) -> Subscription:
+        """topic.go:143-182."""
+        self._check_closed()
+        sub = Subscription(self, buffer_size)
+        sub.on_message = on_message
+        first = not self._subs and self._relay_count == 0
+        self._subs.append(sub)
+        if first:
+            self._announce_and_join()
+        return sub
+
+    def relay(self) -> Callable[[], None]:
+        """Relay refcounting (topic.go:186-207): pump messages without a
+        subscription; returns a cancel function."""
+        self._check_closed()
+        first = not self._subs and self._relay_count == 0
+        self._relay_count += 1
+        self.p.my_relays[self.name] = self._relay_count
+        if first:
+            self._announce_and_join()
+        cancelled = False
+
+        def cancel():
+            nonlocal cancelled
+            if cancelled:
+                return
+            cancelled = True
+            self._relay_count -= 1
+            self.p.my_relays[self.name] = self._relay_count
+            if self._relay_count == 0:
+                del self.p.my_relays[self.name]
+            self._maybe_leave()
+        return cancel
+
+    def _announce_and_join(self) -> None:
+        """First sub/relay (handleAddSubscription, pubsub.go:827-848)."""
+        self.p.announce(self.name, True)
+        self.p.rt.join(self.name)  # routers trace Join themselves
+
+    def _remove_subscription(self, sub: Subscription) -> None:
+        """handleRemoveSubscription (pubsub.go:800-821)."""
+        self._subs.remove(sub)
+        self._maybe_leave()
+
+    def _maybe_leave(self) -> None:
+        if not self._subs and self._relay_count == 0:
+            self.p.announce(self.name, False)
+            self.p.rt.leave(self.name)
+
+    def close(self) -> None:
+        """topic.go:480-494: only an idle handle can be closed."""
+        if self._subs or self._relay_count:
+            raise RuntimeError("cannot close topic with active subscriptions or relays")
+        self._closed = True
+        self.p.my_topics.pop(self.name, None)
+
+    # -- events --
+
+    def event_handler(self) -> TopicEventHandler:
+        """topic.go:392-430; pre-seeds with currently known topic peers."""
+        self._check_closed()
+        h = TopicEventHandler()
+        for peer in sorted(self.p.topics.get(self.name, ())):
+            h._push(PeerEvent("join", peer))
+        self._event_handlers.append(h)
+        return h
+
+    def _notify_peer_event(self, typ: str, peer: PeerID) -> None:
+        for h in self._event_handlers:
+            h._push(PeerEvent(typ, peer))
+
+    def list_peers(self) -> list[PeerID]:
+        return self.p.list_peers(self.name)
+
+    # -- publish (topic.go:224-312) --
+
+    def publish(self, data: bytes, *, custom_key=None, local_only: bool = False) -> None:
+        """Build, sign, validate and route a message. Raises ValidationError
+        if local validation rejects it. ``local_only`` notifies in-process
+        subscribers without routing (WithLocalPublication, topic.go:323-331)."""
+        self._check_closed()
+        msg = Message(data=data, topic=self.name, received_from=self.p.pid,
+                      local=local_only)
+        if custom_key is not None:
+            pid, key = custom_key
+            msg.from_peer = pid
+            msg.seqno = self.p.next_seqno()
+            from .sign import sign_message
+            if self.p.sign_policy.must_sign:
+                sign_message(pid, key, msg)
+        else:
+            self.p.sign_and_finalize(msg)
+        self.p.val.push_local(msg)
+
+    def set_score_params(self, params) -> None:
+        """Per-topic score reconfiguration (topic.go:44-82)."""
+        rt = self.p.rt
+        score = getattr(rt, "score", None)
+        if score is None:
+            raise RuntimeError("peer scoring is not enabled")
+        params.validate()
+        score.set_topic_score_params(self.name, params)

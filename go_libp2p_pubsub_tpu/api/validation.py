@@ -1,0 +1,219 @@
+"""Validation pipeline (validation.go).
+
+Queue caps and throttles are preserved as *counters* on the deterministic
+scheduler instead of goroutines/channels:
+
+- front-end queue: ``validate_queue_size`` pending requests; overflow drops
+  with RejectValidationQueueFull (validation.go:246-260)
+- sync workers: requests drain from the queue after ``worker_delay`` virtual
+  seconds (the off-loop hop the reference gets from its NumCPU workers)
+- async validators: bounded by the global throttle (8192) and per-validator
+  throttle (1024); overflow -> RejectValidationThrottled / peer throttled
+  (validation.go:344-370, 459-500)
+- the signature check -> mark-seen -> inline validators -> async validators
+  ordering matches validation.go:293-370
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable
+
+from ..core.params import (
+    DEFAULT_VALIDATE_CONCURRENCY,
+    DEFAULT_VALIDATE_QUEUE_SIZE,
+    DEFAULT_VALIDATE_THROTTLE,
+)
+from ..core.types import Message, PeerID
+from ..trace import events as ev
+from .sign import SignError, verify_message_signature
+
+if TYPE_CHECKING:
+    from .pubsub import PubSub
+
+# ValidationResult (validation.go:36-52)
+VALIDATION_ACCEPT = 0
+VALIDATION_REJECT = 1
+VALIDATION_IGNORE = 2
+
+ValidatorEx = Callable[[PeerID, Message], int]
+
+
+class ValidationError(ValueError):
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class ValidatorImpl:
+    def __init__(self, topic: str, validate: ValidatorEx, throttle: int,
+                 inline: bool):
+        self.topic = topic
+        self.validate = validate
+        self.throttle = throttle
+        self.inflight = 0
+        self.inline = inline
+
+
+def as_validator_ex(fn) -> ValidatorEx:
+    """Accept bool-returning Validator or enum ValidatorEx (validation.go:163-192)."""
+    def wrapped(src: PeerID, msg: Message) -> int:
+        r = fn(src, msg)
+        if isinstance(r, bool):
+            return VALIDATION_ACCEPT if r else VALIDATION_REJECT
+        return int(r)
+    return wrapped
+
+
+class Validation:
+    def __init__(self, queue_size: int = DEFAULT_VALIDATE_QUEUE_SIZE,
+                 throttle: int = DEFAULT_VALIDATE_THROTTLE,
+                 worker_delay: float = 0.0):
+        self.p: "PubSub | None" = None
+        self.topic_vals: dict[str, ValidatorImpl] = {}
+        self.default_vals: list[ValidatorImpl] = []
+        self.queue_size = queue_size
+        self.queued = 0
+        self.throttle_cap = throttle
+        self.throttled = 0
+        self.worker_delay = worker_delay
+
+    def start(self, p: "PubSub") -> None:
+        self.p = p
+
+    # -- registration (validation.go:140-226) --
+
+    def add_validator(self, topic: str, validate, throttle: int = 0,
+                      inline: bool = False) -> None:
+        if topic in self.topic_vals:
+            raise ValueError(f"duplicate validator for topic {topic}")
+        self.topic_vals[topic] = ValidatorImpl(
+            topic, as_validator_ex(validate),
+            throttle or DEFAULT_VALIDATE_CONCURRENCY, inline)
+
+    def add_default_validator(self, validate, inline: bool = False) -> None:
+        self.default_vals.append(ValidatorImpl(
+            "", as_validator_ex(validate), DEFAULT_VALIDATE_CONCURRENCY, inline))
+
+    def remove_validator(self, topic: str) -> None:
+        if topic not in self.topic_vals:
+            raise ValueError(f"no validator for topic {topic}")
+        del self.topic_vals[topic]
+
+    def get_validators(self, msg: Message) -> list[ValidatorImpl]:
+        vals = list(self.default_vals)
+        v = self.topic_vals.get(msg.topic)
+        return vals + [v] if v is not None else vals
+
+    # -- entry points --
+
+    def push_local(self, msg: Message) -> None:
+        """Synchronous local-publish path (validation.go:232-242).
+        Raises ValidationError on rejection."""
+        p = self.p
+        assert p is not None
+        p.tracer.publish_message(msg)
+        p.check_signing_policy(msg)  # raises on policy violation
+        self._validate(self.get_validators(msg), msg.received_from, msg,
+                       synchronous=True)
+
+    def push(self, src: PeerID, msg: Message) -> bool:
+        """Inbound path; True means forward immediately, no validation needed
+        (validation.go:246-260)."""
+        p = self.p
+        assert p is not None
+        vals = self.get_validators(msg)
+        if vals or msg.signature is not None:
+            if self.queued >= self.queue_size:
+                p.tracer.reject_message(msg, ev.REJECT_VALIDATION_QUEUE_FULL)
+                return False
+            self.queued += 1
+
+            def worker():
+                self.queued -= 1
+                try:
+                    self._validate(vals, src, msg, synchronous=False)
+                except ValidationError:
+                    pass
+
+            if self.worker_delay > 0:
+                p.scheduler.call_later(self.worker_delay, worker)
+            else:
+                worker()
+            return False
+        return True
+
+    # -- the pipeline (validation.go:293-370) --
+
+    def _validate(self, vals: list[ValidatorImpl], src: PeerID | None,
+                  msg: Message, synchronous: bool) -> None:
+        p = self.p
+        assert p is not None
+        if msg.signature is not None:
+            try:
+                verify_message_signature(msg)
+            except SignError:
+                p.tracer.reject_message(msg, ev.REJECT_INVALID_SIGNATURE)
+                raise ValidationError(ev.REJECT_INVALID_SIGNATURE) from None
+
+        # mark seen after signature verification, before user validators
+        mid = p.id_gen.id(msg)
+        if not p.mark_seen(mid):
+            p.tracer.duplicate_message(msg)
+            return
+        p.tracer.validate_message(msg)
+
+        inline = [v for v in vals if v.inline or synchronous]
+        async_vals = [v for v in vals if not (v.inline or synchronous)]
+
+        result = VALIDATION_ACCEPT
+        for v in inline:
+            r = v.validate(src, msg)
+            if r == VALIDATION_REJECT:
+                p.tracer.reject_message(msg, ev.REJECT_VALIDATION_FAILED)
+                raise ValidationError(ev.REJECT_VALIDATION_FAILED)
+            if r == VALIDATION_IGNORE:
+                result = VALIDATION_IGNORE
+
+        if async_vals:
+            if self.throttled >= self.throttle_cap:
+                p.tracer.reject_message(msg, ev.REJECT_VALIDATION_THROTTLED)
+                return
+            self.throttled += 1
+            self._do_validate_topic(async_vals, src, msg, result)
+            self.throttled -= 1
+            return
+
+        if result == VALIDATION_IGNORE:
+            p.tracer.reject_message(msg, ev.REJECT_VALIDATION_IGNORED)
+            raise ValidationError(ev.REJECT_VALIDATION_IGNORED)
+
+        p.deliver_validated(msg)
+
+    def _do_validate_topic(self, vals: list[ValidatorImpl], src: PeerID | None,
+                           msg: Message, prior: int) -> None:
+        """Async leg (validation.go:410-500) with per-validator throttles."""
+        p = self.p
+        assert p is not None
+        result = prior
+        for v in vals:
+            if v.inflight >= v.throttle:
+                p.tracer.reject_message(msg, ev.REJECT_VALIDATION_THROTTLED)
+                p.tracer.throttle_peer(src)
+                return
+            v.inflight += 1
+            try:
+                r = v.validate(src, msg)
+            finally:
+                v.inflight -= 1
+            if r == VALIDATION_REJECT:
+                result = VALIDATION_REJECT
+                break
+            if r == VALIDATION_IGNORE:
+                result = VALIDATION_IGNORE
+        if result == VALIDATION_REJECT:
+            p.tracer.reject_message(msg, ev.REJECT_VALIDATION_FAILED)
+            return
+        if result == VALIDATION_IGNORE:
+            p.tracer.reject_message(msg, ev.REJECT_VALIDATION_IGNORED)
+            return
+        p.deliver_validated(msg)

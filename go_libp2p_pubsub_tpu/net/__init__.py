@@ -1,0 +1,1 @@
+from .network import Host, Network, Notifiee, Scheduler  # noqa: F401

@@ -1,0 +1,230 @@
+"""Simulated network substrate: the L0/L1 replacement (SURVEY.md §1).
+
+The reference sits on libp2p hosts with real TCP/QUIC streams and one
+goroutine per stream (comm.go). Here the substrate is a deterministic
+discrete-event simulation:
+
+- ``Scheduler``: a (time, seq)-ordered event heap driving ONE virtual clock;
+  every callback runs to completion before the next (the single-threaded
+  ``processLoop`` invariant, pubsub.go:561, holds globally by construction).
+- ``Host``: peer identity + addresses + connection table + notifiee fan-out
+  (notify.go) + per-protocol stream handlers.
+- RPC transfer: ``Host.send`` schedules delivery at now + latency with a
+  bounded in-flight cap per (src, dst) modeling the reference's per-peer
+  32-slot writer queue with silent-but-traced drops (comm.go:156-191,
+  gossipsub.go:1195-1202).
+
+Determinism: event order is (time, seq); all randomness comes from seeded
+RNGs owned by nodes.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Callable, Protocol
+
+from ..core.clock import VirtualClock
+from ..core.params import DEFAULT_PEER_OUTBOUND_QUEUE_SIZE
+from ..core.types import RPC, PeerID
+
+
+class Scheduler:
+    def __init__(self):
+        self.clock = VirtualClock()
+        self._heap: list[tuple[float, int, Callable[[], None]]] = []
+        self._seq = itertools.count()
+
+    def now(self) -> float:
+        return self.clock.now()
+
+    def call_at(self, t: float, fn: Callable[[], None]) -> None:
+        if t < self.now():
+            raise ValueError("scheduling into the past")
+        heapq.heappush(self._heap, (t, next(self._seq), fn))
+
+    def call_later(self, dt: float, fn: Callable[[], None]) -> None:
+        self.call_at(self.now() + dt, fn)
+
+    def call_every(self, interval: float, fn: Callable[[], None],
+                   initial_delay: float | None = None) -> Callable[[], None]:
+        """Periodic timer; returns a cancel function."""
+        cancelled = False
+
+        def tick():
+            if cancelled:
+                return
+            fn()
+            self.call_later(interval, tick)
+
+        self.call_later(interval if initial_delay is None else initial_delay, tick)
+
+        def cancel():
+            nonlocal cancelled
+            cancelled = True
+        return cancel
+
+    def run_until(self, t: float) -> None:
+        while self._heap and self._heap[0][0] <= t:
+            when, _, fn = heapq.heappop(self._heap)
+            self.clock.advance_to(when)
+            fn()
+        self.clock.advance_to(max(t, self.now()))
+
+    def run_for(self, dt: float) -> None:
+        self.run_until(self.now() + dt)
+
+
+class Notifiee(Protocol):
+    """Network event listener (notify.go:11-75)."""
+
+    def connected(self, peer: PeerID) -> None: ...
+    def disconnected(self, peer: PeerID) -> None: ...
+
+
+class Host:
+    """A simulated libp2p host: identity, addresses, connections, handlers."""
+
+    def __init__(self, network: "Network", peer_id: PeerID, addr: str):
+        self.network = network
+        self.peer_id = peer_id
+        self.addr = addr                     # source IP for P6 colocation
+        self.conns: dict[PeerID, str] = {}   # peer -> "outbound"/"inbound"
+        self.protocols: dict[PeerID, str] = {}  # negotiated protocol per peer
+        self._notifiees: list[Notifiee] = []
+        # protocol registration: ordered preference list + handler
+        self.supported: list[str] = []
+        self.stream_handler: Callable[[PeerID, str], None] | None = None
+        self.rpc_handler: Callable[[PeerID, RPC], None] | None = None
+        self._inflight: dict[PeerID, int] = {}
+        self.outbound_queue_size = DEFAULT_PEER_OUTBOUND_QUEUE_SIZE
+        self.dropped_rpcs = 0
+
+    # -- wiring --
+
+    def set_protocols(self, protos: list[str],
+                      stream_handler: Callable[[PeerID, str], None],
+                      rpc_handler: Callable[[PeerID, RPC], None]) -> None:
+        """Register pubsub's protocol list + handlers (pubsub.go:323-329)."""
+        self.supported = list(protos)
+        self.stream_handler = stream_handler
+        self.rpc_handler = rpc_handler
+
+    def notify(self, n: Notifiee) -> None:
+        self._notifiees.append(n)
+
+    # -- connectivity --
+
+    def connect(self, other: "Host") -> bool:
+        """Dial ``other``; negotiates the first mutually supported protocol
+        (the multistream-select analogue). Returns False if no overlap."""
+        if other.peer_id in self.conns:
+            return True
+        proto = next((p for p in self.supported if p in other.supported), None)
+        if self.supported and other.supported and proto is None:
+            return False
+        self.conns[other.peer_id] = "outbound"
+        other.conns[self.peer_id] = "inbound"
+        if proto is not None:
+            self.protocols[other.peer_id] = proto
+            other.protocols[self.peer_id] = proto
+        for n in self._notifiees:
+            n.connected(other.peer_id)
+        for n in other._notifiees:
+            n.connected(self.peer_id)
+        return True
+
+    def disconnect(self, peer: PeerID) -> None:
+        other = self.network.hosts.get(peer)
+        self.conns.pop(peer, None)
+        self.protocols.pop(peer, None)
+        if other is not None:
+            other.conns.pop(self.peer_id, None)
+            other.protocols.pop(self.peer_id, None)
+            for n in other._notifiees:
+                n.disconnected(self.peer_id)
+        for n in self._notifiees:
+            n.disconnected(peer)
+
+    def conns_to_peer(self, peer: PeerID) -> list[str]:
+        """Remote addresses for a connected peer (score.go getIPs source)."""
+        other = self.network.hosts.get(peer)
+        if peer in self.conns and other is not None:
+            return [other.addr]
+        return []
+
+    # -- wire transfer (comm.go equivalent) --
+
+    def send(self, peer: PeerID, rpc: RPC) -> bool:
+        """Queue an RPC to ``peer``. Models the bounded per-peer writer: at
+        most ``outbound_queue_size`` RPCs in flight; overflow is dropped and
+        reported to the caller (who traces it, gossipsub.go:1195-1202)."""
+        if peer not in self.conns:
+            return False
+        inflight = self._inflight.get(peer, 0)
+        if inflight >= self.outbound_queue_size:
+            self.dropped_rpcs += 1
+            return False
+        self._inflight[peer] = inflight + 1
+        rpc.from_peer = self.peer_id
+        sched = self.network.scheduler
+        delay = self.network.latency(self.peer_id, peer)
+
+        def deliver():
+            self._inflight[peer] = self._inflight.get(peer, 1) - 1
+            other = self.network.hosts.get(peer)
+            # connection may have died in flight
+            if other is not None and self.peer_id in other.conns \
+                    and other.rpc_handler is not None:
+                other.rpc_handler(self.peer_id, rpc)
+
+        sched.call_later(delay, deliver)
+        return True
+
+
+class Network:
+    """The swarm: host registry + shared scheduler + latency model
+    (the getNetHosts/connect test substrate, floodsub_test.go:45-100)."""
+
+    def __init__(self, latency: float | Callable[[PeerID, PeerID], float] = 0.001):
+        self.scheduler = Scheduler()
+        self.hosts: dict[PeerID, Host] = {}
+        self._latency = latency
+
+    def latency(self, a: PeerID, b: PeerID) -> float:
+        if callable(self._latency):
+            return self._latency(a, b)
+        return self._latency
+
+    def add_host(self, peer_id: PeerID | None = None, addr: str | None = None) -> Host:
+        pid = peer_id if peer_id is not None else f"peer-{len(self.hosts)}"
+        if pid in self.hosts:
+            raise ValueError(f"duplicate peer id {pid}")
+        h = Host(self, pid, addr or f"10.0.{len(self.hosts) // 256}.{len(self.hosts) % 256}")
+        self.hosts[pid] = h
+        return h
+
+    # topology builders mirroring floodsub_test.go:58-100
+    def connect(self, a: Host, b: Host) -> None:
+        a.connect(b)
+
+    def connect_all(self, hosts: list[Host]) -> None:
+        for i, a in enumerate(hosts):
+            for b in hosts[i + 1:]:
+                a.connect(b)
+
+    def sparse_connect(self, hosts: list[Host], degree: int = 3, seed: int = 314159) -> None:
+        self.connect_some(hosts, degree, seed)
+
+    def dense_connect(self, hosts: list[Host], degree: int = 10, seed: int = 314159) -> None:
+        self.connect_some(hosts, degree, seed)
+
+    def connect_some(self, hosts: list[Host], d: int, seed: int = 314159) -> None:
+        import random
+        rng = random.Random(seed)
+        n = len(hosts)
+        for i, a in enumerate(hosts):
+            for _ in range(d):
+                j = rng.randrange(n)
+                if j != i:
+                    a.connect(hosts[j])

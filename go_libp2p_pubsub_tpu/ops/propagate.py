@@ -74,8 +74,11 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.n
         # (randomsub.go:124-143): statistical model via per-edge Bernoulli
         # with matching expected degree
         target = jnp.maximum(cfg.d, jnp.ceil(jnp.sqrt(float(cfg.n_peers))))
-        deg = jnp.maximum(jnp.sum(state.connected, -1), 1)[:, None, None]
-        prob = jnp.minimum(target / deg, 1.0)
+        # probability is per SENDER: it picks target of ITS peers; view from
+        # the receiver via the neighbor table
+        nbr = jnp.clip(state.neighbors, 0, cfg.n_peers - 1)
+        sender_deg = jnp.maximum(jnp.sum(state.connected, -1), 1)[nbr]  # [N,K]
+        prob = jnp.minimum(target / sender_deg, 1.0)[:, None, :]
         draw = jax.random.uniform(key, (n, t, k)) < prob
         return conn & my_sub & draw
     raise ValueError(f"unknown router {cfg.router!r}")

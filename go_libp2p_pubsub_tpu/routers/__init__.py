@@ -1,0 +1,4 @@
+from .base import Router  # noqa: F401
+from .floodsub import FLOODSUB_ID, FloodSubRouter  # noqa: F401
+from .randomsub import RANDOMSUB_ID, RandomSubRouter  # noqa: F401
+from .score import PeerScore  # noqa: F401

@@ -33,11 +33,15 @@ from .state import SimState
 
 def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
                       ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Default scenario: P random subscribed peers publish to random topics."""
+    """Default scenario: P random peers publish, each to a random topic it
+    subscribes to (peers with no subscriptions fall back to topic 0, which
+    only arises in custom scenarios)."""
     kp, kt = jax.random.split(key)
     p = cfg.publishers_per_tick
-    topics = jax.random.randint(kt, (p,), 0, cfg.n_topics)
     peers = jax.random.randint(kp, (p,), 0, cfg.n_peers)
+    sub = state.subscribed[peers]                       # [P, T]
+    g = jax.random.gumbel(kt, sub.shape)
+    topics = jnp.argmax(jnp.where(sub, g, -jnp.inf), axis=-1).astype(jnp.int32)
     return peers, topics
 
 

@@ -45,6 +45,14 @@ def _finalize(n: int, k: int, adj: list[set[int]], dialed: set[tuple[int, int]])
         rs = slot_of.get((j, i))
         if rs is not None:
             reverse_slot[i, s] = rs
+    # capacity truncation can orphan one side of an edge; drop such slots so
+    # every surviving edge is symmetric (one-sided edges would silently never
+    # carry traffic through edge_gather)
+    orphan = (neighbors >= 0) & (reverse_slot < 0)
+    if orphan.any():
+        neighbors[orphan] = -1
+        outbound[orphan] = False
+        degree = (neighbors >= 0).sum(axis=1).astype(np.int32)
     return Topology(neighbors, outbound, reverse_slot, degree)
 
 
@@ -78,6 +86,8 @@ def dense(n: int, k: int, degree: int = 10, seed: int = 314159) -> Topology:
 
 def full(n: int, k: int) -> Topology:
     """Complete graph (connectAll, floodsub_test.go:93-100). Requires k >= n-1."""
+    if k < n - 1:
+        raise ValueError(f"full({n=}) needs k >= {n - 1}, got {k}")
     adj = [set(range(n)) - {i} for i in range(n)]
     dialed = {(i, j) for i in range(n) for j in range(i + 1, n)}
     return _finalize(n, k, adj, dialed)

@@ -1,0 +1,333 @@
+"""Integration tests for the host-side functional runtime.
+
+In-process multi-node swarms over the deterministic substrate, mirroring the
+reference's test pyramid (SURVEY.md §4): floodsub routing
+(floodsub_test.go), gossipsub mesh/fanout/gossip/backoff
+(gossipsub_test.go), signing, validation, blacklists, subscription
+announcements, and mixed-router networks.
+"""
+
+import pytest
+
+from go_libp2p_pubsub_tpu.api import (
+    LAX_NO_SIGN,
+    STRICT_SIGN,
+    PubSub,
+    ValidationError,
+    generate_keypair,
+)
+from go_libp2p_pubsub_tpu.core.params import GossipSubParams
+from go_libp2p_pubsub_tpu.net import Network
+from go_libp2p_pubsub_tpu.routers import FloodSubRouter, RandomSubRouter
+from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+from go_libp2p_pubsub_tpu.utils.subscription_filter import AllowlistSubscriptionFilter
+
+
+def make_net(n, router_factory, connect="dense", degree=10, **pubsub_kw):
+    net = Network()
+    nodes = []
+    for _ in range(n):
+        h = net.add_host()
+        nodes.append(PubSub(h, router_factory(), sign_policy=LAX_NO_SIGN,
+                            **pubsub_kw))
+    hosts = [x.host for x in nodes]
+    if connect == "dense":
+        net.dense_connect(hosts, degree=degree)
+    elif connect == "sparse":
+        net.sparse_connect(hosts, degree=3)
+    elif connect == "all":
+        net.connect_all(hosts)
+    net.scheduler.run_for(0.1)
+    return net, nodes
+
+
+def drain(sub):
+    out = []
+    while (m := sub.next()) is not None:
+        out.append(m)
+    return out
+
+
+class TestFloodSub:
+    def test_basic_routing(self):
+        # TestBasicFloodsub (floodsub_test.go:151)
+        net, nodes = make_net(20, FloodSubRouter, connect="sparse")
+        subs = [x.join("foobar").subscribe() for x in nodes]
+        net.scheduler.run_for(0.5)
+        for i in range(5):
+            nodes[i].my_topics["foobar"].publish(b"msg %d" % i)
+            net.scheduler.run_for(0.5)
+        for s in subs:
+            got = sorted(m.data for m in drain(s))
+            assert got == [b"msg %d" % i for i in range(5)]
+
+    def test_no_subscription_no_delivery(self):
+        net, nodes = make_net(5, FloodSubRouter, connect="all")
+        sub0 = nodes[0].join("a").subscribe()
+        nodes[1].join("b").subscribe()
+        net.scheduler.run_for(0.5)
+        nodes[1].my_topics["b"].publish(b"to-b")
+        net.scheduler.run_for(0.5)
+        assert drain(sub0) == []
+
+    def test_self_delivery(self):
+        net, nodes = make_net(2, FloodSubRouter, connect="all")
+        sub = nodes[0].join("t").subscribe()
+        net.scheduler.run_for(0.2)
+        nodes[0].my_topics["t"].publish(b"self")
+        net.scheduler.run_for(0.2)
+        assert [m.data for m in drain(sub)] == [b"self"]
+
+
+class TestRandomSub:
+    def test_propagation(self):
+        # randomsub_test.go:TestRandomsubBig-ish, small scale
+        net, nodes = make_net(20, lambda: RandomSubRouter(20), connect="dense")
+        subs = [x.join("t").subscribe() for x in nodes]
+        net.scheduler.run_for(0.5)
+        for i in range(5):
+            nodes[i].my_topics["t"].publish(b"m%d" % i)
+            net.scheduler.run_for(0.5)
+        # randomsub is probabilistic per hop; with sqrt(20)+flood target and
+        # dense topology every node should see everything
+        counts = [len(drain(s)) for s in subs]
+        assert min(counts) >= 4
+
+
+class TestGossipSub:
+    def test_dense_full_delivery(self):
+        # TestDenseGossipsub (gossipsub_test.go:85)
+        net, nodes = make_net(20, GossipSubRouter)
+        subs = [x.join("foobar").subscribe() for x in nodes]
+        net.scheduler.run_for(3.0)
+        for i in range(10):
+            nodes[i % 20].my_topics["foobar"].publish(b"%d" % i)
+            net.scheduler.run_for(0.3)
+        net.scheduler.run_for(2.0)
+        for s in subs:
+            assert len(drain(s)) == 10
+
+    def test_mesh_degree_bounds(self):
+        net, nodes = make_net(24, GossipSubRouter)
+        for x in nodes:
+            x.join("t").subscribe()
+        net.scheduler.run_for(5.0)
+        p = GossipSubParams()
+        degs = [len(x.rt.mesh["t"]) for x in nodes]
+        assert max(degs) <= p.dhi
+        assert min(degs) >= 1
+        # meshes are symmetric
+        by_pid = {x.pid: x for x in nodes}
+        for x in nodes:
+            for peer in x.rt.mesh["t"]:
+                assert x.pid in by_pid[peer].rt.mesh["t"]
+
+    def test_fanout_publish_without_subscribe(self):
+        # TestGossipsubFanout (gossipsub_test.go:126)
+        net, nodes = make_net(10, GossipSubRouter)
+        subs = [x.join("t").subscribe() for x in nodes[1:]]
+        net.scheduler.run_for(2.0)
+        pub = nodes[0].join("t")
+        pub.publish(b"from-fanout")
+        net.scheduler.run_for(2.0)
+        for s in subs:
+            assert [m.data for m in drain(s)] == [b"from-fanout"]
+        assert "t" in nodes[0].rt.fanout
+        # fanout expires after FanoutTTL without publishing
+        net.scheduler.run_for(GossipSubParams().fanout_ttl + 3.0)
+        assert "t" not in nodes[0].rt.fanout
+
+    def test_leave_sets_unsubscribe_backoff(self):
+        net, nodes = make_net(6, GossipSubRouter, connect="all")
+        subs = {x.pid: x.join("t").subscribe() for x in nodes}
+        net.scheduler.run_for(2.0)
+        leaver = nodes[0]
+        mesh_peers = set(leaver.rt.mesh["t"])
+        assert mesh_peers
+        subs[leaver.pid].cancel()
+        net.scheduler.run_for(0.5)
+        assert "t" not in leaver.rt.mesh
+        # the pruned peers recorded a backoff for the leaver
+        for x in nodes[1:]:
+            if x.pid in mesh_peers:
+                assert leaver.pid in x.rt.backoff.get("t", {})
+
+    def test_gossip_reaches_non_mesh_peers(self):
+        # gossip propagation (TestGossipsubGossip semantics,
+        # gossipsub_test.go:339): even peers outside the mesh receive via
+        # IHAVE/IWANT within a few heartbeats
+        net, nodes = make_net(20, GossipSubRouter)
+        subs = [x.join("t").subscribe() for x in nodes]
+        net.scheduler.run_for(3.0)
+        nodes[0].my_topics["t"].publish(b"gossiped")
+        # several heartbeats so IHAVE/IWANT can fire
+        net.scheduler.run_for(4.0)
+        assert all(len(drain(s)) == 1 for s in subs)
+
+    def test_mixed_floodsub_gossipsub(self):
+        # TestMixedGossipsub (gossipsub_test.go:909)
+        net = Network()
+        nodes = []
+        for i in range(20):
+            h = net.add_host()
+            rt = GossipSubRouter() if i % 2 == 0 else FloodSubRouter()
+            nodes.append(PubSub(h, rt, sign_policy=LAX_NO_SIGN))
+        net.dense_connect([x.host for x in nodes], degree=10)
+        net.scheduler.run_for(0.1)
+        subs = [x.join("t").subscribe() for x in nodes]
+        net.scheduler.run_for(3.0)
+        for i in range(5):
+            nodes[i].my_topics["t"].publish(b"m%d" % i)
+            net.scheduler.run_for(0.5)
+        net.scheduler.run_for(2.0)
+        for s in subs:
+            assert len(drain(s)) == 5
+
+
+class TestSigning:
+    def _signed_pair(self):
+        net = Network()
+        nodes = []
+        for i in range(2):
+            key, pid = generate_keypair(seed=b"node%d" % i)
+            h = net.add_host(peer_id=pid)
+            nodes.append(PubSub(h, FloodSubRouter(), sign_policy=STRICT_SIGN,
+                                sign_key=key))
+        net.connect_all([x.host for x in nodes])
+        net.scheduler.run_for(0.1)
+        return net, nodes
+
+    def test_signed_roundtrip(self):
+        net, nodes = self._signed_pair()
+        sub = nodes[1].join("t").subscribe()
+        nodes[0].join("t").subscribe()
+        net.scheduler.run_for(0.5)
+        nodes[0].my_topics["t"].publish(b"signed")
+        net.scheduler.run_for(0.5)
+        msgs = drain(sub)
+        assert len(msgs) == 1 and msgs[0].signature is not None
+
+    def test_tampered_message_rejected(self):
+        net, nodes = self._signed_pair()
+        sub = nodes[1].join("t").subscribe()
+        nodes[0].join("t").subscribe()
+        net.scheduler.run_for(0.5)
+        # craft a tampered message: sign then modify data
+        from go_libp2p_pubsub_tpu.core.types import Message, RPC
+        msg = Message(data=b"original", topic="t", from_peer=nodes[0].pid,
+                      seqno=b"\0" * 8)
+        from go_libp2p_pubsub_tpu.api.sign import sign_message
+        sign_message(nodes[0].pid, nodes[0].sign_key, msg)
+        msg.data = b"tampered"
+        nodes[0].host.send(nodes[1].pid, RPC(publish=[msg]))
+        net.scheduler.run_for(0.5)
+        assert drain(sub) == []
+
+    def test_unsigned_message_rejected_under_strict(self):
+        net, nodes = self._signed_pair()
+        sub = nodes[1].join("t").subscribe()
+        nodes[0].join("t").subscribe()
+        net.scheduler.run_for(0.5)
+        from go_libp2p_pubsub_tpu.core.types import Message, RPC
+        msg = Message(data=b"unsigned", topic="t", from_peer=nodes[0].pid,
+                      seqno=b"\1" * 8)
+        nodes[0].host.send(nodes[1].pid, RPC(publish=[msg]))
+        net.scheduler.run_for(0.5)
+        assert drain(sub) == []
+
+
+class TestValidation:
+    def test_rejecting_validator_blocks(self):
+        # TestValidate (validation_test.go-style)
+        net, nodes = make_net(5, FloodSubRouter, connect="all")
+        for x in nodes:
+            x.register_topic_validator(
+                "t", lambda src, msg: b"bad" not in msg.data)
+        subs = [x.join("t").subscribe() for x in nodes]
+        net.scheduler.run_for(0.5)
+        nodes[0].my_topics["t"].publish(b"good message")
+        net.scheduler.run_for(0.5)
+        with pytest.raises(ValidationError):
+            nodes[1].my_topics["t"].publish(b"bad message")
+        net.scheduler.run_for(0.5)
+        for s in subs:
+            assert [m.data for m in drain(s)] == [b"good message"]
+
+    def test_validator_sees_remote_messages(self):
+        net, nodes = make_net(3, FloodSubRouter, connect="all")
+        seen = []
+        nodes[1].register_topic_validator(
+            "t", lambda src, msg: seen.append(msg.data) or True)
+        sub = nodes[1].join("t").subscribe()
+        nodes[0].join("t").subscribe()
+        net.scheduler.run_for(0.5)
+        nodes[0].my_topics["t"].publish(b"x")
+        net.scheduler.run_for(0.5)
+        assert seen == [b"x"]
+        assert len(drain(sub)) == 1
+
+
+class TestRegistry:
+    def test_subscription_announcements(self):
+        net, nodes = make_net(4, FloodSubRouter, connect="all")
+        nodes[0].join("t").subscribe()
+        net.scheduler.run_for(0.5)
+        for x in nodes[1:]:
+            assert nodes[0].pid in x.topics.get("t", set())
+        assert nodes[1].list_peers("t") == [nodes[0].pid]
+
+    def test_peer_events(self):
+        net, nodes = make_net(3, FloodSubRouter, connect="all")
+        t0 = nodes[0].join("t")
+        h = t0.event_handler()
+        nodes[1].join("t").subscribe()
+        net.scheduler.run_for(0.5)
+        ev = h.next_peer_event()
+        assert ev is not None and ev.type == "join" and ev.peer == nodes[1].pid
+
+    def test_blacklist_drops_messages(self):
+        net, nodes = make_net(3, FloodSubRouter, connect="all")
+        sub2 = nodes[2].join("t").subscribe()
+        nodes[0].join("t").subscribe()
+        net.scheduler.run_for(0.5)
+        nodes[2].blacklist_peer(nodes[0].pid)
+        nodes[0].my_topics["t"].publish(b"nope")
+        net.scheduler.run_for(0.5)
+        assert drain(sub2) == []
+
+    def test_subscription_filter_blocks_join(self):
+        net = Network()
+        h = net.add_host()
+        ps = PubSub(h, FloodSubRouter(), sign_policy=LAX_NO_SIGN,
+                    subscription_filter=AllowlistSubscriptionFilter("ok"))
+        ps.join("ok")
+        with pytest.raises(ValueError):
+            ps.join("denied")
+
+    def test_relay(self):
+        # relay pumps messages through an unsubscribed node (topic.go:186-207)
+        net, nodes = make_net(3, FloodSubRouter)
+        net.connect(nodes[0].host, nodes[1].host)
+        net.connect(nodes[1].host, nodes[2].host)
+        net.scheduler.run_for(0.1)
+        sub2 = nodes[2].join("t").subscribe()
+        nodes[1].join("t").relay()
+        nodes[0].join("t").subscribe()
+        net.scheduler.run_for(0.5)
+        nodes[0].my_topics["t"].publish(b"via-relay")
+        net.scheduler.run_for(0.5)
+        assert [m.data for m in drain(sub2)] == [b"via-relay"]
+
+
+class TestDeterminism:
+    def test_two_runs_identical(self):
+        def run():
+            net, nodes = make_net(10, GossipSubRouter)
+            subs = [x.join("t").subscribe() for x in nodes]
+            net.scheduler.run_for(3.0)
+            nodes[0].my_topics["t"].publish(b"d")
+            net.scheduler.run_for(2.0)
+            meshes = tuple(tuple(sorted(x.rt.mesh["t"])) for x in nodes)
+            counts = tuple(len(drain(s)) for s in subs)
+            return meshes, counts
+        assert run() == run()

@@ -49,7 +49,7 @@ class TestMeshFormation:
     def test_degrees_within_bounds(self, converged):
         cfg, st = converged
         deg = np.asarray(mesh_degrees(st))
-        assert deg.min() >= cfg.dlo or deg.min() >= 1  # sparse corners may sit lower
+        assert deg.min() >= 1  # weak bound: sparse corners may sit below Dlo
         assert deg.max() <= cfg.dhi
 
     def test_mesh_symmetric(self, converged):
