@@ -48,6 +48,7 @@ class PubSub:
                  max_message_size: int = 1 << 20,
                  author: PeerID | None = None,
                  no_author: bool = False,
+                 discovery=None,
                  rng: random.Random | None = None):
         self.host = host
         self.rt = router
@@ -86,6 +87,12 @@ class PubSub:
         self.my_relays: dict[str, int] = {}            # relay refcounts
         self.peers: set[PeerID] = set()                # hello'd peers
         self.counter = 0                               # seqno (pubsub.go:1341)
+
+        # discovery bridge (pubsub.go:317, discovery.go:86)
+        from .discovery import Discover
+        self.disc = discovery if isinstance(discovery, Discover) \
+            else Discover(discovery)
+        self.disc.start(self)
 
         # wire up the substrate (pubsub.go:321-336)
         host.set_protocols(router.protocols(), self._handle_new_stream,

@@ -110,6 +110,8 @@ class Topic:
 
     def _announce_and_join(self) -> None:
         """First sub/relay (handleAddSubscription, pubsub.go:827-848)."""
+        self.p.disc.advertise(self.name)
+        self.p.disc.discover(self.name)
         self.p.announce(self.name, True)
         self.p.rt.join(self.name)  # routers trace Join themselves
 
@@ -120,6 +122,7 @@ class Topic:
 
     def _maybe_leave(self) -> None:
         if not self._subs and self._relay_count == 0:
+            self.p.disc.stop_advertise(self.name)
             self.p.announce(self.name, False)
             self.p.rt.leave(self.name)
 

@@ -99,6 +99,8 @@ class Host:
         self._inflight: dict[PeerID, int] = {}
         self.outbound_queue_size = DEFAULT_PEER_OUTBOUND_QUEUE_SIZE
         self.dropped_rpcs = 0
+        from .connmgr import ConnManager
+        self.conn_manager = ConnManager(network.scheduler)
 
     # -- wiring --
 

@@ -121,6 +121,11 @@ class GossipSubRouter:
         if self.gate is not None:
             self.gate.attach(p)
             p.tracer.add_raw(self.gate)
+        # connmgr tag tracer (NewGossipSub wires rt.tagTracer, gossipsub.go:208-212)
+        from .tag_tracer import TagTracer
+        self.tag_tracer = TagTracer(p.host.conn_manager, id_gen=p.id_gen,
+                                    direct=self.direct)
+        p.tracer.add_raw(self.tag_tracer)
         self.mcache.set_msg_id_fn(p.id_gen.id)
         sched.call_every(self.params.heartbeat_interval, self.heartbeat,
                          initial_delay=self.params.heartbeat_initial_delay)

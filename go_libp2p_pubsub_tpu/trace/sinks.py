@@ -1,0 +1,104 @@
+"""Trace sinks (tracer.go:79-303): NDJSON file, length-delimited binary file,
+and a batching "remote" sink.
+
+All sinks share the buffered, lossy writer discipline of the reference's
+``basicTracer`` (64k buffer, drop-when-full for the lossy remote sink,
+tracer.go:23,42-60); flushing happens on a scheduler timer instead of a
+writer goroutine.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import zlib
+from typing import Callable
+
+TRACE_BUFFER_SIZE = 1 << 16  # tracer.go:23
+MIN_TRACE_BATCH_SIZE = 16    # tracer.go:24
+
+
+class _BufferedTracer:
+    def __init__(self, lossy: bool):
+        self.buf: list[dict] = []
+        self.lossy = lossy
+        self.dropped = 0
+        self.closed = False
+
+    def trace(self, evt: dict) -> None:
+        if self.closed:
+            return
+        if self.lossy and len(self.buf) >= TRACE_BUFFER_SIZE:
+            self.dropped += 1
+            return
+        self.buf.append(evt)
+
+
+class JSONTracer(_BufferedTracer):
+    """NDJSON file sink (tracer.go:79-129)."""
+
+    def __init__(self, path: str):
+        super().__init__(lossy=False)
+        self.path = path
+        self._fh = open(path, "w")
+
+    def flush(self) -> None:
+        for evt in self.buf:
+            self._fh.write(json.dumps(evt) + "\n")
+        self.buf.clear()
+        self._fh.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self.closed = True
+        self._fh.close()
+
+
+class PBTracer(_BufferedTracer):
+    """Length-delimited binary file sink (tracer.go:132-181). Uses the pb
+    layer's TraceEvent encoding (uvarint length prefix + protobuf bytes)."""
+
+    def __init__(self, path: str):
+        super().__init__(lossy=False)
+        self.path = path
+        self._fh = open(path, "wb")
+
+    def flush(self) -> None:
+        from ..pb import codec
+        for evt in self.buf:
+            payload = codec.encode_trace_event(evt)
+            self._fh.write(codec.write_uvarint(len(payload)) + payload)
+        self.buf.clear()
+        self._fh.flush()
+
+    def close(self) -> None:
+        self.flush()
+        self.closed = True
+        self._fh.close()
+
+
+class RemoteTracer(_BufferedTracer):
+    """Batched gzip sink (tracer.go:186-303): lossy, batches of at least
+    MIN_TRACE_BATCH_SIZE events compressed and handed to a collector callable
+    (the substrate stand-in for the remote libp2p stream)."""
+
+    def __init__(self, send: Callable[[bytes], None]):
+        super().__init__(lossy=True)
+        self._send = send
+
+    def flush(self) -> None:
+        if len(self.buf) < MIN_TRACE_BATCH_SIZE:
+            return
+        batch, self.buf = self.buf, []
+        payload = gzip.compress(json.dumps({"batch": batch}).encode())
+        self._send(payload)
+
+    def close(self) -> None:
+        if self.buf:
+            batch, self.buf = self.buf, []
+            self._send(gzip.compress(json.dumps({"batch": batch}).encode()))
+        self.closed = True
+
+    @staticmethod
+    def decode_batch(payload: bytes) -> list[dict]:
+        return json.loads(zlib.decompress(payload, wbits=31))["batch"]

@@ -331,3 +331,79 @@ class TestDeterminism:
             counts = tuple(len(drain(s)) for s in subs)
             return meshes, counts
         assert run() == run()
+
+
+class TestScoringEndToEnd:
+    def _scored_net(self, n=10):
+        from go_libp2p_pubsub_tpu.core.params import (
+            PeerScoreParams, PeerScoreThresholds, TopicScoreParams)
+        net = Network()
+        nodes = []
+        for i in range(n):
+            h = net.add_host()
+            sp = PeerScoreParams(
+                app_specific_score=lambda p: 0.0,
+                decay_interval=1.0, decay_to_zero=0.01,
+                topics={"t": TopicScoreParams(
+                    topic_weight=1.0, time_in_mesh_quantum=1.0,
+                    invalid_message_deliveries_weight=-10.0,
+                    invalid_message_deliveries_decay=0.99)})
+            th = PeerScoreThresholds(gossip_threshold=-10, publish_threshold=-50,
+                                     graylist_threshold=-100)
+            rt = GossipSubRouter(score_params=sp, thresholds=th)
+            nodes.append(PubSub(h, rt, sign_policy=LAX_NO_SIGN))
+        net.connect_all([x.host for x in nodes])
+        net.scheduler.run_for(0.1)
+        return net, nodes
+
+    def test_invalid_spammer_pruned_and_graylisted(self):
+        # TestGossipsubNegativeScore semantics (gossipsub_test.go:1526)
+        net, nodes = self._scored_net(8)
+        for x in nodes:
+            x.register_topic_validator("t", lambda src, msg: b"spam" not in msg.data)
+        subs = [x.join("t").subscribe() for x in nodes]
+        net.scheduler.run_for(3.0)
+        spammer = nodes[0]
+        for i in range(10):
+            try:
+                spammer.my_topics["t"].publish(b"spam %d" % i)
+            except ValidationError:
+                # local validation blocks; send raw spam directly instead
+                from go_libp2p_pubsub_tpu.core.types import Message, RPC
+                for peer in list(spammer.peers):
+                    spammer.host.send(peer, RPC(publish=[Message(
+                        from_peer=spammer.pid, seqno=(1000 + i).to_bytes(8, "big"),
+                        data=b"spam %d" % i, topic="t")]))
+            net.scheduler.run_for(0.3)
+        net.scheduler.run_for(5.0)
+        # every honest node now scores the spammer negative and pruned it
+        for x in nodes[1:]:
+            assert x.rt.score.score(spammer.pid) < 0
+            assert spammer.pid not in x.rt.mesh.get("t", set())
+        # spam did not reach subscribers
+        for s in subs[1:]:
+            assert all(b"spam" not in m.data for m in iter(s.next, None))
+
+    def test_graylisted_peer_rpcs_dropped(self):
+        net, nodes = self._scored_net(3)
+        a, b = nodes[0], nodes[1]
+        for x in nodes:
+            x.join("t").subscribe()
+        net.scheduler.run_for(2.0)
+        # push b's score at a below the graylist threshold
+        st = a.rt.score.peer_stats[b.pid]
+        ts = st.get_topic_stats("t", a.rt.score.params)
+        ts.invalid_message_deliveries = 10.0  # -10 * 100 = -1000 < -100
+        assert a.rt.accept_from(b.pid).name == "ACCEPT_NONE"
+
+
+class TestConnManagerIntegration:
+    def test_mesh_peers_protected(self):
+        net, nodes = make_net(6, GossipSubRouter, connect="all")
+        for x in nodes:
+            x.join("t").subscribe()
+        net.scheduler.run_for(3.0)
+        a = nodes[0]
+        cm = a.host.conn_manager
+        for peer in a.rt.mesh["t"]:
+            assert cm.is_protected(peer, "pubsub:t")
