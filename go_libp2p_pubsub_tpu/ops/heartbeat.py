@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ..sim.config import SimConfig, TopicParams
-from ..sim.state import SimState
+from ..sim.state import NEVER, SimState
 from .score_ops import apply_prune_penalty, compute_scores
 from .selection import masked_median, select_random, select_top
 
@@ -50,7 +50,7 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
               key: jax.Array) -> HeartbeatOut:
     n, t, k = state.mesh.shape
     tick = state.tick
-    ks = jax.random.split(key, 7)
+    ks = jax.random.split(key, 8)
 
     scores = compute_scores(state, cfg, tp)          # [N, K]
     s = scores[:, None, :]                           # broadcast over T
@@ -147,17 +147,36 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     newly = new_mesh & ~state.mesh
     removed = state.mesh & ~new_mesh
 
+    # fanout maintenance (gossipsub.go:1560-1596): expire topics past
+    # FanoutTTL since last publish; drop disconnected/low-score members; top
+    # up to D from topic peers with score >= publish threshold. Fanout only
+    # exists for non-joined topics (Join promotes it, gossipsub.go:1047-1102).
+    fanout_alive = (state.fanout_lastpub < NEVER) & \
+        (tick <= state.fanout_lastpub + cfg.fanout_ttl_ticks) & ~state.subscribed
+    fa3 = fanout_alive[..., None]
+    keep_f = state.fanout & conn & nbr_sub & (s >= cfg.publish_threshold) & fa3
+    need_f = jnp.where(fanout_alive,
+                       jnp.maximum(cfg.d - jnp.sum(keep_f, -1), 0), 0)
+    add_f = select_random(
+        conn & nbr_sub & ~keep_f & ~direct3 & (s >= cfg.publish_threshold) & fa3,
+        need_f, ks[7])
+    new_fanout = keep_f | add_f
+    fanout_lastpub = jnp.where(fanout_alive, state.fanout_lastpub, NEVER)
+
     st = state._replace(mesh=new_mesh, backoff=new_backoff,
-                        behaviour_penalty=behaviour_penalty)
+                        behaviour_penalty=behaviour_penalty,
+                        fanout=new_fanout, fanout_lastpub=fanout_lastpub)
     st = apply_prune_penalty(st, removed, tp)
     st = st._replace(
         graft_tick=jnp.where(newly, tick, st.graft_tick),
         mesh_active=jnp.where(newly, False, st.mesh_active))
 
-    # emitGossip peer selection (gossipsub.go:1711-1775): non-mesh topic peers
-    # with score >= gossip threshold; target max(Dlazy, factor * candidates)
-    gossip_cand = conn & nbr_sub & ~new_mesh & ~direct3 & \
-        (s >= cfg.gossip_threshold) & joined
+    # emitGossip peer selection (gossipsub.go:1711-1775): non-mesh/non-fanout
+    # topic peers with score >= gossip threshold, for joined AND active-fanout
+    # topics (the heartbeat gossips both loops, gossipsub.go:1556, 1596);
+    # target max(Dlazy, factor * candidates)
+    gossip_cand = conn & nbr_sub & ~new_mesh & ~new_fanout & ~direct3 & \
+        (s >= cfg.gossip_threshold) & (joined | fa3)
     n_cand = jnp.sum(gossip_cand, axis=-1)
     target = jnp.maximum(cfg.dlazy,
                          jnp.floor(cfg.gossip_factor * n_cand).astype(jnp.int32))

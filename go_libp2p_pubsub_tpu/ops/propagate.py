@@ -12,10 +12,22 @@ expansion over the padded adjacency:
   gossip window iff it was delivered within ``history_gossip`` ticks.
 - IWANT pulls resolve with a one-tick delay through ``iwant_pending``
   (slot of the chosen IHAVE sender, lowest-slot deterministic choice vs the
-  reference's random pick, gossip_tracer.go:53).
+  reference's random pick, gossip_tracer.go:53). Unanswered pulls are broken
+  gossip promises: one P7 behaviour-penalty point per broken message id
+  (gossip_tracer.go:79-115 GetBrokenPromises → gossipsub.go:1620-1625
+  applyIwantPenalties).
 - Delivery bookkeeping feeds the score counters exactly where the reference's
   RawTracer hooks fire: first deliveries (score.go:920-947), same-window
-  duplicates from mesh members (score.go:949-981).
+  duplicates from mesh members (score.go:949-981), invalid deliveries
+  (score.go:899-918 RejectMessage → P4).
+- Receive gating: data from peers scored below ``graylist_threshold`` is
+  ignored (AcceptFrom, gossipsub.go:598-609), and IHAVE from peers below
+  ``gossip_threshold`` is ignored (gossipsub.go:634-645) — both use the
+  RECEIVER's score of the sender. The per-tick IWANT budget enforces
+  MaxIHaveLength flood protection (gossipsub.go:654-676).
+- Adversaries (``state.malicious``): publish invalid messages, advertise the
+  entire live window, never answer IWANTs, and accept/forward anything —
+  the gossipsub_spam_test.go actor behaviors as peer attributes.
 
 Memory: all [N, K, M] temporaries are chunked over M (``msg_chunk``), and
 per-(topic)-scatters are one-hot matmuls over the small T axis (MXU-friendly,
@@ -38,7 +50,9 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
 
     publishers: [P] int32 peer ids; topics: [P] int32 topic ids. Slot reuse
     resets the per-peer seen state (the timecache TTL analogue: a slot lives
-    msg_window // publishers_per_tick ticks).
+    msg_window // publishers_per_tick ticks). Publishers not subscribed to
+    their topic stamp ``fanout_lastpub`` (gossipsub.go:1007-1018: publish to
+    fanout, record lastpub). Malicious publishers emit invalid messages.
     """
     p = publishers.shape[0]
     m = cfg.msg_window
@@ -46,15 +60,22 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
 
     msg_topic = state.msg_topic.at[slots].set(topics)
     msg_publish_tick = state.msg_publish_tick.at[slots].set(state.tick)
+    msg_invalid = state.msg_invalid.at[slots].set(state.malicious[publishers])
     # reset recycled slots, then mark the publisher as having it
     have = state.have.at[:, slots].set(False)
     have = have.at[publishers, slots].set(True)
     deliver_tick = state.deliver_tick.at[:, slots].set(NEVER)
     deliver_tick = deliver_tick.at[publishers, slots].set(state.tick)
     iwant_pending = state.iwant_pending.at[:, slots].set(-1)
+    # fanout lastpub for non-subscribed publishers
+    sub_pub = state.subscribed[publishers, topics]
+    cur_lp = state.fanout_lastpub[publishers, topics]
+    fanout_lastpub = state.fanout_lastpub.at[publishers, topics].set(
+        jnp.where(sub_pub, cur_lp, state.tick))
     return state._replace(msg_topic=msg_topic, msg_publish_tick=msg_publish_tick,
-                          have=have, deliver_tick=deliver_tick,
-                          iwant_pending=iwant_pending)
+                          msg_invalid=msg_invalid, have=have,
+                          deliver_tick=deliver_tick, iwant_pending=iwant_pending,
+                          fanout_lastpub=fanout_lastpub)
 
 
 def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
@@ -64,8 +85,10 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.n
     conn = state.connected[:, None, :]
     my_sub = state.subscribed[:, :, None]
     if cfg.router == "gossipsub":
-        # sender forwards along ITS mesh edges (gossipsub.go:1020-1035)
-        return edge_gather(state.mesh, state)
+        # sender forwards along ITS mesh edges (gossipsub.go:1020-1035); a
+        # non-subscribed publisher sends along its fanout (gossipsub.go:1007)
+        send = state.mesh | (state.fanout & ~state.subscribed[:, :, None])
+        return edge_gather(send, state)
     if cfg.router == "floodsub":
         # sender forwards to every subscribed neighbor (floodsub.go:76-100)
         return conn & my_sub
@@ -85,18 +108,35 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.n
 
 
 def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
-                 gossip_sel: jnp.ndarray, key: jax.Array) -> SimState:
+                 gossip_sel: jnp.ndarray, scores: jnp.ndarray,
+                 key: jax.Array) -> SimState:
     """One tick of data-plane traffic: resolve last tick's IWANTs, run
-    ``prop_substeps`` forwarding hops, then emit this tick's IHAVE/IWANT."""
+    ``prop_substeps`` forwarding hops, then emit this tick's IHAVE/IWANT.
+
+    ``scores`` is the heartbeat's [N, K] score cache (receiver's score of the
+    peer in slot k), used for accept/gossip gating.
+    """
     n, t, k = state.mesh.shape
     m = cfg.msg_window
     nbr = jnp.clip(state.neighbors, 0, n - 1)
-    alive = (state.tick - state.msg_publish_tick) < cfg.history_length  # [M]
+    # [M] slot holds a live message: published (tick < NEVER, so the age is
+    # non-negative) within the mcache history window
+    age_pub = state.tick - state.msg_publish_tick
+    alive = (age_pub >= 0) & (age_pub < cfg.history_length)
     t_m = jnp.clip(state.msg_topic, 0, t - 1)                           # [M]
     onehot_t = jax.nn.one_hot(t_m, t, dtype=jnp.float32) * \
         (state.msg_topic >= 0)[:, None]                                  # [M,T]
+    mal_recv = state.malicious[:, None]                                  # [N,1]
+
+    if cfg.scoring_enabled:
+        accept_ok = scores >= cfg.graylist_threshold      # [N,K] AcceptFrom
+        gossip_ok = scores >= cfg.gossip_threshold        # [N,K] handleIHave
+    else:
+        accept_ok = jnp.ones((n, k), bool)
+        gossip_ok = jnp.ones((n, k), bool)
 
     fwd_mask = _edge_forward_mask(state, cfg, key)   # [N,T,K] receiver view
+    fwd_mask = fwd_mask & accept_ok[:, None, :]
     my_mesh = state.mesh                             # [N,T,K] my own mesh view
     caps = tp.first_message_deliveries_cap[None, :, None], \
         tp.mesh_message_deliveries_cap[None, :, None]
@@ -107,25 +147,41 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     pend = state.iwant_pending                       # [N,M] slot or -1
     # pend indexes slots per (peer, message); gather sender peer ids:
     src = nbr[jnp.arange(n)[:, None], jnp.clip(pend, 0, k - 1)]       # [N,M]
-    src_has = state.have[src, jnp.arange(m)[None, :]]                 # [N,M]
-    got = (pend >= 0) & src_has & alive[None, :] & ~state.have
+    # malicious sources never answer IWANTs (the iwantEverything-style actor
+    # holds its promises open, gossipsub_spam_test.go:23-133); honest sources
+    # answer from their mcache, which rejected messages never enter
+    # (deliver_tick stays NEVER on rejection — validation.go:293-370)
+    src_answers = (state.deliver_tick[src, jnp.arange(m)[None, :]] < NEVER) \
+        & ~state.malicious[src]
+    asked = (pend >= 0) & alive[None, :]
+    # pulls cannot yield invalid messages: honest mcaches never contain them
+    # (rejected messages are not delivered) and malicious sources never answer
+    got = asked & src_answers & ~state.have
+    broken = asked & ~src_answers
     have = state.have | got
     deliver_tick = jnp.where(got, state.tick, state.deliver_tick)
-    # first-delivery credit to the gossip sender: scatter via one-hot matmuls
+    # per-slot attribution via one-hot matmuls
     slot_onehot = jax.nn.one_hot(jnp.clip(pend, 0, k - 1), k, dtype=jnp.float32)
     fmd_add = jnp.einsum("nm,mt,nmk->ntk", got.astype(jnp.float32), onehot_t, slot_onehot)
     fmd = jnp.minimum(state.first_message_deliveries + fmd_add, caps[0])
-    state = state._replace(have=have, deliver_tick=deliver_tick,
-                           first_message_deliveries=fmd,
-                           iwant_pending=jnp.full_like(pend, -1),
-                           delivered_total=state.delivered_total + jnp.sum(got))
+    # broken promises: one penalty point per unfulfilled message id
+    # (gossip_tracer.go:79-115, applied gossipsub.go:1620-1625)
+    broken_per_slot = jnp.einsum("nm,nmk->nk", broken.astype(jnp.float32), slot_onehot)
+    state = state._replace(
+        have=have, deliver_tick=deliver_tick,
+        first_message_deliveries=fmd,
+        behaviour_penalty=state.behaviour_penalty + broken_per_slot,
+        iwant_pending=jnp.full_like(pend, -1),
+        delivered_total=state.delivered_total + jnp.sum(got))
 
     # -- step 2: eager forwarding, prop_substeps hops, chunked over messages --
+    invalid_m = state.msg_invalid                    # [M]
+
     def hop(carry, _):
-        have, deliver_tick, frontier, fmd, mmd = carry
+        have, deliver_tick, frontier, fmd, mmd, imd = carry
 
         def chunk_body(c0, sl):
-            have_c, dt_c, fr_c, fmd_i, mmd_i = c0
+            have_c, dt_c, fr_c, fmd_i, mmd_i, imd_i = c0
             msl = sl  # [Mc] message indices
             fr_nbr = frontier[:, msl][nbr]            # [N,K,Mc] sender frontier
             # edge forward mask for each chunk message's topic:
@@ -134,67 +190,100 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             recv = jnp.any(senders, axis=1)           # [N,Mc]
             had = have_c[:, msl]
             new = recv & ~had
+            # honest receivers reject invalid messages: seen but not
+            # delivered/forwarded; P4 charged to the delivering slot
+            new_invalid = new & invalid_m[msl][None, :] & ~mal_recv
+            new_valid = new & ~new_invalid
             # first-sender attribution: lowest active slot
             first_slot = jnp.argmax(senders, axis=1)  # [N,Mc]
             slot_oh = jax.nn.one_hot(first_slot, k, dtype=jnp.float32)
-            new_f = new.astype(jnp.float32)
+            new_f = new_valid.astype(jnp.float32)
             fmd_add = jnp.einsum("nm,mt,nmk->ntk", new_f, onehot_t[msl], slot_oh)
+            imd_add = jnp.einsum("nm,mt,nmk->ntk",
+                                 new_invalid.astype(jnp.float32),
+                                 onehot_t[msl], slot_oh)
             # mesh-delivery credit: first delivery from a peer in MY mesh
             # (score.go:938-947), plus same-window duplicates from mesh
             # members (score.go:949-981; window < 1 tick -> same tick)
             in_my_mesh = jnp.transpose(my_mesh[:, t_m[msl], :], (0, 2, 1))  # [N,K,Mc]
-            dup = senders & (had | new)[:, None, :] & in_my_mesh
+            dup = senders & (had | new_valid)[:, None, :] & in_my_mesh & \
+                ~invalid_m[msl][None, None, :]
             # exclude the first-delivery slot from dup, count it via new_f
-            dup = dup & ~(slot_oh.transpose(0, 2, 1).astype(bool) & new[:, None, :])
+            dup = dup & ~(slot_oh.transpose(0, 2, 1).astype(bool) & new_valid[:, None, :])
             mmd_add = jnp.einsum("nkm,mt->ntk", dup.astype(jnp.float32), onehot_t[msl])
             first_in_mesh = jnp.einsum(
                 "nm,mt,nmk->ntk", new_f, onehot_t[msl],
                 slot_oh * jnp.transpose(in_my_mesh, (0, 2, 1)))
             have_c = have_c.at[:, msl].set(had | recv)
-            dt_c = dt_c.at[:, msl].set(jnp.where(new, state.tick, dt_c[:, msl]))
-            fr_c = fr_c.at[:, msl].set(new)
-            return (have_c, dt_c, fr_c,
-                    fmd_i + fmd_add, mmd_i + mmd_add + first_in_mesh), 0
+            dt_c = dt_c.at[:, msl].set(jnp.where(new_valid, state.tick, dt_c[:, msl]))
+            fr_c = fr_c.at[:, msl].set(new_valid)
+            return (have_c, dt_c, fr_c, fmd_i + fmd_add,
+                    mmd_i + mmd_add + first_in_mesh, imd_i + imd_add), 0
 
         slices = jnp.arange(m).reshape(-1, cfg.msg_chunk)
         new_frontier = jnp.zeros_like(frontier)
-        (have, deliver_tick, new_frontier, fmd_d, mmd_d), _ = jax.lax.scan(
+        (have, deliver_tick, new_frontier, fmd_d, mmd_d, imd_d), _ = jax.lax.scan(
             chunk_body, (have, deliver_tick, new_frontier,
                          jnp.zeros((n, t, k), jnp.float32),
+                         jnp.zeros((n, t, k), jnp.float32),
                          jnp.zeros((n, t, k), jnp.float32)), slices)
-        return (have, deliver_tick, new_frontier, fmd + fmd_d, mmd + mmd_d), 0
+        return (have, deliver_tick, new_frontier, fmd + fmd_d, mmd + mmd_d,
+                imd + imd_d), 0
 
     frontier0 = state.deliver_tick == state.tick     # published/just received
-    carry0 = (state.have, state.deliver_tick, frontier0,
-              jnp.zeros((n, t, k), jnp.float32), jnp.zeros((n, t, k), jnp.float32))
-    (have, deliver_tick, _, fmd_add, mmd_add), _ = jax.lax.scan(
+    z = jnp.zeros((n, t, k), jnp.float32)
+    carry0 = (state.have, state.deliver_tick, frontier0, z, z, z)
+    (have, deliver_tick, _, fmd_add, mmd_add, imd_add), _ = jax.lax.scan(
         hop, carry0, None, length=cfg.prop_substeps)
 
     delivered = jnp.sum(have) - jnp.sum(state.have)
     fmd = jnp.minimum(state.first_message_deliveries + fmd_add, caps[0])
     mmd = jnp.minimum(state.mesh_message_deliveries + mmd_add, caps[1])
+    imd = state.invalid_message_deliveries + imd_add
     state = state._replace(have=have, deliver_tick=deliver_tick,
                            first_message_deliveries=fmd,
                            mesh_message_deliveries=mmd,
+                           invalid_message_deliveries=imd,
                            delivered_total=state.delivered_total + delivered)
 
     # -- step 3: IHAVE/IWANT for next tick (gossipsub.go:1711-1775) --
-    # receiver view of gossip edges: slot s's peer gossips topic t to me
-    inc_gossip = edge_gather(gossip_sel, state)      # [N,T,K]
-    window = state.have & ((state.tick - state.deliver_tick) < cfg.history_gossip) \
-        & alive[None, :]                              # [N,M] sender gossip window
+    # receiver view of gossip edges: slot s's peer gossips topic t to me;
+    # ignore IHAVE from senders I score below the gossip threshold
+    inc_gossip = edge_gather(gossip_sel, state) & gossip_ok[:, None, :]
+    # sender gossip window = the mcache gossip slice: DELIVERED within the
+    # last history_gossip ticks (rejected messages never enter the mcache, so
+    # have-but-not-delivered is excluded)
+    age = state.tick - state.deliver_tick
+    window = (age >= 0) & (age < cfg.history_gossip) & alive[None, :]
+    # malicious peers advertise everything alive (IHAVE flood)
+    window = window | (state.malicious[:, None] & alive[None, :])
 
     def iwant_chunk(c, sl):
-        pend = c
+        pend, asked_ct = c                           # asked_ct: [N,K] iasked
         w_nbr = window[:, sl][nbr]                   # [N,K,Mc]
         eg = jnp.transpose(inc_gossip[:, t_m[sl], :], (0, 2, 1))  # [N,K,Mc]
-        offer = w_nbr & eg
+        # MaxIHaveLength flood protection, PER SENDING PEER: the iasked[p]
+        # budget caps ids asked from each advertiser within a heartbeat
+        # (gossipsub.go:654-676); an id advertised by a second peer with
+        # headroom is still pulled from that peer, so one flooder cannot
+        # starve honest pulls (headroom checked at chunk granularity)
+        headroom = (asked_ct < cfg.max_iwant_per_tick)[:, :, None]
+        offer = w_nbr & eg & headroom
         wanted = jnp.any(offer, axis=1) & ~state.have[:, sl]
         best_slot = jnp.argmax(offer, axis=1).astype(jnp.int32)   # lowest slot
-        pend = pend.at[:, sl].set(jnp.where(wanted, best_slot, -1))
-        return pend, 0
+        oh = jax.nn.one_hot(best_slot, k, dtype=jnp.int32) * \
+            wanted[..., None].astype(jnp.int32)      # [N,Mc,K]
+        before = asked_ct[:, None, :] + jnp.cumsum(oh, axis=1) - oh
+        within = jnp.sum(before * oh, axis=-1) < cfg.max_iwant_per_tick
+        take = wanted & within
+        pend = pend.at[:, sl].set(jnp.where(take, best_slot, -1))
+        asked_ct = asked_ct + jnp.sum(oh * take[..., None].astype(jnp.int32),
+                                      axis=1)
+        return (pend, asked_ct), 0
 
     slices = jnp.arange(m).reshape(-1, cfg.msg_chunk)
-    iwant_pending, _ = jax.lax.scan(iwant_chunk,
-                                    jnp.full((n, m), -1, jnp.int32), slices)
+    (iwant_pending, _), _ = jax.lax.scan(
+        iwant_chunk,
+        (jnp.full((n, m), -1, jnp.int32), jnp.zeros((n, k), jnp.int32)),
+        slices)
     return state._replace(iwant_pending=iwant_pending)

@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..sim.config import SimConfig, TopicParams
-from ..sim.state import SimState
+from ..sim.state import NEVER, SimState
 
 
 def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams) -> jnp.ndarray:
@@ -108,4 +108,4 @@ def apply_prune_penalty(state: SimState, pruned: jnp.ndarray,
     return state._replace(
         mesh_failure_penalty=state.mesh_failure_penalty + add,
         mesh_active=jnp.where(pruned, False, state.mesh_active),
-        graft_tick=jnp.where(pruned, jnp.int32(2**30), state.graft_tick))
+        graft_tick=jnp.where(pruned, NEVER, state.graft_tick))

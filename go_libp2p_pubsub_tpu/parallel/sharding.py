@@ -46,12 +46,14 @@ def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
         tick=(0, False), neighbors=(2, True), connected=(2, True),
         outbound=(2, True), reverse_slot=(2, True), subscribed=(2, True),
         direct=(2, True), ip_group=(1, True), app_score=(1, True),
+        malicious=(1, True),
         mesh=(3, True), fanout=(3, True), fanout_lastpub=(2, True),
         backoff=(3, True), graft_tick=(3, True), mesh_active=(3, True),
         first_message_deliveries=(3, True), mesh_message_deliveries=(3, True),
         mesh_failure_penalty=(3, True), invalid_message_deliveries=(3, True),
         behaviour_penalty=(2, True), msg_topic=(1, False),
-        msg_publish_tick=(1, False), have=(2, True), deliver_tick=(2, True),
+        msg_publish_tick=(1, False), msg_invalid=(1, False),
+        have=(2, True), deliver_tick=(2, True),
         iwant_pending=(2, True), delivered_total=(0, False),
     )
     assert set(layout) == set(SimState._fields), "layout drifted from SimState"

@@ -58,6 +58,10 @@ class SimConfig:
     opportunistic_graft_ticks: int = 60
     opportunistic_graft_peers: int = 2
     graft_flood_ticks: int = 10
+    # IHAVE flood protection (gossipsub.go:57-58, 654-676): cap on message
+    # ids a peer will IWANT per heartbeat (the ``iasked`` counter vs
+    # MaxIHaveLength; counters reset every tick, gossipsub.go:1608-1618)
+    max_iwant_per_tick: int = 5000
 
     # score thresholds (score_params.go:12-35)
     gossip_threshold: float = 0.0
@@ -102,6 +106,7 @@ class SimConfig:
             opportunistic_graft_ticks=int(p.opportunistic_graft_ticks),
             opportunistic_graft_peers=p.opportunistic_graft_peers,
             graft_flood_ticks=max(1, int(p.graft_flood_threshold / hb)),
+            max_iwant_per_tick=p.max_ihave_length,
             gossip_threshold=th.gossip_threshold,
             publish_threshold=th.publish_threshold,
             graylist_threshold=th.graylist_threshold,

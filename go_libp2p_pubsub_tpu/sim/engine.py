@@ -54,7 +54,7 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
     state = publish(state, cfg, peers, topics)
     state = decay_counters(state, cfg, tp)
     hb = heartbeat(state, cfg, tp, k_hb)
-    state = forward_tick(hb.state, cfg, tp, hb.gossip_sel, k_fwd)
+    state = forward_tick(hb.state, cfg, tp, hb.gossip_sel, hb.scores, k_fwd)
     return state._replace(tick=state.tick + 1)
 
 

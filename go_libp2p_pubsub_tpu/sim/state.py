@@ -39,6 +39,11 @@ class SimState(NamedTuple):
     direct: jnp.ndarray               # [N, K] bool (direct peers, gossipsub.go:425)
     ip_group: jnp.ndarray             # [N] int32 (P6 colocation groups)
     app_score: jnp.ndarray            # [N] float32 (P5 per-peer app score)
+    malicious: jnp.ndarray            # [N] bool: sybil/spam actors (the
+                                      #   gossipsub_spam_test.go adversary
+                                      #   roles as a peer attribute): publish
+                                      #   invalid messages, advertise the
+                                      #   whole window, never answer IWANTs
 
     # --- router state ---
     mesh: jnp.ndarray                 # [N, T, K] bool
@@ -58,6 +63,8 @@ class SimState(NamedTuple):
     # --- message window (rotating slots) ---
     msg_topic: jnp.ndarray            # [M] int32 topic of message slot, -1 idle
     msg_publish_tick: jnp.ndarray     # [M] int32
+    msg_invalid: jnp.ndarray          # [M] bool: fails validation (honest
+                                      #   receivers reject + count P4)
     have: jnp.ndarray                 # [N, M] bool (seen/validated)
     deliver_tick: jnp.ndarray         # [N, M] int32, NEVER if not delivered
     iwant_pending: jnp.ndarray        # [N, M] int32 source peer for pending
@@ -70,7 +77,8 @@ class SimState(NamedTuple):
 def init_state(cfg: SimConfig, topo: Topology,
                subscribed: np.ndarray | None = None,
                ip_group: np.ndarray | None = None,
-               app_score: np.ndarray | None = None) -> SimState:
+               app_score: np.ndarray | None = None,
+               malicious: np.ndarray | None = None) -> SimState:
     n, k, t, m = cfg.n_peers, cfg.k_slots, cfg.n_topics, cfg.msg_window
     if subscribed is None:
         subscribed = np.ones((n, t), dtype=bool)
@@ -89,6 +97,8 @@ def init_state(cfg: SimConfig, topo: Topology,
                              else np.zeros(n, np.int32)),
         app_score=jnp.asarray(app_score if app_score is not None
                               else np.zeros(n, np.float32)),
+        malicious=jnp.asarray(malicious if malicious is not None
+                              else np.zeros(n, bool)),
         mesh=b(n, t, k),
         fanout=b(n, t, k),
         fanout_lastpub=i32(n, t, fill=int(NEVER)),
@@ -102,6 +112,7 @@ def init_state(cfg: SimConfig, topo: Topology,
         behaviour_penalty=f32(n, k),
         msg_topic=i32(m, fill=-1),
         msg_publish_tick=i32(m, fill=int(NEVER)),
+        msg_invalid=b(m),
         have=b(n, m),
         deliver_tick=i32(n, m, fill=int(NEVER)),
         iwant_pending=i32(n, m, fill=-1),
