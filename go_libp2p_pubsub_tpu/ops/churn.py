@@ -1,0 +1,95 @@
+"""Connection churn: batched dead-peer / reconnect dynamics.
+
+Models the reference's connection lifecycle as per-edge state toggles over the
+fixed neighbor table:
+
+- **Edge down** = the stream-reader sentinel firing (comm.go:144-154
+  ``handlePeerDead``) followed by ``handleDeadPeers`` (pubsub.go:711-757):
+  the peer leaves every mesh and fanout it was in — router ``RemovePeer``
+  (gossipsub.go:575-596) — and its score enters the retention window
+  (score.go:611-644 ``RemovePeer`` with RetainScore): the P3 deficit is
+  converted to a sticky mesh-failure penalty exactly as on PRUNE, and the
+  counters are kept frozen until retention expires.
+- **Edge up** = a (re)connect notification (notify.go:11-75): the slot becomes
+  usable again. If the edge was down longer than ``retain_score_ticks``, the
+  per-slot score counters reset (the reference deletes ``peerStats`` after
+  retention, score.go:631-643); a faster reconnect sees its old score — this
+  is the reference's defence against whitewashing by reconnect.
+
+Symmetry: both directions of an edge go down/up together (a TCP stream dies
+for both ends), decided by the lower-id endpoint's random draw and mirrored
+through ``reverse_slot``.
+
+Churn is OFF unless ``SimConfig.churn_disconnect_prob > 0`` (a jit-static
+flag, so non-churn configs compile identical programs as before).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..sim.config import SimConfig, TopicParams
+from ..sim.state import NEVER, SimState
+from .score_ops import apply_prune_penalty
+
+
+def _symmetric_uniform(state: SimState, key: jax.Array) -> jnp.ndarray:
+    """[N, K] uniform draws equal on both directions of each edge: the draw of
+    the lower-id endpoint wins, gathered through reverse_slot."""
+    n, k = state.neighbors.shape
+    r = jax.random.uniform(key, (n, k))
+    nbr = jnp.clip(state.neighbors, 0, n - 1)
+    rk = jnp.clip(state.reverse_slot, 0, k - 1)
+    r_rev = r[nbr, rk]
+    mine_wins = jnp.arange(n)[:, None] < nbr
+    return jnp.where(mine_wins, r, r_rev)
+
+
+def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
+                key: jax.Array) -> SimState:
+    """One churn round: take down a random fraction of live edges, bring back
+    a random fraction of down edges, with RemovePeer/retention semantics."""
+    n, t, k = state.mesh.shape
+    kd, ku = jax.random.split(key)
+
+    known = state.neighbors >= 0
+    down = known & ~state.connected
+    live = known & state.connected
+
+    go_down = live & (_symmetric_uniform(state, kd) < cfg.churn_disconnect_prob)
+    come_up = down & (_symmetric_uniform(state, ku) < cfg.churn_reconnect_prob)
+
+    # --- RemovePeer on edges going down (gossipsub.go:575-596) ---
+    down3 = go_down[:, None, :]
+    removed_mesh = state.mesh & down3
+    state = apply_prune_penalty(state, removed_mesh, tp)
+    state = state._replace(
+        mesh=state.mesh & ~down3,
+        fanout=state.fanout & ~down3,
+        # a dead peer's pending gossip pulls never resolve; drop them rather
+        # than charging a broken promise (the reference cancels promises on
+        # peer removal, gossip_tracer.go:154-162)
+        iwant_pending=jnp.where(
+            go_down[jnp.arange(n)[:, None],
+                    jnp.clip(state.iwant_pending, 0, k - 1)]
+            & (state.iwant_pending >= 0),
+            -1, state.iwant_pending),
+        disconnect_tick=jnp.where(go_down, state.tick, state.disconnect_tick))
+
+    # --- reconnect: expire retention, then flip the edge up ---
+    down_age = state.tick - state.disconnect_tick
+    expired = come_up & (down_age > cfg.retain_score_ticks)
+    exp3 = expired[:, None, :]
+    z3 = jnp.zeros((n, t, k), jnp.float32)
+    state = state._replace(
+        first_message_deliveries=jnp.where(exp3, z3, state.first_message_deliveries),
+        mesh_message_deliveries=jnp.where(exp3, z3, state.mesh_message_deliveries),
+        mesh_failure_penalty=jnp.where(exp3, z3, state.mesh_failure_penalty),
+        invalid_message_deliveries=jnp.where(exp3, z3, state.invalid_message_deliveries),
+        behaviour_penalty=jnp.where(expired, 0.0, state.behaviour_penalty),
+        graft_tick=jnp.where(exp3, NEVER, state.graft_tick),
+        mesh_active=state.mesh_active & ~exp3,
+        connected=(state.connected & ~go_down) | come_up,
+        disconnect_tick=jnp.where(come_up, NEVER, state.disconnect_tick))
+    return state

@@ -87,6 +87,11 @@ class SimConfig:
 
     scoring_enabled: bool = True
 
+    # connection churn per tick (0.0 = off; ops/churn.py). Models the
+    # dead-peer / reconnect lifecycle (pubsub.go:711-757, notify.go:11-75).
+    churn_disconnect_prob: float = 0.0
+    churn_reconnect_prob: float = 0.0
+
     @staticmethod
     def from_params(n_peers: int, k_slots: int, n_topics: int = 1,
                     params: GossipSubParams | None = None,

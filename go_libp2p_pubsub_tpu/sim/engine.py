@@ -5,6 +5,7 @@ per node per second (gossipsub.go:1320-1343 heartbeat timer, score.go:408-445
 decay ticker, plus the continuous data plane):
 
     step: (state, key) -> state
+      0. churn              (optional) edge down/up round, RemovePeer semantics
       1. publish            P scenario-chosen messages enter the network
       2. decay_counters     refreshScores' decay pass (DecayInterval == tick)
       3. heartbeat          mesh maintenance + GRAFT/PRUNE exchange + gossip
@@ -24,6 +25,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..ops.churn import churn_edges
 from ..ops.heartbeat import heartbeat
 from ..ops.propagate import forward_tick, publish
 from ..ops.score_ops import decay_counters
@@ -49,7 +51,9 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
          key: jax.Array) -> SimState:
     if cfg.msg_window % cfg.msg_chunk != 0:
         raise ValueError("msg_window must be a multiple of msg_chunk")
-    k_pub, k_hb, k_fwd = jax.random.split(key, 3)
+    k_pub, k_hb, k_fwd, k_churn = jax.random.split(key, 4)
+    if cfg.churn_disconnect_prob > 0.0:
+        state = churn_edges(state, cfg, tp, k_churn)
     peers, topics = choose_publishers(state, cfg, k_pub)
     state = publish(state, cfg, peers, topics)
     state = decay_counters(state, cfg, tp)

@@ -1,0 +1,185 @@
+"""The five BASELINE.json benchmark scenarios as reproducible constructors.
+
+Each builder returns ``(cfg, tp, state)`` ready for ``engine.run``:
+
+1. ``single_topic_1k``   — 1k-peer single-topic gossipsub, default score
+   params (the gossipsub_test.go harness scale/semantics).
+2. ``beacon_10k``        — 10k peers, Ethereum beacon-chain-style topic set
+   (global topics everyone joins + attestation subnets joined by random
+   committees) with the published beacon scoring shape: capped positive
+   topic scores, heavy invalid/behaviour penalties.
+3. ``churn_50k``         — 50k peers, multi-topic, connection churn each tick
+   exercising backoff + retention + mesh self-healing (pubsub.go:711-757
+   dead-peer path, score.go:611-644 RetainScore).
+4. ``sybil_100k``        — 100k-peer mesh with 20% sybil attackers
+   (the gossipsub_spam_test.go adversary roles: invalid publishes, IHAVE
+   floods, unanswered IWANTs) under full scoring + colocation penalties.
+5. ``router_sweep_100k`` — same 100k network built for each router variant
+   (floodsub / randomsub / gossipsub) for the propagation-latency sweep.
+
+Seeds are fixed (314159, the reference's test seed —
+validation_builtin_test.go:25-27) so every scenario is deterministic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.params import TopicScoreParams
+from .config import SimConfig, TopicParams
+from .state import SimState, init_state
+from . import topology
+
+SEED = 314159
+
+
+def default_topic_params(n_topics: int = 1) -> TopicParams:
+    """The reference tests' canonical params shape (score_test.go style):
+    all P components active with mild weights."""
+    return TopicParams.from_topic_params([TopicScoreParams(
+        topic_weight=1.0, time_in_mesh_weight=0.01, time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=3600.0, first_message_deliveries_weight=1.0,
+        first_message_deliveries_decay=0.5, first_message_deliveries_cap=100.0,
+        mesh_message_deliveries_weight=-1.0, mesh_message_deliveries_decay=0.5,
+        mesh_message_deliveries_cap=100.0, mesh_message_deliveries_threshold=2.0,
+        mesh_message_deliveries_window=0.01, mesh_message_deliveries_activation=5.0,
+        mesh_failure_penalty_weight=-1.0, mesh_failure_penalty_decay=0.5,
+        invalid_message_deliveries_weight=-10.0, invalid_message_deliveries_decay=0.9,
+    )] * n_topics)
+
+
+def single_topic_1k(n_peers: int = 1024, k_slots: int = 32, degree: int = 12,
+                    ) -> tuple[SimConfig, TopicParams, SimState]:
+    """Config 1: the gossipsub_test.go harness at 1k scale."""
+    cfg = SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=1, msg_window=64,
+        msg_chunk=16, publishers_per_tick=8, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
+        publish_threshold=-200.0, graylist_threshold=-300.0)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    return cfg, default_topic_params(1), init_state(cfg, topo)
+
+
+# Beacon-chain-style topic roles: (name, joined-by-all, weight, invalid_w)
+# modeled on the published Eth2 gossip scoring shape — one heavy global block
+# topic, a global aggregate topic, and per-subnet attestation topics joined by
+# rotating committees. (Shape only; exact production constants are chain-
+# config dependent.)
+_BEACON_TOPICS = [
+    ("beacon_block", True, 0.5),
+    ("beacon_aggregate_and_proof", True, 0.5),
+    ("voluntary_exit", True, 0.05),
+    ("proposer_slashing", True, 0.05),
+    ("attester_slashing", True, 0.05),
+    ("beacon_attestation_0", False, 0.25),
+    ("beacon_attestation_1", False, 0.25),
+    ("beacon_attestation_2", False, 0.25),
+    ("beacon_attestation_3", False, 0.25),
+]
+
+
+def beacon_10k(n_peers: int = 10_000, k_slots: int = 48, degree: int = 16,
+               subnet_fraction: float = 0.15,
+               ) -> tuple[SimConfig, TopicParams, SimState]:
+    """Config 2: 10k peers over a beacon-style topic set with peer scoring."""
+    rng = np.random.default_rng(SEED)
+    t = len(_BEACON_TOPICS)
+    subscribed = np.zeros((n_peers, t), dtype=bool)
+    for i, (_, global_topic, _) in enumerate(_BEACON_TOPICS):
+        if global_topic:
+            subscribed[:, i] = True
+        else:
+            subscribed[:, i] = rng.random(n_peers) < subnet_fraction
+    tp = TopicParams.from_topic_params([TopicScoreParams(
+        topic_weight=w, time_in_mesh_weight=0.03, time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=300.0, first_message_deliveries_weight=1.0,
+        first_message_deliveries_decay=0.99, first_message_deliveries_cap=50.0,
+        mesh_message_deliveries_weight=-1.0, mesh_message_deliveries_decay=0.97,
+        mesh_message_deliveries_cap=100.0, mesh_message_deliveries_threshold=4.0,
+        mesh_message_deliveries_window=0.01, mesh_message_deliveries_activation=10.0,
+        mesh_failure_penalty_weight=-1.0, mesh_failure_penalty_decay=0.95,
+        invalid_message_deliveries_weight=-100.0, invalid_message_deliveries_decay=0.99,
+    ) for (_, _, w) in _BEACON_TOPICS])
+    cfg = SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=t, msg_window=64,
+        msg_chunk=16, publishers_per_tick=16, prop_substeps=8,
+        scoring_enabled=True, topic_score_cap=100.0,
+        behaviour_penalty_weight=-15.9, behaviour_penalty_threshold=6.0,
+        behaviour_penalty_decay=0.986, gossip_threshold=-4000.0,
+        publish_threshold=-8000.0, graylist_threshold=-16000.0)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    return cfg, tp, init_state(cfg, topo, subscribed=subscribed)
+
+
+def churn_50k(n_peers: int = 50_000, k_slots: int = 32, degree: int = 12,
+              n_topics: int = 4, disconnect_prob: float = 0.02,
+              reconnect_prob: float = 0.2,
+              ) -> tuple[SimConfig, TopicParams, SimState]:
+    """Config 3: 50k peers, multi-topic, per-tick connection churn."""
+    rng = np.random.default_rng(SEED)
+    subscribed = rng.random((n_peers, n_topics)) < 0.5
+    subscribed[~subscribed.any(axis=1), 0] = True
+    cfg = SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=n_topics, msg_window=64,
+        msg_chunk=16, publishers_per_tick=16, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
+        publish_threshold=-200.0, graylist_threshold=-300.0,
+        retain_score_ticks=30, churn_disconnect_prob=disconnect_prob,
+        churn_reconnect_prob=reconnect_prob)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    return cfg, default_topic_params(n_topics), \
+        init_state(cfg, topo, subscribed=subscribed)
+
+
+def sybil_100k(n_peers: int = 100_000, k_slots: int = 32, degree: int = 12,
+               sybil_fraction: float = 0.2, n_sybil_ips: int = 64,
+               ) -> tuple[SimConfig, TopicParams, SimState]:
+    """Config 4: 100k-peer mesh, 20% sybil attackers sharing few IPs.
+
+    Sybils publish invalid messages, advertise the whole window, and never
+    answer IWANTs (the gossipsub_spam_test.go actor set); scoring must
+    graylist them (P4 + P7 + P6 colocation)."""
+    rng = np.random.default_rng(SEED)
+    malicious = rng.random(n_peers) < sybil_fraction
+    # honest peers get unique ip groups; sybils share n_sybil_ips groups
+    ip_group = np.arange(n_peers, dtype=np.int32)
+    ip_group[malicious] = n_peers + (rng.integers(
+        0, n_sybil_ips, malicious.sum())).astype(np.int32)
+    # compact group ids
+    _, ip_group = np.unique(ip_group, return_inverse=True)
+    ip_group = ip_group.astype(np.int32)
+    cfg = SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=1, msg_window=32,
+        msg_chunk=16, publishers_per_tick=8, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        behaviour_penalty_threshold=2.0, behaviour_penalty_decay=0.99,
+        ip_colocation_factor_weight=-50.0, ip_colocation_factor_threshold=4,
+        n_ip_groups=int(ip_group.max()) + 1,
+        gossip_threshold=-10.0, publish_threshold=-50.0,
+        graylist_threshold=-100.0)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    return cfg, default_topic_params(1), \
+        init_state(cfg, topo, malicious=malicious, ip_group=ip_group)
+
+
+def router_sweep_100k(router: str, n_peers: int = 100_000, k_slots: int = 32,
+                      degree: int = 12,
+                      ) -> tuple[SimConfig, TopicParams, SimState]:
+    """Config 5: one 100k network per router variant, scoring off (floodsub
+    and randomsub have no scoring; comparison isolates propagation)."""
+    cfg = SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=1, msg_window=32,
+        msg_chunk=16, publishers_per_tick=4, prop_substeps=8,
+        router=router, scoring_enabled=False)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    return cfg, TopicParams.disabled(1), init_state(cfg, topo)
+
+
+SCENARIOS = {
+    "1k_single_topic": single_topic_1k,
+    "10k_beacon": beacon_10k,
+    "50k_churn": churn_50k,
+    "100k_sybil": sybil_100k,
+}

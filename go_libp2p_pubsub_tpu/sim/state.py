@@ -36,6 +36,9 @@ class SimState(NamedTuple):
     outbound: jnp.ndarray             # [N, K] bool
     reverse_slot: jnp.ndarray         # [N, K] int32
     subscribed: jnp.ndarray           # [N, T] bool
+    disconnect_tick: jnp.ndarray      # [N, K] int32 tick the edge went down,
+                                      #   NEVER if up/never-connected; drives
+                                      #   RetainScore expiry (score.go:611-644)
     direct: jnp.ndarray               # [N, K] bool (direct peers, gossipsub.go:425)
     ip_group: jnp.ndarray             # [N] int32 (P6 colocation groups)
     app_score: jnp.ndarray            # [N] float32 (P5 per-peer app score)
@@ -92,6 +95,7 @@ def init_state(cfg: SimConfig, topo: Topology,
         outbound=jnp.asarray(topo.outbound),
         reverse_slot=jnp.asarray(topo.reverse_slot),
         subscribed=jnp.asarray(subscribed),
+        disconnect_tick=i32(n, k, fill=int(NEVER)),
         direct=b(n, k),
         ip_group=jnp.asarray(ip_group if ip_group is not None
                              else np.zeros(n, np.int32)),

@@ -1,0 +1,135 @@
+"""Connection churn + benchmark scenario tests.
+
+Churn models the reference's dead-peer path (pubsub.go:711-757) and score
+retention (score.go:611-644 RemovePeer/RetainScore); scenarios are the
+BASELINE.md benchmark configs at toy scale.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu.ops.churn import churn_edges
+from go_libp2p_pubsub_tpu.sim import (
+    SimConfig, TopicParams, delivery_fraction, init_state, mesh_degrees, run,
+    topology,
+)
+from go_libp2p_pubsub_tpu.sim import scenarios
+from go_libp2p_pubsub_tpu.sim.state import NEVER
+
+
+def cfg_with_churn(**kw):
+    base = dict(n_peers=64, k_slots=16, n_topics=1, msg_window=32, msg_chunk=8,
+                publishers_per_tick=2, prop_substeps=6,
+                churn_disconnect_prob=0.5, churn_reconnect_prob=0.5,
+                retain_score_ticks=5)
+    base.update(kw)
+    return SimConfig(**base)
+
+
+class TestChurnEdges:
+    def _setup(self, **kw):
+        cfg = cfg_with_churn(**kw)
+        topo = topology.dense(cfg.n_peers, cfg.k_slots, degree=10)
+        tp = TopicParams.disabled(cfg.n_topics)
+        st = init_state(cfg, topo)
+        return cfg, tp, st
+
+    def test_symmetric_disconnect(self):
+        cfg, tp, st = self._setup()
+        st2 = churn_edges(st, cfg, tp, jax.random.PRNGKey(1))
+        conn = np.asarray(st2.connected)
+        nbr = np.asarray(st2.neighbors)
+        rs = np.asarray(st2.reverse_slot)
+        n, k = nbr.shape
+        for i in range(n):
+            for s in range(k):
+                if nbr[i, s] >= 0 and rs[i, s] >= 0:
+                    assert conn[i, s] == conn[nbr[i, s], rs[i, s]]
+        # with p=0.5 a good fraction actually went down
+        known = nbr >= 0
+        assert conn[known].mean() < 0.9
+
+    def test_down_edges_leave_mesh_and_stamp_tick(self):
+        cfg, tp, st = self._setup()
+        # put every connected edge in the mesh first
+        st = st._replace(mesh=st.connected[:, None, :] & st.subscribed[:, :, None],
+                         tick=jnp.int32(7))
+        st2 = churn_edges(st, cfg, tp, jax.random.PRNGKey(2))
+        went_down = np.asarray(st.connected & ~st2.connected)
+        assert went_down.any()
+        mesh2 = np.asarray(st2.mesh)
+        assert not (mesh2 & went_down[:, None, :]).any()
+        dt = np.asarray(st2.disconnect_tick)
+        assert (dt[went_down] == 7).all()
+        assert (dt[np.asarray(st2.connected)] == int(NEVER)).all()
+
+    def test_retention_expiry_resets_counters(self):
+        cfg, tp, st = self._setup(churn_disconnect_prob=0.0,
+                                  churn_reconnect_prob=1.0)
+        # edge (0, slot 0) went down at tick 0; counters carry score history
+        connected = st.connected.at[0, 0].set(False)
+        j = int(st.neighbors[0, 0]); rs = int(st.reverse_slot[0, 0])
+        connected = connected.at[j, rs].set(False)
+        fmd = st.first_message_deliveries.at[0, 0, 0].set(9.0)
+        dtick = st.disconnect_tick.at[0, 0].set(0).at[j, rs].set(0)
+        base = st._replace(connected=connected, first_message_deliveries=fmd,
+                           disconnect_tick=dtick)
+
+        # reconnect BEFORE retention expiry (tick 3 <= retain 5): score kept
+        early = churn_edges(base._replace(tick=jnp.int32(3)), cfg, tp,
+                            jax.random.PRNGKey(3))
+        assert bool(early.connected[0, 0])
+        assert float(early.first_message_deliveries[0, 0, 0]) == 9.0
+        assert int(early.disconnect_tick[0, 0]) == int(NEVER)
+
+        # reconnect AFTER expiry (tick 50 > 5): counters reset
+        late = churn_edges(base._replace(tick=jnp.int32(50)), cfg, tp,
+                           jax.random.PRNGKey(3))
+        assert bool(late.connected[0, 0])
+        assert float(late.first_message_deliveries[0, 0, 0]) == 0.0
+
+    def test_mesh_self_heals_under_churn(self):
+        # gossipsub_test.go TestReconnects analogue: the network keeps
+        # delivering while edges flap
+        cfg = cfg_with_churn(churn_disconnect_prob=0.05,
+                             churn_reconnect_prob=0.5)
+        topo = topology.dense(cfg.n_peers, cfg.k_slots, degree=10)
+        tp = scenarios.default_topic_params(1)
+        st = init_state(cfg, topo)
+        st = run(st, cfg, tp, jax.random.PRNGKey(0), 40)
+        deg = np.asarray(mesh_degrees(st))
+        assert deg.mean() > 2.0
+        assert float(delivery_fraction(st, cfg)) > 0.9
+
+
+class TestScenarios:
+    def test_all_build_and_run(self):
+        for name, builder in scenarios.SCENARIOS.items():
+            cfg, tp, st = builder(n_peers=96, k_slots=16, degree=6)
+            st = run(st, cfg, tp, jax.random.PRNGKey(0), 8)
+            assert int(st.tick) == 8, name
+            assert float(delivery_fraction(st, cfg)) > 0.5, name
+
+    def test_router_sweep_builds(self):
+        for r in ("floodsub", "randomsub", "gossipsub"):
+            cfg, tp, st = scenarios.router_sweep_100k(r, n_peers=96,
+                                                      k_slots=16, degree=6)
+            st = run(st, cfg, tp, jax.random.PRNGKey(0), 6)
+            assert float(delivery_fraction(st, cfg)) > 0.9, r
+
+    def test_sybil_scenario_graylists_attackers(self):
+        # the spam-test end state: honest observers score sybil neighbors
+        # negative (P4 invalid deliveries + P7 broken promises + P6 colocation)
+        from go_libp2p_pubsub_tpu.ops.score_ops import compute_scores
+        cfg, tp, st = scenarios.sybil_100k(n_peers=128, k_slots=16, degree=8,
+                                           sybil_fraction=0.25, n_sybil_ips=2)
+        st = run(st, cfg, tp, jax.random.PRNGKey(0), 30)
+        scores = np.asarray(compute_scores(st, cfg, tp))
+        nbr = np.asarray(jnp.clip(st.neighbors, 0, cfg.n_peers - 1))
+        mal = np.asarray(st.malicious)
+        honest_obs = ~mal
+        edge_to_sybil = mal[nbr] & np.asarray(st.connected) & honest_obs[:, None]
+        edge_to_honest = ~mal[nbr] & np.asarray(st.connected) & honest_obs[:, None]
+        assert scores[edge_to_sybil].mean() < scores[edge_to_honest].mean()
+        assert scores[edge_to_sybil].mean() < 0
