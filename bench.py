@@ -30,7 +30,7 @@ def main() -> None:
     from go_libp2p_pubsub_tpu.sim.engine import run
 
     cfg, tp, st = _build(n_peers=n, k_slots=32, degree=12, msg_window=64,
-                         msg_chunk=16, publishers=8)
+                         publishers=8)
     key = jax.random.PRNGKey(0)
 
     # warmup with the SAME n_ticks (static jit arg): compiles the measured

@@ -1,0 +1,85 @@
+"""Bit-packed message-window primitives.
+
+The data plane packs the M-slot message window into ceil(M/32) uint32 lanes
+per peer, so frontier propagation and delivery attribution are bitwise
+OR/AND/popcount passes over [N, W] / [N, K, W] words instead of [N, K, M]
+float temporaries. This is what makes 100k-peer ticks HBM-feasible: a full
+forwarding hop touches ~N*K*W words (megabytes) rather than N*K*M floats
+(gigabytes). See SURVEY.md §7 "Kernels" — the frontier scatter over mesh
+edges — and BASELINE.md's heartbeats/sec target.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+U32 = jnp.uint32
+popcount = jax.lax.population_count
+
+
+def n_words(m: int) -> int:
+    return (m + 31) // 32
+
+
+def pack_bool(x: jnp.ndarray) -> jnp.ndarray:
+    """bool [..., M] -> uint32 [..., ceil(M/32)] (little-endian bit order)."""
+    *lead, m = x.shape
+    w = n_words(m)
+    pad = w * 32 - m
+    if pad:
+        x = jnp.concatenate(
+            [x, jnp.zeros((*lead, pad), x.dtype)], axis=-1)
+    xr = x.reshape(*lead, w, 32).astype(U32)
+    shifts = U32(1) << jnp.arange(32, dtype=U32)
+    return jnp.sum(xr * shifts, axis=-1, dtype=U32)
+
+
+def pack_words(x: jnp.ndarray) -> jnp.ndarray:
+    """bool [N, M] -> uint32 [W, N] (word-major, peer-minor).
+
+    The peer axis stays minor so packed arrays tile the TPU's (8, 128)
+    vector-lane layout with no padding waste — a [N, K, W] array with W=2
+    minor would be padded 64x on the lane dimension.
+    """
+    n, m = x.shape
+    w = n_words(m)
+    pad = w * 32 - m
+    xt = x.T                                        # [M, N]
+    if pad:
+        xt = jnp.concatenate([xt, jnp.zeros((pad, n), x.dtype)], axis=0)
+    xr = xt.reshape(w, 32, n).astype(U32)
+    shifts = (U32(1) << jnp.arange(32, dtype=U32))[None, :, None]
+    return jnp.sum(xr * shifts, axis=1, dtype=U32)
+
+
+def unpack_words(p: jnp.ndarray, m: int) -> jnp.ndarray:
+    """uint32 [W, ...] -> bool [..., m] (inverse of pack_words)."""
+    w, *rest = p.shape
+    bits = (p[:, None] >> jnp.arange(32, dtype=U32)[None, :].reshape(
+        (1, 32) + (1,) * len(rest))) & U32(1)
+    flat = bits.reshape((w * 32,) + tuple(rest))[:m]
+    return jnp.moveaxis(flat, 0, -1).astype(bool)
+
+
+def reduce_or(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Bitwise-OR reduction along ``axis``."""
+    return jax.lax.reduce(x, U32(0), jnp.bitwise_or, (axis,))
+
+
+def exclusive_prefix_or(x: jnp.ndarray, axis: int) -> jnp.ndarray:
+    """Exclusive running OR along ``axis`` (first element -> 0).
+
+    Used for lowest-slot first-sender attribution: slot k is the first
+    sender of a message bit iff it offers the bit and no slot < k does.
+    """
+    incl = jax.lax.associative_scan(jnp.bitwise_or, x, axis=axis)
+    zero = jnp.zeros_like(jax.lax.slice_in_dim(x, 0, 1, axis=axis))
+    return jnp.concatenate(
+        [zero, jax.lax.slice_in_dim(incl, 0, x.shape[axis] - 1, axis=axis)],
+        axis=axis)
+
+
+def popcount_sum(x: jnp.ndarray, axis: int = -1, dtype=jnp.float32) -> jnp.ndarray:
+    """Total set bits summed over the word axis."""
+    return jnp.sum(popcount(x).astype(dtype), axis=axis)

@@ -29,9 +29,15 @@ expansion over the padded adjacency:
   entire live window, never answer IWANTs, and accept/forward anything —
   the gossipsub_spam_test.go actor behaviors as peer attributes.
 
-Memory: all [N, K, M] temporaries are chunked over M (``msg_chunk``), and
-per-(topic)-scatters are one-hot matmuls over the small T axis (MXU-friendly,
-no scatter in the hot loop).
+Memory/layout: the message window lives in uint32 bitmask words in
+**word-major, peer-minor** layout ([W, N] and [W, K, N]; ops/bits.py), so a
+forwarding hop is W per-word neighbor gathers plus a handful of bitwise
+passes that tile the TPU vector lanes with zero padding waste. Per-slot
+score attribution happens once per tick on OR-accumulated event sets, which
+is exact because each (receiver, message) first-delivery and each
+(receiver, sender, message) duplicate occurs at most once per tick
+(frontier semantics: a peer forwards a message the hop after it first
+receives it).
 """
 
 from __future__ import annotations
@@ -41,6 +47,16 @@ import jax.numpy as jnp
 
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
+from .bits import (
+    U32,
+    exclusive_prefix_or,
+    n_words,
+    pack_bool,
+    pack_words,
+    popcount_sum,
+    reduce_or,
+    unpack_words,
+)
 from .heartbeat import edge_gather
 
 
@@ -107,6 +123,65 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.n
     raise ValueError(f"unknown router {cfg.router!r}")
 
 
+def _gather_words(x_w: jnp.ndarray, nbr_t: jnp.ndarray) -> jnp.ndarray:
+    """out[w, k, n] = x_w[w, nbr_t[k, n]] — per-word 1D neighbor gather.
+
+    The per-word form keeps both the table ([N] u32) and the result
+    peer-minor; a [N, K, W] row gather would materialize a 64x lane-padded
+    intermediate on TPU.
+    """
+    return jnp.stack([x_w[i][nbr_t] for i in range(x_w.shape[0])])
+
+
+def _edge_topic_bits(mask_ntk: jnp.ndarray, topic_bits: jnp.ndarray,
+                     w: int) -> jnp.ndarray:
+    """Expand a per-(peer, topic, slot) edge mask into packed per-edge message
+    words: out[w,k,n] = OR over topics t with mask[n,t,k] of topic_bits[t,w].
+
+    Topic message sets are disjoint, so OR == sum; T is small and static.
+    """
+    n, t, k = mask_ntk.shape
+    acc = jnp.zeros((w, k, n), U32)
+    for ti in range(t):
+        acc = acc | jnp.where(mask_ntk[:, ti, :].T[None, :, :],
+                              topic_bits[ti][:, None, None], U32(0))
+    return acc
+
+
+def _slot_bitplanes(pend: jnp.ndarray, k: int) -> jnp.ndarray:
+    """iwant_pending [N, M] (slot id or -1) -> packed per-slot ask sets
+    [W, K, N]: bit m of out[:, s, n] iff pend[n, m] == s.
+
+    Encoded via ceil(log2 K) packed bit-planes of the slot index, so no
+    [N, K, M] temporary is materialized.
+    """
+    n, m = pend.shape
+    nbits = max(1, (k - 1).bit_length())
+    valid = pack_words(pend >= 0)                              # [W, N]
+    planes = [pack_words((pend > -1) & (((pend >> b) & 1) == 1))
+              for b in range(nbits)]                           # each [W, N]
+    out = jnp.broadcast_to(valid[:, None, :], (valid.shape[0], k, n))
+    for b in range(nbits):
+        kbit = ((jnp.arange(k) >> b) & 1).astype(bool)[None, :, None]
+        match = jnp.where(kbit, planes[b][:, None, :], ~planes[b][:, None, :])
+        out = out & match
+    return out
+
+
+def _bits_to_slot(chosen: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Packed disjoint per-slot sets [W, K, N] -> [N, M] slot id or -1
+    (inverse of _slot_bitplanes), again via bit-planes."""
+    w, k, n = chosen.shape
+    nbits = max(1, (k - 1).bit_length())
+    any_bits = reduce_or(chosen, axis=1)                       # [W, N]
+    slot = jnp.zeros((n, m), jnp.int32)
+    for b in range(nbits):
+        kbit = ((jnp.arange(k) >> b) & 1).astype(U32)[None, :, None]
+        plane = reduce_or(chosen * kbit, axis=1)               # [W, N]
+        slot = slot + (unpack_words(plane, m).astype(jnp.int32) << b)
+    return jnp.where(unpack_words(any_bits, m), slot, -1)
+
+
 def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                  gossip_sel: jnp.ndarray, scores: jnp.ndarray,
                  key: jax.Array) -> SimState:
@@ -118,15 +193,30 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     """
     n, t, k = state.mesh.shape
     m = cfg.msg_window
-    nbr = jnp.clip(state.neighbors, 0, n - 1)
-    # [M] slot holds a live message: published (tick < NEVER, so the age is
-    # non-negative) within the mcache history window
+    w = n_words(m)
+    nbr_t = jnp.clip(state.neighbors, 0, n - 1).T              # [K, N]
+    mal = state.malicious
+
+    # --- per-tick packed masks ---
     age_pub = state.tick - state.msg_publish_tick
-    alive = (age_pub >= 0) & (age_pub < cfg.history_length)
-    t_m = jnp.clip(state.msg_topic, 0, t - 1)                           # [M]
-    onehot_t = jax.nn.one_hot(t_m, t, dtype=jnp.float32) * \
-        (state.msg_topic >= 0)[:, None]                                  # [M,T]
-    mal_recv = state.malicious[:, None]                                  # [N,1]
+    alive = (age_pub >= 0) & (age_pub < cfg.history_length)             # [M]
+    t_m = jnp.clip(state.msg_topic, 0, t - 1)
+    live_topic = (state.msg_topic >= 0) & alive
+    # [T, W]: per-topic live message sets (disjoint across topics)
+    topic_bits = pack_bool((t_m[None, :] == jnp.arange(t)[:, None])
+                           & live_topic[None, :])
+    alive_bits = pack_bool(alive[None, :])[0]                           # [W]
+    invalid_bits = pack_bool((state.msg_invalid & alive)[None, :])[0]
+    valid_msg_bits = alive_bits & ~invalid_bits
+    # per-receiver acceptance: honest peers reject invalid messages
+    # (validation.go:293-370); malicious receivers accept + forward anything
+    vm = jnp.where(mal[None, :], alive_bits[:, None],
+                   valid_msg_bits[:, None])                             # [W,N]
+
+    have_bits = pack_words(state.have)                                  # [W,N]
+    dlv_bits = pack_words(state.deliver_tick < NEVER)                   # [W,N]
+    dlv_start = dlv_bits
+    n_have_start = popcount_sum(have_bits, axis=(0, 1))
 
     if cfg.scoring_enabled:
         accept_ok = scores >= cfg.graylist_threshold      # [N,K] AcceptFrom
@@ -135,116 +225,96 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         accept_ok = jnp.ones((n, k), bool)
         gossip_ok = jnp.ones((n, k), bool)
 
-    fwd_mask = _edge_forward_mask(state, cfg, key)   # [N,T,K] receiver view
-    fwd_mask = fwd_mask & accept_ok[:, None, :]
-    my_mesh = state.mesh                             # [N,T,K] my own mesh view
-    caps = tp.first_message_deliveries_cap[None, :, None], \
-        tp.mesh_message_deliveries_cap[None, :, None]
+    fmd_add = jnp.zeros((n, t, k), jnp.float32)
+    mmd_add = jnp.zeros((n, t, k), jnp.float32)
+    imd_add = jnp.zeros((n, t, k), jnp.float32)
 
     # -- step 1: resolve pending IWANTs from last tick (gossipsub.go:698-739:
     # the sender answers from its mcache; delivery counts as a first delivery
     # from a non-mesh peer) --
-    pend = state.iwant_pending                       # [N,M] slot or -1
-    # pend indexes slots per (peer, message); gather sender peer ids:
-    src = nbr[jnp.arange(n)[:, None], jnp.clip(pend, 0, k - 1)]       # [N,M]
+    asked_k = _slot_bitplanes(state.iwant_pending, k) & alive_bits[:, None, None]
     # malicious sources never answer IWANTs (the iwantEverything-style actor
     # holds its promises open, gossipsub_spam_test.go:23-133); honest sources
     # answer from their mcache, which rejected messages never enter
     # (deliver_tick stays NEVER on rejection — validation.go:293-370)
-    src_answers = (state.deliver_tick[src, jnp.arange(m)[None, :]] < NEVER) \
-        & ~state.malicious[src]
-    asked = (pend >= 0) & alive[None, :]
-    # pulls cannot yield invalid messages: honest mcaches never contain them
-    # (rejected messages are not delivered) and malicious sources never answer
-    got = asked & src_answers & ~state.have
-    broken = asked & ~src_answers
-    have = state.have | got
-    deliver_tick = jnp.where(got, state.tick, state.deliver_tick)
-    # per-slot attribution via one-hot matmuls
-    slot_onehot = jax.nn.one_hot(jnp.clip(pend, 0, k - 1), k, dtype=jnp.float32)
-    fmd_add = jnp.einsum("nm,mt,nmk->ntk", got.astype(jnp.float32), onehot_t, slot_onehot)
-    fmd = jnp.minimum(state.first_message_deliveries + fmd_add, caps[0])
+    answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)             # [W,N]
+    answers_k = _gather_words(answer_bits, nbr_t)                       # [W,K,N]
+    got_k = asked_k & answers_k & ~have_bits[:, None, :]
+    broken_k = asked_k & ~answers_k
+    got_any = reduce_or(got_k, axis=1)                                  # [W,N]
+    # pulls cannot yield invalid messages (see above), so they are deliveries
+    for ti in range(t):
+        fmd_add = fmd_add.at[:, ti, :].add(
+            popcount_sum(got_k & topic_bits[ti][:, None, None], axis=0).T)
     # broken promises: one penalty point per unfulfilled message id
     # (gossip_tracer.go:79-115, applied gossipsub.go:1620-1625)
-    broken_per_slot = jnp.einsum("nm,nmk->nk", broken.astype(jnp.float32), slot_onehot)
-    state = state._replace(
-        have=have, deliver_tick=deliver_tick,
-        first_message_deliveries=fmd,
-        behaviour_penalty=state.behaviour_penalty + broken_per_slot,
-        iwant_pending=jnp.full_like(pend, -1),
-        delivered_total=state.delivered_total + jnp.sum(got))
+    behaviour_penalty = state.behaviour_penalty + \
+        popcount_sum(broken_k, axis=0).T
+    have_bits = have_bits | got_any
+    dlv_bits = dlv_bits | got_any
 
-    # -- step 2: eager forwarding, prop_substeps hops, chunked over messages --
-    invalid_m = state.msg_invalid                    # [M]
+    # -- step 2: eager forwarding, prop_substeps hops, fully bit-packed --
+    fwd_mask = _edge_forward_mask(state, cfg, key)
+    fwd_mask = fwd_mask & accept_ok[:, None, :]
+    allowed = _edge_topic_bits(fwd_mask, topic_bits, w)                 # [W,K,N]
+    mesh_eb = _edge_topic_bits(state.mesh, topic_bits, w)               # [W,K,N]
 
-    def hop(carry, _):
-        have, deliver_tick, frontier, fmd, mmd, imd = carry
+    # frontier: messages that entered this peer THIS tick (fresh publishes and
+    # IWANT pulls above); peers forward a message exactly one hop after they
+    # first receive it, so the per-tick event sets below are disjoint across
+    # hops and OR-accumulation counts each event exactly once
+    frontier = pack_words(state.deliver_tick == state.tick) | got_any   # [W,N]
+    nv_acc = jnp.zeros((w, k, n), U32)     # first-delivery events, per slot
+    ni_acc = jnp.zeros((w, k, n), U32)     # invalid-delivery events, per slot
+    dup_acc = jnp.zeros((w, k, n), U32)    # mesh-duplicate events, per slot
 
-        def chunk_body(c0, sl):
-            have_c, dt_c, fr_c, fmd_i, mmd_i, imd_i = c0
-            msl = sl  # [Mc] message indices
-            fr_nbr = frontier[:, msl][nbr]            # [N,K,Mc] sender frontier
-            # edge forward mask for each chunk message's topic:
-            em = jnp.transpose(fwd_mask[:, t_m[msl], :], (0, 2, 1))  # [N,K,Mc]
-            senders = fr_nbr & em & alive[msl][None, None, :]
-            recv = jnp.any(senders, axis=1)           # [N,Mc]
-            had = have_c[:, msl]
-            new = recv & ~had
-            # honest receivers reject invalid messages: seen but not
-            # delivered/forwarded; P4 charged to the delivering slot
-            new_invalid = new & invalid_m[msl][None, :] & ~mal_recv
-            new_valid = new & ~new_invalid
-            # first-sender attribution: lowest active slot
-            first_slot = jnp.argmax(senders, axis=1)  # [N,Mc]
-            slot_oh = jax.nn.one_hot(first_slot, k, dtype=jnp.float32)
-            new_f = new_valid.astype(jnp.float32)
-            fmd_add = jnp.einsum("nm,mt,nmk->ntk", new_f, onehot_t[msl], slot_oh)
-            imd_add = jnp.einsum("nm,mt,nmk->ntk",
-                                 new_invalid.astype(jnp.float32),
-                                 onehot_t[msl], slot_oh)
-            # mesh-delivery credit: first delivery from a peer in MY mesh
-            # (score.go:938-947), plus same-window duplicates from mesh
-            # members (score.go:949-981; window < 1 tick -> same tick)
-            in_my_mesh = jnp.transpose(my_mesh[:, t_m[msl], :], (0, 2, 1))  # [N,K,Mc]
-            dup = senders & (had | new_valid)[:, None, :] & in_my_mesh & \
-                ~invalid_m[msl][None, None, :]
-            # exclude the first-delivery slot from dup, count it via new_f
-            dup = dup & ~(slot_oh.transpose(0, 2, 1).astype(bool) & new_valid[:, None, :])
-            mmd_add = jnp.einsum("nkm,mt->ntk", dup.astype(jnp.float32), onehot_t[msl])
-            first_in_mesh = jnp.einsum(
-                "nm,mt,nmk->ntk", new_f, onehot_t[msl],
-                slot_oh * jnp.transpose(in_my_mesh, (0, 2, 1)))
-            have_c = have_c.at[:, msl].set(had | recv)
-            dt_c = dt_c.at[:, msl].set(jnp.where(new_valid, state.tick, dt_c[:, msl]))
-            fr_c = fr_c.at[:, msl].set(new_valid)
-            return (have_c, dt_c, fr_c, fmd_i + fmd_add,
-                    mmd_i + mmd_add + first_in_mesh, imd_i + imd_add), 0
+    for _hop in range(cfg.prop_substeps):
+        offered = _gather_words(frontier, nbr_t) & allowed              # [W,K,N]
+        excl = exclusive_prefix_or(offered, axis=1)
+        new_from_k = offered & ~excl & ~have_bits[:, None, :]
+        new_any = (excl[:, -1] | offered[:, -1]) & ~have_bits           # [W,N]
+        new_valid = new_any & vm
+        nv_acc = nv_acc | (new_from_k & vm[:, None, :])
+        ni_acc = ni_acc | (new_from_k & ~vm[:, None, :])
+        # mesh-delivery credit: any mesh sender of a message I (now) hold
+        # valid — covers first-in-mesh (score.go:938-947) and same-window
+        # duplicates (score.go:949-981; window < 1 tick -> same tick).
+        # Invalid messages never earn MMD, including for malicious
+        # receivers who "deliver" them: an adversary's own counters about
+        # its neighbors are never consulted by honest-peer defenses, and
+        # the reference's spam actors run no scoring at all
+        # (gossipsub_spam_test.go drives raw streams)
+        elig = (dlv_bits | new_valid) & valid_msg_bits[:, None]
+        dup_acc = dup_acc | (offered & mesh_eb & elig[:, None, :])
+        have_bits = have_bits | new_any
+        dlv_bits = dlv_bits | new_valid
+        frontier = new_valid
 
-        slices = jnp.arange(m).reshape(-1, cfg.msg_chunk)
-        new_frontier = jnp.zeros_like(frontier)
-        (have, deliver_tick, new_frontier, fmd_d, mmd_d, imd_d), _ = jax.lax.scan(
-            chunk_body, (have, deliver_tick, new_frontier,
-                         jnp.zeros((n, t, k), jnp.float32),
-                         jnp.zeros((n, t, k), jnp.float32),
-                         jnp.zeros((n, t, k), jnp.float32)), slices)
-        return (have, deliver_tick, new_frontier, fmd + fmd_d, mmd + mmd_d,
-                imd + imd_d), 0
+    for ti in range(t):
+        tb = topic_bits[ti][:, None, None]
+        fmd_add = fmd_add.at[:, ti, :].add(popcount_sum(nv_acc & tb, axis=0).T)
+        imd_add = imd_add.at[:, ti, :].add(popcount_sum(ni_acc & tb, axis=0).T)
+        mmd_add = mmd_add.at[:, ti, :].add(popcount_sum(dup_acc & tb, axis=0).T)
 
-    frontier0 = state.deliver_tick == state.tick     # published/just received
-    z = jnp.zeros((n, t, k), jnp.float32)
-    carry0 = (state.have, state.deliver_tick, frontier0, z, z, z)
-    (have, deliver_tick, _, fmd_add, mmd_add, imd_add), _ = jax.lax.scan(
-        hop, carry0, None, length=cfg.prop_substeps)
-
-    delivered = jnp.sum(have) - jnp.sum(state.have)
+    caps = tp.first_message_deliveries_cap[None, :, None], \
+        tp.mesh_message_deliveries_cap[None, :, None]
     fmd = jnp.minimum(state.first_message_deliveries + fmd_add, caps[0])
     mmd = jnp.minimum(state.mesh_message_deliveries + mmd_add, caps[1])
     imd = state.invalid_message_deliveries + imd_add
-    state = state._replace(have=have, deliver_tick=deliver_tick,
-                           first_message_deliveries=fmd,
-                           mesh_message_deliveries=mmd,
-                           invalid_message_deliveries=imd,
-                           delivered_total=state.delivered_total + delivered)
+
+    newly_dlv = dlv_bits & ~dlv_start
+    have = unpack_words(have_bits, m)
+    deliver_tick = jnp.where(unpack_words(newly_dlv, m), state.tick,
+                             state.deliver_tick)
+    delivered = popcount_sum(have_bits, axis=(0, 1)) - n_have_start
+
+    state = state._replace(
+        have=have, deliver_tick=deliver_tick,
+        first_message_deliveries=fmd,
+        mesh_message_deliveries=mmd,
+        invalid_message_deliveries=imd,
+        behaviour_penalty=behaviour_penalty,
+        delivered_total=state.delivered_total + delivered)
 
     # -- step 3: IHAVE/IWANT for next tick (gossipsub.go:1711-1775) --
     # receiver view of gossip edges: slot s's peer gossips topic t to me;
@@ -254,36 +324,38 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # last history_gossip ticks (rejected messages never enter the mcache, so
     # have-but-not-delivered is excluded)
     age = state.tick - state.deliver_tick
-    window = (age >= 0) & (age < cfg.history_gossip) & alive[None, :]
+    window_bits = pack_words((age >= 0) & (age < cfg.history_gossip)) \
+        & alive_bits[:, None]
     # malicious peers advertise everything alive (IHAVE flood)
-    window = window | (state.malicious[:, None] & alive[None, :])
-
-    def iwant_chunk(c, sl):
-        pend, asked_ct = c                           # asked_ct: [N,K] iasked
-        w_nbr = window[:, sl][nbr]                   # [N,K,Mc]
-        eg = jnp.transpose(inc_gossip[:, t_m[sl], :], (0, 2, 1))  # [N,K,Mc]
+    window_bits = jnp.where(mal[None, :], alive_bits[:, None], window_bits)
+    gossip_allowed = _edge_topic_bits(inc_gossip, topic_bits, w)        # [W,K,N]
+    offer = _gather_words(window_bits, nbr_t) & gossip_allowed
+    if cfg.max_iwant_per_tick >= m:
+        # a sender can offer at most M ids, so the budget cannot bind: pick
+        # the lowest offering slot per message (deterministic stand-in for
+        # the reference's random IWANT pick, gossip_tracer.go:53)
+        excl = exclusive_prefix_or(offer, axis=1)
+        chosen_k = offer & ~excl & ~have_bits[:, None, :]
+        iwant_pending = _bits_to_slot(chosen_k, m)
+    else:
         # MaxIHaveLength flood protection, PER SENDING PEER: the iasked[p]
-        # budget caps ids asked from each advertiser within a heartbeat
-        # (gossipsub.go:654-676); an id advertised by a second peer with
-        # headroom is still pulled from that peer, so one flooder cannot
-        # starve honest pulls (headroom checked at chunk granularity)
-        headroom = (asked_ct < cfg.max_iwant_per_tick)[:, :, None]
-        offer = w_nbr & eg & headroom
-        wanted = jnp.any(offer, axis=1) & ~state.have[:, sl]
-        best_slot = jnp.argmax(offer, axis=1).astype(jnp.int32)   # lowest slot
-        oh = jax.nn.one_hot(best_slot, k, dtype=jnp.int32) * \
-            wanted[..., None].astype(jnp.int32)      # [N,Mc,K]
-        before = asked_ct[:, None, :] + jnp.cumsum(oh, axis=1) - oh
-        within = jnp.sum(before * oh, axis=-1) < cfg.max_iwant_per_tick
-        take = wanted & within
-        pend = pend.at[:, sl].set(jnp.where(take, best_slot, -1))
-        asked_ct = asked_ct + jnp.sum(oh * take[..., None].astype(jnp.int32),
-                                      axis=1)
-        return (pend, asked_ct), 0
+        # budget caps ids asked from each advertiser within a heartbeat, and
+        # an id advertised by a second peer with headroom is still pulled
+        # from that peer, so one flooder cannot starve honest pulls
+        # (gossipsub.go:654-676). Exact sequential selection, only on this
+        # adversarial-config path.
+        offer_u = jnp.moveaxis(unpack_words(offer.reshape(w, k * n), m)
+                               .reshape(k, n, m), 0, 1)                 # [N,K,M]
+        offer_u = offer_u & ~state.have[:, None, :]
 
-    slices = jnp.arange(m).reshape(-1, cfg.msg_chunk)
-    (iwant_pending, _), _ = jax.lax.scan(
-        iwant_chunk,
-        (jnp.full((n, m), -1, jnp.int32), jnp.zeros((n, k), jnp.int32)),
-        slices)
+        def pick(asked_ct, off_m):                                      # [N,K]
+            avail = off_m & (asked_ct < cfg.max_iwant_per_tick)
+            slot = jnp.argmax(avail, axis=1).astype(jnp.int32)          # [N]
+            take = jnp.any(avail, axis=1)
+            oh = jax.nn.one_hot(slot, k, dtype=jnp.int32) * take[:, None]
+            return asked_ct + oh, jnp.where(take, slot, -1)
+
+        _, pend_t = jax.lax.scan(pick, jnp.zeros((n, k), jnp.int32),
+                                 jnp.moveaxis(offer_u, -1, 0))
+        iwant_pending = jnp.moveaxis(pend_t, 0, -1)                     # [N,M]
     return state._replace(iwant_pending=iwant_pending)

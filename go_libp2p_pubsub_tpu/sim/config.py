@@ -38,7 +38,6 @@ class SimConfig:
     # floodsub.go:76-100), "randomsub" (random max(D, sqrt N), randomsub.go:99-160)
     router: str = "gossipsub"
     prop_substeps: int = 8    # intra-tick forwarding hops (mesh diameter bound)
-    msg_chunk: int = 32       # message-axis chunk to bound [N,K,chunk] temps
 
     # overlay degree bounds (gossipsub.go:32-40)
     d: int = 6

@@ -49,8 +49,6 @@ def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
 
 def step(state: SimState, cfg: SimConfig, tp: TopicParams,
          key: jax.Array) -> SimState:
-    if cfg.msg_window % cfg.msg_chunk != 0:
-        raise ValueError("msg_window must be a multiple of msg_chunk")
     k_pub, k_hb, k_fwd, k_churn = jax.random.split(key, 4)
     if cfg.churn_disconnect_prob > 0.0:
         state = churn_edges(state, cfg, tp, k_churn)

@@ -53,7 +53,7 @@ def single_topic_1k(n_peers: int = 1024, k_slots: int = 32, degree: int = 12,
     """Config 1: the gossipsub_test.go harness at 1k scale."""
     cfg = SimConfig(
         n_peers=n_peers, k_slots=k_slots, n_topics=1, msg_window=64,
-        msg_chunk=16, publishers_per_tick=8, prop_substeps=8,
+        publishers_per_tick=8, prop_substeps=8,
         scoring_enabled=True, behaviour_penalty_weight=-10.0,
         behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
         publish_threshold=-200.0, graylist_threshold=-300.0)
@@ -103,7 +103,7 @@ def beacon_10k(n_peers: int = 10_000, k_slots: int = 48, degree: int = 16,
     ) for (_, _, w) in _BEACON_TOPICS])
     cfg = SimConfig(
         n_peers=n_peers, k_slots=k_slots, n_topics=t, msg_window=64,
-        msg_chunk=16, publishers_per_tick=16, prop_substeps=8,
+        publishers_per_tick=16, prop_substeps=8,
         scoring_enabled=True, topic_score_cap=100.0,
         behaviour_penalty_weight=-15.9, behaviour_penalty_threshold=6.0,
         behaviour_penalty_decay=0.986, gossip_threshold=-4000.0,
@@ -122,7 +122,7 @@ def churn_50k(n_peers: int = 50_000, k_slots: int = 32, degree: int = 12,
     subscribed[~subscribed.any(axis=1), 0] = True
     cfg = SimConfig(
         n_peers=n_peers, k_slots=k_slots, n_topics=n_topics, msg_window=64,
-        msg_chunk=16, publishers_per_tick=16, prop_substeps=8,
+        publishers_per_tick=16, prop_substeps=8,
         scoring_enabled=True, behaviour_penalty_weight=-10.0,
         behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
         publish_threshold=-200.0, graylist_threshold=-300.0,
@@ -152,7 +152,7 @@ def sybil_100k(n_peers: int = 100_000, k_slots: int = 32, degree: int = 12,
     ip_group = ip_group.astype(np.int32)
     cfg = SimConfig(
         n_peers=n_peers, k_slots=k_slots, n_topics=1, msg_window=32,
-        msg_chunk=16, publishers_per_tick=8, prop_substeps=8,
+        publishers_per_tick=8, prop_substeps=8,
         scoring_enabled=True, behaviour_penalty_weight=-10.0,
         behaviour_penalty_threshold=2.0, behaviour_penalty_decay=0.99,
         ip_colocation_factor_weight=-50.0, ip_colocation_factor_threshold=4,
@@ -171,7 +171,7 @@ def router_sweep_100k(router: str, n_peers: int = 100_000, k_slots: int = 32,
     and randomsub have no scoring; comparison isolates propagation)."""
     cfg = SimConfig(
         n_peers=n_peers, k_slots=k_slots, n_topics=1, msg_window=32,
-        msg_chunk=16, publishers_per_tick=4, prop_substeps=8,
+        publishers_per_tick=4, prop_substeps=8,
         router=router, scoring_enabled=False)
     topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
     return cfg, TopicParams.disabled(1), init_state(cfg, topo)

@@ -19,7 +19,7 @@ from go_libp2p_pubsub_tpu.sim.state import NEVER
 
 
 def cfg_with_churn(**kw):
-    base = dict(n_peers=64, k_slots=16, n_topics=1, msg_window=32, msg_chunk=8,
+    base = dict(n_peers=64, k_slots=16, n_topics=1, msg_window=32,
                 publishers_per_tick=2, prop_substeps=6,
                 churn_disconnect_prob=0.5, churn_reconnect_prob=0.5,
                 retain_score_ticks=5)

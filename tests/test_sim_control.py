@@ -33,7 +33,7 @@ def strict_tp():
 
 class TestBrokenPromises:
     def test_unanswered_iwant_adds_behaviour_penalty(self):
-        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8, msg_chunk=4,
+        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8,
                         publishers_per_tick=1, prop_substeps=2,
                         behaviour_penalty_weight=-1.0)
         topo = topology.dense(8, 4, degree=3)
@@ -59,7 +59,7 @@ class TestBrokenPromises:
         assert not bool(st2.have[0, 0])
 
     def test_answered_iwant_no_penalty(self):
-        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8, msg_chunk=4,
+        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8,
                         publishers_per_tick=1, prop_substeps=2)
         topo = topology.dense(8, 4, degree=3)
         st = init_state(cfg, topo)
@@ -85,7 +85,7 @@ class TestIWantBudget:
     def test_no_phantom_wants_for_never_published_slots(self):
         # idle slots (msg_publish_tick == NEVER) must not be advertised even
         # by malicious peers, nor produce broken-promise penalties
-        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8, msg_chunk=4,
+        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8,
                         publishers_per_tick=1, prop_substeps=1)
         topo = topology.dense(8, 4, degree=3)
         mal = np.zeros(8, bool)
@@ -104,7 +104,7 @@ class TestIWantBudget:
     def test_budget_is_per_sender(self):
         # a flooder exhausting its own budget must not starve pulls from an
         # honest advertiser (iasked is per sending peer, gossipsub.go:654-676)
-        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8, msg_chunk=4,
+        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8,
                         publishers_per_tick=1, prop_substeps=1,
                         max_iwant_per_tick=2)
         topo = topology.dense(8, 4, degree=3)
@@ -129,7 +129,7 @@ class TestIWantBudget:
         assert pend[6] >= 0
 
     def test_cap_limits_pending_iwants(self):
-        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8, msg_chunk=4,
+        cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8,
                         publishers_per_tick=1, prop_substeps=1,
                         max_iwant_per_tick=2)
         topo = topology.dense(8, 4, degree=3)
@@ -154,7 +154,7 @@ class TestIWantBudget:
 class TestSybilIsolation:
     def test_invalid_publishers_scored_and_graylisted(self):
         n, k = 64, 16
-        cfg = SimConfig(n_peers=n, k_slots=k, msg_window=32, msg_chunk=8,
+        cfg = SimConfig(n_peers=n, k_slots=k, msg_window=32,
                         publishers_per_tick=4, prop_substeps=6,
                         scoring_enabled=True, graylist_threshold=-50.0,
                         gossip_threshold=-10.0, publish_threshold=-20.0)
@@ -201,7 +201,7 @@ class TestSybilIsolation:
 
 class TestFanout:
     def _cfg(self):
-        return SimConfig(n_peers=32, k_slots=8, msg_window=16, msg_chunk=4,
+        return SimConfig(n_peers=32, k_slots=8, msg_window=16,
                          publishers_per_tick=1, prop_substeps=6,
                          fanout_ttl_ticks=3, scoring_enabled=False)
 

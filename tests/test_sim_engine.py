@@ -29,7 +29,7 @@ from go_libp2p_pubsub_tpu.sim import (
 
 
 def small_cfg(**kw):
-    base = dict(n_peers=64, k_slots=16, n_topics=1, msg_window=32, msg_chunk=8,
+    base = dict(n_peers=64, k_slots=16, n_topics=1, msg_window=32,
                 publishers_per_tick=2, prop_substeps=6)
     base.update(kw)
     return SimConfig(**base)
