@@ -93,6 +93,30 @@ def full(n: int, k: int) -> Topology:
     return _finalize(n, k, adj, dialed)
 
 
+def from_hosts(hosts, k: int) -> tuple[Topology, dict]:
+    """Topology mirroring a functional-runtime network's live connections
+    (net/network.py Host.conns), plus the peer-id -> index map.
+
+    Slot assignment matches ``_finalize`` (sorted neighbor ids), so a trace
+    replayed into this topology addresses the same (peer, slot) cells the
+    live routers mutated. Dial direction comes from the substrate's
+    "outbound"/"inbound" conn tags (gossipsub.go:467-476 feeds Dout).
+    """
+    n = len(hosts)
+    peer_index = {h.peer_id: i for i, h in enumerate(hosts)}
+    adj: list[set[int]] = [set() for _ in range(n)]
+    dialed: set[tuple[int, int]] = set()
+    for i, h in enumerate(hosts):
+        for pid, direction in h.conns.items():
+            j = peer_index.get(pid)
+            if j is None:
+                continue
+            adj[i].add(j)
+            if direction == "outbound":
+                dialed.add((i, j))
+    return _finalize(n, k, adj, dialed), peer_index
+
+
 def star(n: int, k: int) -> Topology:
     """Peer 0 is the hub (gossipsub_test.go:1044-1127)."""
     adj: list[set[int]] = [set() for _ in range(n)]

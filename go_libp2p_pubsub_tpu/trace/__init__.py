@@ -1,1 +1,9 @@
 from .events import RawTracer, RawTracerBase  # noqa: F401
+from .replay import (  # noqa: F401
+    ReplayFeed,
+    replay,
+    replay_feed,
+    replay_topic_params,
+    tensorize_trace,
+)
+from .sinks import JSONTracer, MemoryTracer, PBTracer, RemoteTracer  # noqa: F401

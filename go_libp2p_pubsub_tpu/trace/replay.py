@@ -1,0 +1,501 @@
+"""Trace replay: drive the batched TPU engine from recorded trace events.
+
+This is the differential-testing contract from SURVEY.md §7 step 7 and
+BASELINE.json ("replaying pb/trace.pb events into the JAX state"): a stream
+of TraceEvents — from the host-side functional runtime's tracer bus
+(trace/bus.py, mirroring trace.go:63-531) or decoded from a PBTracer file
+(pb/codec.py `read_trace_file`) — is *tensorized* host-side into a flat
+op-stream (`ReplayFeed`), then *injected* on device into a `SimState` by a
+single jitted scan. After replay, the sim's mesh membership and P1–P7 score
+counters can be diffed against the live router that produced the trace.
+
+Two halves:
+
+- ``tensorize_trace``: mirrors the reference's delivery-record state machine
+  (score.go:840-877; routers/score.py:317-372) while walking the event
+  stream, expanding DELIVER/DUPLICATE/REJECT into primitive counter ops
+  (first-delivery, in-window mesh duplicate, invalid delivery) exactly as
+  the score RawTracer hooks would fire. Decay boundaries (refreshScores,
+  score.go:504-565) are synthesized from timestamps: every node's decay
+  ticker fires before same-instant traffic (scheduler seq ordering), so a
+  single global DECAY op per boundary is exact.
+- ``replay``: applies the ops in trace order with per-event dynamic-index
+  updates under ``lax.scan`` + ``lax.switch`` — the canonical event order
+  demanded by SURVEY.md §7 "Order-sensitivity vs batching".
+
+Time quantization: replay grafts happen strictly inside a tick interval but
+the sim clock is integral, so grafts record ``graft_tick = tick + 1``
+("credit starts at the next boundary"). With that convention P1's floor
+(score.go:285-291) matches the wall-clock router exactly; the P3 activation
+latch (strict ``>``, score.go:539) then needs its threshold lowered by one
+tick — ``replay_topic_params`` applies that shift. Counters themselves
+(P2/P3/P3b/P4/P7) replay exactly (same decay chain, f32 vs f64 rounding
+aside).
+
+Known scope limits (documented, not silent): behaviour-penalty events
+(P7 add_penalty calls, score.go:439) are not traced by the reference's
+schema, so free-running penalty accrual cannot be replayed — suites that
+exercise P7 must diff against synthetic PENALTY ops; delivery marking
+during a disconnected peer's score-retention window is gated on
+``connected`` rather than the reference's stats-retention lifetime.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..sim.config import SimConfig, TopicParams
+from ..sim.state import NEVER, SimState
+from . import events as ev
+
+# primitive op codes (device-side lax.switch branch index)
+OP_NOP = 0
+OP_DECAY = 1        # tick += 1, then refreshScores decay pass
+OP_GRAFT = 2        # a=observer, b=peer, c=topic
+OP_PRUNE = 3        # a=observer, b=peer, c=topic
+OP_FIRST = 4        # first message delivery from b (score.go:920-947)
+OP_DUP = 5          # in-window mesh duplicate from b (score.go:949-981)
+OP_INVALID = 6      # invalid delivery from b (score.go:899-918)
+OP_PENALTY = 7      # a=observer, b=peer, c=count (score.go:439 AddPenalty)
+OP_JOIN = 8         # a=observer, c=topic
+OP_LEAVE = 9
+OP_PUBLISH = 10     # a=publisher, b=msg slot, c=topic
+OP_DELIVER = 11     # a=observer, b=msg slot (local delivery bookkeeping)
+OP_CONNECT = 12     # a=observer, b=peer (ADD_PEER)
+OP_DISCONNECT = 13  # a=observer, b=peer (REMOVE_PEER, score.go:611-644)
+N_OPS = 14
+
+_SIG_REJECTS = frozenset({
+    ev.REJECT_MISSING_SIGNATURE, ev.REJECT_INVALID_SIGNATURE,
+    ev.REJECT_UNEXPECTED_SIGNATURE, ev.REJECT_UNEXPECTED_AUTH_INFO,
+    ev.REJECT_SELF_ORIGIN,
+})
+_SILENT_REJECTS = frozenset({
+    ev.REJECT_BLACKLISTED_PEER, ev.REJECT_BLACKLISTED_SOURCE,
+    ev.REJECT_VALIDATION_QUEUE_FULL,
+})
+
+# delivery-record states (score.go:90-120)
+_UNKNOWN, _VALID, _INVALID_ST, _THROTTLED, _IGNORED = range(5)
+
+
+class ReplayFeed(NamedTuple):
+    """Flat tensorized op stream + the mid -> slot assignment used."""
+
+    op: np.ndarray      # [E] int32
+    a: np.ndarray       # [E] int32
+    b: np.ndarray      # [E] int32
+    c: np.ndarray       # [E] int32
+    mid_slot: dict      # message id -> slot index
+
+
+def replay_topic_params(topics, heartbeat_interval: float = 1.0) -> TopicParams:
+    """TopicParams for replay: activation threshold shifted by -1 tick to
+    compensate the graft-at-next-boundary convention (module docstring)."""
+    tp = TopicParams.from_topic_params(topics, heartbeat_interval)
+    return tp._replace(
+        mesh_message_deliveries_activation_ticks=(
+            tp.mesh_message_deliveries_activation_ticks - 1.0))
+
+
+class _Record:
+    __slots__ = ("status", "peers", "validated")
+
+    def __init__(self):
+        self.status = _UNKNOWN
+        self.peers: list[str] = []      # insertion-ordered, deterministic
+        self.validated = 0.0
+
+
+def tensorize_trace(events: list[dict], peer_index: dict, topic_index: dict,
+                    *, msg_window: int, decay_interval: float = 1.0,
+                    dup_window=None, t_end: float | None = None) -> ReplayFeed:
+    """Expand a trace-ordered event stream into primitive replay ops.
+
+    events: tracer-bus dicts (trace/bus.py shape / decode_trace_event output),
+    globally ordered as emitted (a shared EventTracer preserves the true
+    scheduler order; timestamp order is equivalent for distinct instants).
+    dup_window: per-topic-index mesh_message_deliveries_window seconds
+    (score_params.go:117-170); scalar or list; default 0 (same-instant only).
+    t_end: run end time — trailing decay boundaries up to here are emitted.
+    """
+    t_count = len(topic_index)
+    if dup_window is None:
+        dup_window = [0.0] * t_count
+    elif np.isscalar(dup_window):
+        dup_window = [float(dup_window)] * t_count
+
+    ops: list[tuple[int, int, int, int]] = []
+    records: dict[tuple[str, str], _Record] = {}
+    mid_slot: dict[str, int] = {}
+    next_decay = decay_interval
+    eps = 1e-9
+
+    def slot_of(mid: str) -> int:
+        s = mid_slot.get(mid)
+        if s is None:
+            s = len(mid_slot)
+            if s >= msg_window:
+                raise ValueError(
+                    f"trace has more than msg_window={msg_window} message ids")
+            mid_slot[mid] = s
+        return s
+
+    def rec_of(observer: str, mid: str) -> _Record:
+        r = records.get((observer, mid))
+        if r is None:
+            r = _Record()
+            records[(observer, mid)] = r
+        return r
+
+    for e in events:
+        ts = e.get("timestamp", 0.0)
+        while ts >= next_decay - eps:
+            ops.append((OP_DECAY, 0, 0, 0))
+            next_decay += decay_interval
+        typ = e["type"]
+        obs = e.get("peerID")
+        ai = peer_index.get(obs, -1)
+        if ai < 0:
+            continue
+
+        if typ == "GRAFT" or typ == "PRUNE":
+            pl = e["graft" if typ == "GRAFT" else "prune"]
+            bi = peer_index.get(pl["peerID"], -1)
+            ci = topic_index.get(pl["topic"], -1)
+            if bi >= 0 and ci >= 0:
+                ops.append((OP_GRAFT if typ == "GRAFT" else OP_PRUNE,
+                            ai, bi, ci))
+        elif typ == "JOIN":
+            ci = topic_index.get(e["join"]["topic"], -1)
+            if ci >= 0:
+                ops.append((OP_JOIN, ai, -1, ci))
+        elif typ == "LEAVE":
+            ci = topic_index.get(e["leave"]["topic"], -1)
+            if ci >= 0:
+                ops.append((OP_LEAVE, ai, -1, ci))
+        elif typ == "ADD_PEER":
+            bi = peer_index.get(e["addPeer"]["peerID"], -1)
+            if bi >= 0:
+                ops.append((OP_CONNECT, ai, bi, -1))
+        elif typ == "REMOVE_PEER":
+            bi = peer_index.get(e["removePeer"]["peerID"], -1)
+            if bi >= 0:
+                ops.append((OP_DISCONNECT, ai, bi, -1))
+        elif typ == "PUBLISH_MESSAGE":
+            pl = e["publishMessage"]
+            ci = topic_index.get(pl.get("topic"), -1)
+            if ci >= 0:
+                ops.append((OP_PUBLISH, ai, slot_of(pl["messageID"]), ci))
+        elif typ == "DELIVER_MESSAGE":
+            pl = e["deliverMessage"]
+            mid = pl["messageID"]
+            ci = topic_index.get(pl.get("topic"), -1)
+            rf = pl.get("receivedFrom")
+            if ci < 0:
+                continue
+            sl = slot_of(mid)
+            # the raw score hook is gated on received_from != observer
+            # (trace/bus.py deliver_message; pubsub self-publish path)
+            if rf is not None and rf != obs:
+                bi = peer_index.get(rf, -1)
+                if bi >= 0:
+                    ops.append((OP_FIRST, ai, bi, ci))
+                r = rec_of(obs, mid)
+                if r.status == _UNKNOWN:
+                    r.status = _VALID
+                    r.validated = ts
+                    # retro-credit duplicates that arrived during validation
+                    # (score.go deliver: always in-window)
+                    for p in r.peers:
+                        if p != rf:
+                            pi = peer_index.get(p, -1)
+                            if pi >= 0:
+                                ops.append((OP_DUP, ai, pi, ci))
+            ops.append((OP_DELIVER, ai, sl, ci))
+        elif typ == "DUPLICATE_MESSAGE":
+            pl = e["duplicateMessage"]
+            mid = pl["messageID"]
+            ci = topic_index.get(pl.get("topic"), -1)
+            rf = pl.get("receivedFrom")
+            if ci < 0 or rf is None or rf == obs:
+                continue
+            r = rec_of(obs, mid)
+            if rf in r.peers:
+                continue
+            if r.status == _UNKNOWN:
+                r.peers.append(rf)
+            elif r.status == _VALID:
+                r.peers.append(rf)
+                if ts - r.validated <= dup_window[ci]:
+                    pi = peer_index.get(rf, -1)
+                    if pi >= 0:
+                        ops.append((OP_DUP, ai, pi, ci))
+            elif r.status == _INVALID_ST:
+                pi = peer_index.get(rf, -1)
+                if pi >= 0:
+                    ops.append((OP_INVALID, ai, pi, ci))
+            # throttled/ignored: nothing
+        elif typ == "REJECT_MESSAGE":
+            pl = e["rejectMessage"]
+            mid = pl["messageID"]
+            ci = topic_index.get(pl.get("topic"), -1)
+            rf = pl.get("receivedFrom")
+            reason = pl.get("reason", "")
+            if ci < 0 or rf is None or rf == obs:
+                continue
+            pi = peer_index.get(rf, -1)
+            if reason in _SIG_REJECTS:
+                if pi >= 0:
+                    ops.append((OP_INVALID, ai, pi, ci))
+                continue
+            if reason in _SILENT_REJECTS:
+                continue
+            r = rec_of(obs, mid)
+            if r.status != _UNKNOWN:
+                continue
+            if reason == ev.REJECT_VALIDATION_THROTTLED:
+                r.status = _THROTTLED
+                r.peers = []
+            elif reason == ev.REJECT_VALIDATION_IGNORED:
+                r.status = _IGNORED
+                r.peers = []
+            else:
+                r.status = _INVALID_ST
+                if pi >= 0:
+                    ops.append((OP_INVALID, ai, pi, ci))
+                for p in r.peers:
+                    qi = peer_index.get(p, -1)
+                    if qi >= 0:
+                        ops.append((OP_INVALID, ai, qi, ci))
+                r.peers = []
+
+    if t_end is not None:
+        while next_decay <= t_end + eps:
+            ops.append((OP_DECAY, 0, 0, 0))
+            next_decay += decay_interval
+
+    if not ops:
+        ops.append((OP_NOP, 0, 0, 0))
+    arr = np.asarray(ops, dtype=np.int32)
+    return ReplayFeed(op=arr[:, 0], a=arr[:, 1], b=arr[:, 2], c=arr[:, 3],
+                      mid_slot=mid_slot)
+
+
+# --- device-side injection ---
+
+
+def _slot_lookup(st: SimState, a, b):
+    """Slot of peer b in observer a's neighbor table; (k, found)."""
+    row = st.neighbors[a]
+    hit = row == b
+    return jnp.argmax(hit), jnp.any(hit) & (b >= 0)
+
+
+def _slot_score(st: SimState, cfg: SimConfig, tp: TopicParams, a, k) -> jnp.ndarray:
+    """Score of the peer in observer a's slot k (score.go:265-342), scalar.
+
+    Used by OP_DISCONNECT to pick the retention branch (score.go:614-618:
+    positive scores are not retained)."""
+    in_mesh = st.mesh[a, :, k]
+    mesh_time = jnp.where(in_mesh, (st.tick - st.graft_tick[a, :, k])
+                          .astype(jnp.float32), 0.0)
+    p1 = jnp.minimum(jnp.floor(mesh_time / tp.time_in_mesh_quantum_ticks + 1e-9),
+                     tp.time_in_mesh_cap)
+    t_score = jnp.where(in_mesh, p1 * tp.time_in_mesh_weight, 0.0)
+    t_score += st.first_message_deliveries[a, :, k] * \
+        tp.first_message_deliveries_weight
+    deficit = tp.mesh_message_deliveries_threshold - \
+        st.mesh_message_deliveries[a, :, k]
+    p3 = jnp.where(st.mesh_active[a, :, k] & (deficit > 0), deficit * deficit, 0.0)
+    t_score += p3 * tp.mesh_message_deliveries_weight
+    t_score += st.mesh_failure_penalty[a, :, k] * tp.mesh_failure_penalty_weight
+    t_score += (st.invalid_message_deliveries[a, :, k] ** 2) * \
+        tp.invalid_message_deliveries_weight
+    score = jnp.sum(t_score * tp.topic_weight)
+    if cfg.topic_score_cap > 0:
+        score = jnp.minimum(score, cfg.topic_score_cap)
+    if cfg.app_specific_weight != 0.0:
+        nbr = jnp.clip(st.neighbors[a, k], 0, cfg.n_peers - 1)
+        score += cfg.app_specific_weight * st.app_score[nbr]
+    if cfg.behaviour_penalty_weight != 0.0:
+        excess = st.behaviour_penalty[a, k] - cfg.behaviour_penalty_threshold
+        score += jnp.where(excess > 0, excess * excess, 0.0) * \
+            cfg.behaviour_penalty_weight
+    return score
+
+
+def _make_branches(cfg: SimConfig, tp: TopicParams):
+    from ..ops.score_ops import decay_counters
+
+    def nop(st, a, b, c):
+        return st
+
+    def decay(st, a, b, c):
+        st = st._replace(tick=st.tick + 1)
+        return decay_counters(st, cfg, tp)
+
+    def graft(st, a, b, c):
+        k, ok = _slot_lookup(st, a, b)
+        # score.go:649-667 Graft: in_mesh, graft time = now, latch reset;
+        # graft_tick = tick+1 (module docstring: next-boundary convention)
+        return st._replace(
+            mesh=st.mesh.at[a, c, k].set(ok | st.mesh[a, c, k]),
+            graft_tick=st.graft_tick.at[a, c, k].set(
+                jnp.where(ok, st.tick + 1, st.graft_tick[a, c, k])),
+            mesh_active=st.mesh_active.at[a, c, k].set(
+                jnp.where(ok, False, st.mesh_active[a, c, k])))
+
+    def prune(st, a, b, c):
+        k, ok = _slot_lookup(st, a, b)
+        # score.go:669-694 Prune: sticky penalty while the P3 latch is
+        # active and under threshold; latch itself is NOT cleared
+        deficit = tp.mesh_message_deliveries_threshold[c] - \
+            st.mesh_message_deliveries[a, c, k]
+        add = jnp.where(ok & st.mesh_active[a, c, k] & (deficit > 0),
+                        deficit * deficit, 0.0)
+        return st._replace(
+            mesh_failure_penalty=st.mesh_failure_penalty.at[a, c, k].add(add),
+            mesh=st.mesh.at[a, c, k].set(jnp.where(ok, False, st.mesh[a, c, k])),
+            backoff=st.backoff.at[a, c, k].set(jnp.where(
+                ok, st.tick + cfg.prune_backoff_ticks, st.backoff[a, c, k])))
+
+    def first(st, a, b, c):
+        k, ok = _slot_lookup(st, a, b)
+        ok = ok & st.connected[a, k]
+        fmd = jnp.where(ok, jnp.minimum(
+            st.first_message_deliveries[a, c, k] + 1.0,
+            tp.first_message_deliveries_cap[c]),
+            st.first_message_deliveries[a, c, k])
+        in_mesh = ok & st.mesh[a, c, k]
+        mmd = jnp.where(in_mesh, jnp.minimum(
+            st.mesh_message_deliveries[a, c, k] + 1.0,
+            tp.mesh_message_deliveries_cap[c]),
+            st.mesh_message_deliveries[a, c, k])
+        return st._replace(
+            first_message_deliveries=st.first_message_deliveries.at[a, c, k].set(fmd),
+            mesh_message_deliveries=st.mesh_message_deliveries.at[a, c, k].set(mmd))
+
+    def dup(st, a, b, c):
+        k, ok = _slot_lookup(st, a, b)
+        ok = ok & st.connected[a, k] & st.mesh[a, c, k]
+        mmd = jnp.where(ok, jnp.minimum(
+            st.mesh_message_deliveries[a, c, k] + 1.0,
+            tp.mesh_message_deliveries_cap[c]),
+            st.mesh_message_deliveries[a, c, k])
+        return st._replace(
+            mesh_message_deliveries=st.mesh_message_deliveries.at[a, c, k].set(mmd))
+
+    def invalid(st, a, b, c):
+        k, ok = _slot_lookup(st, a, b)
+        ok = ok & st.connected[a, k]
+        return st._replace(
+            invalid_message_deliveries=st.invalid_message_deliveries
+            .at[a, c, k].add(jnp.where(ok, 1.0, 0.0)))
+
+    def penalty(st, a, b, c):
+        k, ok = _slot_lookup(st, a, b)
+        return st._replace(behaviour_penalty=st.behaviour_penalty.at[a, k].add(
+            jnp.where(ok, c.astype(jnp.float32), 0.0)))
+
+    def join(st, a, b, c):
+        return st._replace(subscribed=st.subscribed.at[a, c].set(True))
+
+    def leave(st, a, b, c):
+        return st._replace(subscribed=st.subscribed.at[a, c].set(False))
+
+    def publish_op(st, a, b, c):
+        return st._replace(
+            msg_topic=st.msg_topic.at[b].set(c),
+            msg_publish_tick=st.msg_publish_tick.at[b].set(st.tick),
+            have=st.have.at[a, b].set(True),
+            deliver_tick=st.deliver_tick.at[a, b].set(st.tick))
+
+    def deliver(st, a, b, c):
+        return st._replace(
+            have=st.have.at[a, b].set(True),
+            deliver_tick=st.deliver_tick.at[a, b].set(
+                jnp.minimum(st.deliver_tick[a, b], st.tick)))
+
+    def connect(st, a, b, c):
+        k, ok = _slot_lookup(st, a, b)
+        expired = ok & (st.tick - st.disconnect_tick[a, k] > cfg.retain_score_ticks)
+        zt = jnp.zeros((st.mesh.shape[1],), jnp.float32)
+
+        def clr(x):
+            return x.at[a, :, k].set(jnp.where(expired, zt, x[a, :, k]))
+
+        return st._replace(
+            first_message_deliveries=clr(st.first_message_deliveries),
+            mesh_message_deliveries=clr(st.mesh_message_deliveries),
+            mesh_failure_penalty=clr(st.mesh_failure_penalty),
+            invalid_message_deliveries=clr(st.invalid_message_deliveries),
+            behaviour_penalty=st.behaviour_penalty.at[a, k].set(
+                jnp.where(expired, 0.0, st.behaviour_penalty[a, k])),
+            connected=st.connected.at[a, k].set(ok | st.connected[a, k]),
+            disconnect_tick=st.disconnect_tick.at[a, k].set(
+                jnp.where(ok, NEVER, st.disconnect_tick[a, k])))
+
+    def disconnect(st, a, b, c):
+        k, ok = _slot_lookup(st, a, b)
+        # score.go:611-644 RemovePeer: positive score -> stats dropped
+        # outright; otherwise retention (FMD cleared, sticky P3b, frozen)
+        drop = ok & (_slot_score(st, cfg, tp, a, k) > 0)
+        retain = ok & ~drop
+        t_ = st.mesh.shape[1]
+        zt = jnp.zeros((t_,), jnp.float32)
+        deficit = tp.mesh_message_deliveries_threshold - \
+            st.mesh_message_deliveries[a, :, k]
+        sticky = jnp.where(
+            retain & st.mesh[a, :, k] & st.mesh_active[a, :, k] & (deficit > 0),
+            deficit * deficit, 0.0)
+        fmd = jnp.where(drop | retain, zt, st.first_message_deliveries[a, :, k])
+        mmd = jnp.where(drop, zt, st.mesh_message_deliveries[a, :, k])
+        mfp = jnp.where(drop, zt,
+                        st.mesh_failure_penalty[a, :, k] + sticky)
+        imd = jnp.where(drop, zt, st.invalid_message_deliveries[a, :, k])
+        return st._replace(
+            first_message_deliveries=st.first_message_deliveries.at[a, :, k].set(fmd),
+            mesh_message_deliveries=st.mesh_message_deliveries.at[a, :, k].set(mmd),
+            mesh_failure_penalty=st.mesh_failure_penalty.at[a, :, k].set(mfp),
+            invalid_message_deliveries=st.invalid_message_deliveries
+            .at[a, :, k].set(imd),
+            behaviour_penalty=st.behaviour_penalty.at[a, k].set(
+                jnp.where(drop, 0.0, st.behaviour_penalty[a, k])),
+            mesh=st.mesh.at[a, :, k].set(
+                jnp.where(ok, False, st.mesh[a, :, k])),
+            fanout=st.fanout.at[a, :, k].set(
+                jnp.where(ok, False, st.fanout[a, :, k])),
+            connected=st.connected.at[a, k].set(
+                jnp.where(ok, False, st.connected[a, k])),
+            disconnect_tick=st.disconnect_tick.at[a, k].set(
+                jnp.where(ok, st.tick, st.disconnect_tick[a, k])))
+
+    return [nop, decay, graft, prune, first, dup, invalid, penalty,
+            join, leave, publish_op, deliver, connect, disconnect]
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def replay(state: SimState, cfg: SimConfig, tp: TopicParams,
+           op: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray,
+           c: jnp.ndarray) -> SimState:
+    """Inject a tensorized op stream into the state, in trace order."""
+    branches = _make_branches(cfg, tp)
+
+    def step(st, e):
+        o, aa, bb, cc = e
+        return jax.lax.switch(o, branches, st, aa, bb, cc), None
+
+    state, _ = jax.lax.scan(step, state, (op, a, b, c))
+    return state
+
+
+def replay_feed(state: SimState, cfg: SimConfig, tp: TopicParams,
+                feed: ReplayFeed) -> SimState:
+    return replay(state, cfg, tp, jnp.asarray(feed.op), jnp.asarray(feed.a),
+                  jnp.asarray(feed.b), jnp.asarray(feed.c))

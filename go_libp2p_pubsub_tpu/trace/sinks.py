@@ -34,6 +34,18 @@ class _BufferedTracer:
         self.buf.append(evt)
 
 
+class MemoryTracer:
+    """In-memory event collector. Shared across all nodes of an in-process
+    network it yields the true global emission order — the canonical event
+    order for trace replay (trace/replay.py)."""
+
+    def __init__(self):
+        self.events: list[dict] = []
+
+    def trace(self, evt: dict) -> None:
+        self.events.append(evt)
+
+
 class JSONTracer(_BufferedTracer):
     """NDJSON file sink (tracer.go:79-129)."""
 

@@ -1,0 +1,198 @@
+"""Differential test: live functional-runtime routers vs trace replay into
+the batched engine.
+
+The BASELINE.json bit-match gate (SURVEY.md §7 step 7): run an in-process
+gossipsub network with peer scoring on the deterministic substrate, record
+every event through the tracer bus, tensorize the trace, inject it into a
+``SimState`` on the same topology, and diff mesh membership and the P1-P7
+score state against the routers that produced the trace.
+
+Parity bounds: counters decay in f32 on the sim side vs Python floats on the
+host side, so comparisons are allclose(1e-3), not bit equality; P1 and the
+P3 activation latch are tick-quantized via the graft-at-next-boundary
+convention (trace/replay.py module docstring).
+"""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+from go_libp2p_pubsub_tpu.core.params import (
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.net import Network
+from go_libp2p_pubsub_tpu.pb import codec
+from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+from go_libp2p_pubsub_tpu.sim import SimConfig, init_state, topology
+from go_libp2p_pubsub_tpu.trace import (
+    MemoryTracer,
+    replay_feed,
+    replay_topic_params,
+    tensorize_trace,
+)
+
+TOPIC = "t"
+T_END = 12.0
+DUP_WINDOW = 0.05
+
+TSP = TopicScoreParams(
+    topic_weight=1.0, time_in_mesh_weight=0.05, time_in_mesh_quantum=1.0,
+    time_in_mesh_cap=100.0, first_message_deliveries_weight=1.0,
+    first_message_deliveries_decay=0.9, first_message_deliveries_cap=50.0,
+    mesh_message_deliveries_weight=-0.5, mesh_message_deliveries_decay=0.8,
+    mesh_message_deliveries_cap=30.0, mesh_message_deliveries_threshold=3.0,
+    mesh_message_deliveries_window=DUP_WINDOW,
+    mesh_message_deliveries_activation=4.0,
+    mesh_failure_penalty_weight=-1.0, mesh_failure_penalty_decay=0.7,
+    invalid_message_deliveries_weight=-5.0,
+    invalid_message_deliveries_decay=0.9)
+
+
+def run_traced_network(n=12, degree=6, publishes=8):
+    net = Network()
+    mem = MemoryTracer()
+    nodes = []
+    for _ in range(n):
+        h = net.add_host()
+        sp = PeerScoreParams(
+            app_specific_score=lambda p: 0.0, decay_interval=1.0,
+            decay_to_zero=0.01, topics={TOPIC: TSP})
+        rt = GossipSubRouter(score_params=sp,
+                             thresholds=PeerScoreThresholds(
+                                 gossip_threshold=-10, publish_threshold=-50,
+                                 graylist_threshold=-100))
+        nodes.append(PubSub(h, rt, sign_policy=LAX_NO_SIGN, event_tracer=mem))
+    hosts = [x.host for x in nodes]
+    net.dense_connect(hosts, degree=degree)
+    net.scheduler.run_for(0.1)
+    for x in nodes:
+        x.join(TOPIC).subscribe()
+    net.scheduler.run_until(2.5)
+    for i in range(publishes):
+        nodes[i % n].my_topics[TOPIC].publish(b"msg %d" % i)
+        net.scheduler.run_for(0.73)
+    net.scheduler.run_until(T_END)
+    return net, nodes, hosts, mem
+
+
+def replay_into_sim(nodes, hosts, events, k_slots=16, msg_window=64):
+    n = len(hosts)
+    topo, peer_index = topology.from_hosts(hosts, k_slots)
+    cfg = SimConfig(n_peers=n, k_slots=k_slots, n_topics=1,
+                    msg_window=msg_window, scoring_enabled=True)
+    tp = replay_topic_params([TSP])
+    st = init_state(cfg, topo, subscribed=np.zeros((n, 1), bool))
+    feed = tensorize_trace(events, peer_index, {TOPIC: 0},
+                           msg_window=msg_window, decay_interval=1.0,
+                           dup_window=[DUP_WINDOW], t_end=T_END)
+    st = replay_feed(st, cfg, tp, feed)
+    return st, cfg, tp, topo, peer_index, feed
+
+
+@pytest.fixture(scope="module")
+def diff_setup():
+    net, nodes, hosts, mem = run_traced_network()
+    st, cfg, tp, topo, peer_index, feed = replay_into_sim(
+        nodes, hosts, mem.events)
+    return net, nodes, hosts, mem, st, cfg, tp, topo, peer_index, feed
+
+
+class TestTraceReplayDifferential:
+    def test_tick_count(self, diff_setup):
+        _, _, _, _, st, *_ = diff_setup
+        assert int(st.tick) == int(T_END)
+
+    def test_mesh_state_matches(self, diff_setup):
+        _, nodes, hosts, _, st, cfg, tp, topo, peer_index, _ = diff_setup
+        mesh = np.asarray(st.mesh)
+        for i, x in enumerate(nodes):
+            want = {peer_index[p] for p in x.rt.mesh.get(TOPIC, set())}
+            got = {int(topo.neighbors[i, k]) for k in range(cfg.k_slots)
+                   if mesh[i, 0, k]}
+            assert got == want, f"node {i}: sim mesh {got} != router {want}"
+
+    def test_score_counters_match(self, diff_setup):
+        _, nodes, hosts, _, st, cfg, tp, topo, peer_index, _ = diff_setup
+        fmd = np.asarray(st.first_message_deliveries)
+        mmd = np.asarray(st.mesh_message_deliveries)
+        mfp = np.asarray(st.mesh_failure_penalty)
+        imd = np.asarray(st.invalid_message_deliveries)
+        slot_of = [{int(j): k for k, j in enumerate(topo.neighbors[i])
+                    if j >= 0} for i in range(len(nodes))]
+        checked = 0
+        for i, x in enumerate(nodes):
+            for pid, pstats in x.rt.score.peer_stats.items():
+                ts = pstats.topics.get(TOPIC)
+                if ts is None:
+                    continue
+                j = peer_index[pid]
+                k = slot_of[i].get(j)
+                assert k is not None, f"peer {j} not adjacent to {i}"
+                np.testing.assert_allclose(
+                    fmd[i, 0, k], ts.first_message_deliveries, atol=1e-3,
+                    err_msg=f"FMD mismatch at observer {i} slot {k} (peer {j})")
+                np.testing.assert_allclose(
+                    mmd[i, 0, k], ts.mesh_message_deliveries, atol=1e-3,
+                    err_msg=f"MMD mismatch at observer {i} slot {k} (peer {j})")
+                np.testing.assert_allclose(
+                    mfp[i, 0, k], ts.mesh_failure_penalty, atol=1e-3,
+                    err_msg=f"MFP mismatch at observer {i} slot {k} (peer {j})")
+                np.testing.assert_allclose(
+                    imd[i, 0, k], ts.invalid_message_deliveries, atol=1e-3,
+                    err_msg=f"IMD mismatch at observer {i} slot {k} (peer {j})")
+                checked += 1
+        assert checked > len(nodes)  # scoring actually exercised
+
+    def test_total_scores_match(self, diff_setup):
+        from go_libp2p_pubsub_tpu.ops.score_ops import compute_scores
+        _, nodes, hosts, _, st, cfg, tp, topo, peer_index, _ = diff_setup
+        scores = np.asarray(compute_scores(st, cfg, tp))
+        checked = 0
+        for i, x in enumerate(nodes):
+            for k in range(cfg.k_slots):
+                j = int(topo.neighbors[i, k])
+                if j < 0:
+                    continue
+                pid = hosts[j].peer_id
+                if pid not in x.rt.score.peer_stats:
+                    continue
+                host_score = x.rt.score.score(pid)
+                np.testing.assert_allclose(
+                    scores[i, k], host_score, atol=5e-3,
+                    err_msg=f"score mismatch: observer {i} -> peer {j}")
+                checked += 1
+        assert checked > len(nodes)
+
+    def test_delivery_state_matches(self, diff_setup):
+        _, nodes, hosts, _, st, cfg, tp, topo, peer_index, feed = diff_setup
+        have = np.asarray(st.have)
+        # every subscribed node saw every message (dense net, full delivery)
+        n_msgs = len(feed.mid_slot)
+        assert n_msgs == 8
+        for i, x in enumerate(nodes):
+            for mid, sl in feed.mid_slot.items():
+                assert have[i, sl] == x.seen.has(mid), \
+                    f"have mismatch node {i} mid {mid!r}"
+
+
+class TestTraceCodecRoundTrip:
+    def test_pb_file_feed_identical(self, diff_setup, tmp_path):
+        """Events -> pb/trace bytes -> decode -> tensorize == in-memory feed
+        (the interop path for traces recorded outside this process)."""
+        _, nodes, hosts, mem, st, cfg, tp, topo, peer_index, feed = diff_setup
+        path = tmp_path / "trace.pb"
+        with open(path, "wb") as f:
+            for e in mem.events:
+                blob = codec.encode_trace_event(e)
+                f.write(codec.write_uvarint(len(blob)) + blob)
+        decoded = codec.read_trace_file(str(path))
+        assert len(decoded) == len(mem.events)
+        feed2 = tensorize_trace(decoded, peer_index, {TOPIC: 0},
+                                msg_window=64, decay_interval=1.0,
+                                dup_window=[DUP_WINDOW], t_end=T_END)
+        np.testing.assert_array_equal(feed.op, feed2.op)
+        np.testing.assert_array_equal(feed.a, feed2.a)
+        np.testing.assert_array_equal(feed.b, feed2.b)
+        np.testing.assert_array_equal(feed.c, feed2.c)
