@@ -430,9 +430,8 @@ def decode_trace_event(buf: bytes) -> dict:
     return evt
 
 
-def read_trace_file(path: str) -> list[dict]:
-    """Read a PBTracer output file (uvarint-delimited TraceEvents)."""
-    data = open(path, "rb").read()
+def decode_trace_bytes(data: bytes) -> list[dict]:
+    """Decode a uvarint-delimited TraceEvent stream."""
     out = []
     pos = 0
     while pos < len(data):
@@ -440,3 +439,9 @@ def read_trace_file(path: str) -> list[dict]:
         out.append(decode_trace_event(data[pos:pos + ln]))
         pos += ln
     return out
+
+
+def read_trace_file(path: str) -> list[dict]:
+    """Read a PBTracer output file (uvarint-delimited TraceEvents)."""
+    with open(path, "rb") as f:
+        return decode_trace_bytes(f.read())

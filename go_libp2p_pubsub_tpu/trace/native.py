@@ -1,0 +1,156 @@
+"""ctypes binding for the native trace codec (native/trace_codec.cpp).
+
+The shared library is compiled on demand with g++ (one translation unit,
+O2) into the package's ``native/`` directory and cached; when no compiler
+is available the pure-Python tensorizer (trace/replay.py) is the fallback.
+``tensorize_file`` is the fast path for SURVEY.md §7's "chunked,
+pre-tensorized event feeds": it parses a uvarint-delimited TraceEvent file
+and returns the ReplayFeed without instantiating per-event Python objects.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from .replay import ReplayFeed
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "trace_codec.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libtracecodec.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.trace_codec_tensorize.restype = ctypes.c_int
+        lib.trace_codec_tensorize.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,          # buf, len
+            ctypes.c_char_p, ctypes.c_long,          # peers blob, n
+            ctypes.c_char_p, ctypes.c_long,          # topics blob, n
+            ctypes.POINTER(ctypes.c_double),         # dup_window
+            ctypes.c_double, ctypes.c_double,        # decay_interval, t_end
+            ctypes.c_int, ctypes.c_long,             # has_t_end, msg_window
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.trace_codec_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def tensorize_bytes(data: bytes, peer_index: dict, topic_index: dict, *,
+                    msg_window: int, decay_interval: float = 1.0,
+                    dup_window=None, t_end: float | None = None) -> ReplayFeed:
+    """Native twin of replay.tensorize_trace over encoded TraceEvent bytes.
+
+    peer_index / topic_index must map contiguous indices 0..n-1 (the same
+    contract replay.tensorize_trace relies on for array addressing).
+    """
+    lib = load()
+    if lib is None:
+        from ..pb.codec import decode_trace_bytes
+        from .replay import tensorize_trace
+        return tensorize_trace(decode_trace_bytes(data), peer_index,
+                               topic_index, msg_window=msg_window,
+                               decay_interval=decay_interval,
+                               dup_window=dup_window, t_end=t_end)
+
+    t_count = len(topic_index)
+    if dup_window is None:
+        dw = [0.0] * t_count
+    elif np.isscalar(dup_window):
+        dw = [float(dup_window)] * t_count
+    else:
+        dw = [float(x) for x in dup_window]
+
+    def blob(index: dict) -> bytes:
+        # length-prefixed, binary-safe (peer ids are raw multihash bytes
+        # round-tripped through surrogateescape by pb/codec.py)
+        ordered = sorted(index, key=index.get)
+        out = bytearray()
+        for s in ordered:
+            raw = s.encode("utf-8", "surrogateescape")
+            out += len(raw).to_bytes(4, "little") + raw
+        return bytes(out)
+
+    out = ctypes.POINTER(ctypes.c_int32)()
+    out_events = ctypes.c_long()
+    mids_p = ctypes.POINTER(ctypes.c_char)()
+    n_mids = ctypes.c_long()
+    dw_arr = (ctypes.c_double * t_count)(*dw)
+    rc = lib.trace_codec_tensorize(
+        data, len(data), blob(peer_index), len(peer_index),
+        blob(topic_index), t_count, dw_arr,
+        decay_interval, t_end if t_end is not None else 0.0,
+        1 if t_end is not None else 0, msg_window,
+        ctypes.byref(out), ctypes.byref(out_events),
+        ctypes.byref(mids_p), ctypes.byref(n_mids))
+    if rc != 0:
+        lib.trace_codec_free(out)
+        lib.trace_codec_free(mids_p)
+        raise ValueError(f"native tensorize failed (rc={rc}); "
+                         "msg_window too small or malformed stream")
+    n = out_events.value
+    arr = np.ctypeslib.as_array(out, shape=(n, 4)).copy()
+    mid_slot: dict = {}
+    off = 0
+    for i in range(n_mids.value):
+        ln = int.from_bytes(ctypes.string_at(
+            ctypes.addressof(mids_p.contents) + off, 4), "little")
+        off += 4
+        mid = ctypes.string_at(
+            ctypes.addressof(mids_p.contents) + off, ln).decode("latin-1")
+        off += ln
+        mid_slot[mid] = i
+    lib.trace_codec_free(out)
+    lib.trace_codec_free(mids_p)
+    return ReplayFeed(op=np.ascontiguousarray(arr[:, 0]),
+                      a=np.ascontiguousarray(arr[:, 1]),
+                      b=np.ascontiguousarray(arr[:, 2]),
+                      c=np.ascontiguousarray(arr[:, 3]),
+                      mid_slot=mid_slot)
+
+
+def tensorize_file(path: str, peer_index: dict, topic_index: dict,
+                   **kw) -> ReplayFeed:
+    with open(path, "rb") as f:
+        return tensorize_bytes(f.read(), peer_index, topic_index, **kw)

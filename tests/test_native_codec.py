@@ -1,0 +1,73 @@
+"""Parity tests: native C++ trace tensorizer vs the Python reference.
+
+The native path (native/trace_codec.cpp via trace/native.py) must produce a
+byte-identical op stream to replay.tensorize_trace for the same encoded
+TraceEvent bytes — same ops, same slot assignment, same decay boundaries.
+"""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.pb import codec
+from go_libp2p_pubsub_tpu.trace import native, tensorize_trace
+
+from test_trace_replay import DUP_WINDOW, T_END, TOPIC, run_traced_network
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="no C++ toolchain for native codec")
+
+
+def encode_stream(events):
+    out = bytearray()
+    for e in events:
+        blob = codec.encode_trace_event(e)
+        out += codec.write_uvarint(len(blob)) + blob
+    return bytes(out)
+
+
+@pytest.fixture(scope="module")
+def traced():
+    net, nodes, hosts, mem = run_traced_network(n=10, degree=5, publishes=6)
+    peer_index = {h.peer_id: i for i, h in enumerate(hosts)}
+    return mem.events, peer_index
+
+
+class TestNativeParity:
+    def test_op_stream_identical(self, traced):
+        events, peer_index = traced
+        data = encode_stream(events)
+        evs = codec.decode_trace_bytes(data)
+        kw = dict(msg_window=64, decay_interval=1.0,
+                  dup_window=[DUP_WINDOW], t_end=T_END)
+        ref = tensorize_trace(evs, peer_index, {TOPIC: 0}, **kw)
+        got = native.tensorize_bytes(data, peer_index, {TOPIC: 0}, **kw)
+        np.testing.assert_array_equal(got.op, ref.op)
+        np.testing.assert_array_equal(got.a, ref.a)
+        np.testing.assert_array_equal(got.b, ref.b)
+        np.testing.assert_array_equal(got.c, ref.c)
+        assert got.mid_slot == ref.mid_slot
+
+    def test_no_t_end_no_trailing_decay(self, traced):
+        events, peer_index = traced
+        data = encode_stream(events[:50])
+        evs = codec.decode_trace_bytes(data)
+        ref = tensorize_trace(evs, peer_index, {TOPIC: 0}, msg_window=64)
+        got = native.tensorize_bytes(data, peer_index, {TOPIC: 0},
+                                     msg_window=64)
+        np.testing.assert_array_equal(got.op, ref.op)
+        np.testing.assert_array_equal(got.a, ref.a)
+
+    def test_window_overflow_raises(self, traced):
+        events, peer_index = traced
+        data = encode_stream(events)
+        with pytest.raises(ValueError):
+            native.tensorize_bytes(data, peer_index, {TOPIC: 0}, msg_window=2)
+
+    def test_malformed_stream_raises(self):
+        with pytest.raises(ValueError):
+            native.tensorize_bytes(b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\xff",
+                                   {}, {}, msg_window=8)
+
+    def test_empty_stream_noop(self):
+        feed = native.tensorize_bytes(b"", {"a": 0}, {TOPIC: 0}, msg_window=8)
+        assert list(feed.op) == [0]  # OP_NOP
