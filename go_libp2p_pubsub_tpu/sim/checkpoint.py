@@ -1,0 +1,53 @@
+"""Exact network checkpoints: save/restore the whole SimState pytree.
+
+The reference has no checkpoint/resume — all router state is soft and
+rebuilt from the network (SURVEY.md §5.4); the only deliberate persistence
+is in-RAM score retention (score.go:611-644). The simulator gains what the
+reference lacks: the entire N-peer network is one pytree of arrays, so a
+checkpoint is an orbax save and resume is bit-exact — a paused 100k-peer
+simulation continues as if never stopped (tests/test_checkpoint.py proves
+trajectory equality).
+
+orbax is the primary backend; a .npz fallback keeps the feature alive in
+minimal environments.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .state import SimState
+
+try:
+    import orbax.checkpoint as ocp
+    _HAVE_ORBAX = True
+except Exception:  # pragma: no cover - orbax is baked into the image
+    _HAVE_ORBAX = False
+
+
+def save(path: str, state: SimState) -> None:
+    """Write a checkpoint directory (orbax) or .npz file (fallback)."""
+    path = os.path.abspath(path)
+    if _HAVE_ORBAX and not path.endswith(".npz"):
+        with ocp.StandardCheckpointer() as ckpt:
+            ckpt.save(path, jax.device_get(state))
+        return
+    arrs = {f: np.asarray(v) for f, v in zip(SimState._fields, state)}
+    np.savez_compressed(path if path.endswith(".npz") else path + ".npz",
+                        **arrs)
+
+
+def restore(path: str, like: SimState) -> SimState:
+    """Load a checkpoint; ``like`` supplies the shapes/dtypes (and, for
+    sharded states, the target shardings via its arrays)."""
+    path = os.path.abspath(path)
+    if _HAVE_ORBAX and os.path.isdir(path):
+        with ocp.StandardCheckpointer() as ckpt:
+            out = ckpt.restore(path, jax.device_get(like))
+        return SimState(*[jnp.asarray(x) for x in out])
+    npz = np.load(path if path.endswith(".npz") else path + ".npz")
+    return SimState(*[jnp.asarray(npz[f]) for f in SimState._fields])

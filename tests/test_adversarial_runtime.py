@@ -1,0 +1,330 @@
+"""Adversarial + behavioral parity tests for the host-side functional
+runtime, mirroring the reference's hostile-actor suite:
+
+- IWANT spam retransmission cutoff (gossipsub_spam_test.go:23)
+- IHAVE flood protection (gossipsub_spam_test.go:134, gossipsub.go:630-660)
+- GRAFT during backoff -> behaviour penalty (gossipsub_spam_test.go:365)
+- direct peers (gossipsub_test.go:1221)
+- flood publish (gossipsub_test.go:1412)
+- opportunistic grafting (gossipsub_test.go:1804)
+- star topology relay (gossipsub_test.go:1044-1127)
+
+The raw mock peer speaks hand-built RPCs over the substrate without a
+PubSub instance — the newMockGS pattern (gossipsub_spam_test.go:767).
+"""
+
+from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+from go_libp2p_pubsub_tpu.core.params import (
+    GossipSubParams,
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.core.types import (
+    RPC,
+    ControlGraft,
+    ControlIHave,
+    ControlIWant,
+    ControlMessage,
+    ControlPrune,
+    SubOpts,
+)
+from go_libp2p_pubsub_tpu.net import Network
+from go_libp2p_pubsub_tpu.routers.feat import GOSSIPSUB_ID_V11
+from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+from go_libp2p_pubsub_tpu.trace import MemoryTracer
+
+
+class RawPeer:
+    """Hand-rolled gossipsub speaker: records every inbound RPC, sends
+    whatever control messages the test scripts (newMockGS, spam suite)."""
+
+    def __init__(self, net: Network):
+        self.host = net.add_host()
+        self.inbox: list[RPC] = []
+        self.host.set_protocols([GOSSIPSUB_ID_V11], lambda p, proto: None,
+                                lambda src, rpc: self.inbox.append(rpc))
+
+    @property
+    def pid(self):
+        return self.host.peer_id
+
+    def connect(self, node: PubSub) -> None:
+        self.host.connect(node.host)
+
+    def send(self, node: PubSub, rpc: RPC) -> None:
+        self.host.send(node.pid, rpc)
+
+    def subscribe(self, node: PubSub, topic: str) -> None:
+        self.send(node, RPC(subscriptions=[SubOpts(True, topic)]))
+
+    def received_messages(self):
+        return [m for rpc in self.inbox for m in rpc.publish]
+
+    def received_ihave_ids(self):
+        return [mid for rpc in self.inbox if rpc.control
+                for ih in rpc.control.ihave for mid in ih.message_ids]
+
+    def received_iwant_ids(self):
+        return [mid for rpc in self.inbox if rpc.control
+                for iw in rpc.control.iwant for mid in iw.message_ids]
+
+    def received_prunes(self):
+        return [pr for rpc in self.inbox if rpc.control
+                for pr in rpc.control.prune]
+
+
+def one_node(net, **router_kw):
+    h = net.add_host()
+    return PubSub(h, GossipSubRouter(**router_kw), sign_policy=LAX_NO_SIGN)
+
+
+class TestIWantRetransmissionCutoff:
+    def test_repeated_iwant_cut_off(self):
+        # gossipsub_spam_test.go:23: the same id re-requested more than
+        # GossipRetransmission times stops being served (mcache.go:66-80)
+        net = Network()
+        node = one_node(net, params=GossipSubParams(gossip_retransmission=3))
+        node.join("t").subscribe()
+        mock = RawPeer(net)
+        mock.connect(node)
+        net.scheduler.run_for(0.2)
+        mock.subscribe(node, "t")
+        net.scheduler.run_for(1.0)
+        node.my_topics["t"].publish(b"payload")
+        net.scheduler.run_for(0.5)
+        # the mock (grafted as the only topic peer) got the eager push;
+        # like iwantEverything it re-requests the id it already has
+        got = mock.received_messages()
+        assert got, "expected the eager mesh push"
+        mid = node.id_gen.id(got[0])
+        mock.inbox.clear()
+        for _ in range(10):
+            mock.send(node, RPC(control=ControlMessage(
+                iwant=[ControlIWant(message_ids=[mid])])))
+            net.scheduler.run_for(0.05)
+        # served at most GossipRetransmission times, then cut off
+        assert len(mock.received_messages()) == 3
+
+
+class TestIHaveFloodProtection:
+    def test_max_ihave_messages_per_heartbeat(self):
+        # gossipsub_spam_test.go:134 / gossipsub.go:645-660: more than
+        # MaxIHaveMessages advertisements within one heartbeat are ignored
+        net = Network()
+        node = one_node(net, params=GossipSubParams(max_ihave_messages=5))
+        node.join("t").subscribe()
+        mock = RawPeer(net)
+        mock.connect(node)
+        net.scheduler.run_for(0.2)
+        mock.subscribe(node, "t")
+        net.scheduler.run_for(0.95)  # stay inside one heartbeat window
+        for i in range(20):
+            mock.send(node, RPC(control=ControlMessage(ihave=[
+                ControlIHave(topic="t", message_ids=["fake-%d" % i])])))
+        net.scheduler.run_for(0.04)
+        # one IWANT per accepted IHAVE; the 6th..20th are dropped
+        assert len(mock.received_iwant_ids()) == 5
+
+    def test_max_ihave_length_budget(self):
+        # iasked budget: ids asked per advertiser per heartbeat is capped by
+        # MaxIHaveLength (gossipsub.go:662-676)
+        net = Network()
+        node = one_node(net, params=GossipSubParams(max_ihave_length=7))
+        node.join("t").subscribe()
+        mock = RawPeer(net)
+        mock.connect(node)
+        net.scheduler.run_for(0.2)
+        mock.subscribe(node, "t")
+        net.scheduler.run_for(0.95)
+        mock.send(node, RPC(control=ControlMessage(ihave=[
+            ControlIHave(topic="t",
+                         message_ids=["fake-%d" % i for i in range(30)])])))
+        net.scheduler.run_for(0.04)
+        assert len(mock.received_iwant_ids()) == 7
+
+
+class TestGraftBackoffPenalty:
+    def test_regraft_during_backoff_penalized(self):
+        # gossipsub_spam_test.go:365: GRAFT while in PRUNE backoff earns
+        # behaviour penalties (one + one for flood regraft) and a re-PRUNE
+        net = Network()
+        sp = PeerScoreParams(
+            app_specific_score=lambda p: 0.0, decay_interval=1.0,
+            decay_to_zero=0.01, behaviour_penalty_weight=-1.0,
+            behaviour_penalty_decay=0.9,
+            topics={"t": TopicScoreParams(topic_weight=1.0,
+                                          time_in_mesh_quantum=1.0)})
+        node = one_node(net, score_params=sp,
+                        thresholds=PeerScoreThresholds(
+                            gossip_threshold=-100, publish_threshold=-200,
+                            graylist_threshold=-300))
+        node.join("t").subscribe()
+        mock = RawPeer(net)
+        mock.connect(node)
+        net.scheduler.run_for(0.2)
+        mock.subscribe(node, "t")
+        net.scheduler.run_for(0.2)
+        # graft in, then prune ourselves out: node records a backoff for us
+        mock.send(node, RPC(control=ControlMessage(
+            graft=[ControlGraft(topic="t")])))
+        net.scheduler.run_for(0.05)
+        assert mock.pid in node.rt.mesh["t"]
+        mock.send(node, RPC(control=ControlMessage(
+            prune=[ControlPrune(topic="t")])))
+        net.scheduler.run_for(0.05)
+        assert mock.pid not in node.rt.mesh["t"]
+        # regraft during backoff: penalized (double: within flood threshold)
+        mock.inbox.clear()
+        mock.send(node, RPC(control=ControlMessage(
+            graft=[ControlGraft(topic="t")])))
+        net.scheduler.run_for(0.05)
+        assert mock.pid not in node.rt.mesh["t"]
+        assert [pr.topic for pr in mock.received_prunes()] == ["t"]
+        # P7: two penalty points -> -(2^2) = -4
+        assert node.rt.score.score(mock.pid) == -4.0
+
+
+class TestDirectPeers:
+    def test_direct_always_accepted_never_meshed(self):
+        # gossipsub_test.go:1221: direct peers bypass the gater/graylist but
+        # GRAFTs from them are refused (gossipsub.go:761-767)
+        net = Network()
+        hA = net.add_host()
+        hB = net.add_host()
+        a = PubSub(hA, GossipSubRouter(direct_peers=[hB.peer_id]),
+                   sign_policy=LAX_NO_SIGN)
+        b = PubSub(hB, GossipSubRouter(direct_peers=[hA.peer_id]),
+                   sign_policy=LAX_NO_SIGN)
+        net.connect_all([hA, hB])
+        net.scheduler.run_for(0.2)
+        sa = a.join("t").subscribe()
+        sb = b.join("t").subscribe()
+        net.scheduler.run_for(2.5)
+        from go_libp2p_pubsub_tpu.core.types import AcceptStatus
+        assert a.rt.accept_from(b.pid) == AcceptStatus.ACCEPT_ALL
+        # direct peers are excluded from the mesh on both sides
+        assert b.pid not in a.rt.mesh.get("t", set())
+        assert a.pid not in b.rt.mesh.get("t", set())
+        # ...but messages still flow (Publish includes direct peers,
+        # gossipsub.go:996-1000)
+        a.my_topics["t"].publish(b"direct hello")
+        net.scheduler.run_for(0.5)
+        got = [m for m in iter(sb.next, None)]
+        assert any(m.data == b"direct hello" for m in got)
+
+    def test_direct_connect_retries(self):
+        # gossipsub.go:1648-1670: direct peers are dialed at attach and
+        # re-dialed every DirectConnectTicks if the connection dropped
+        net = Network()
+        hA = net.add_host()
+        hB = net.add_host()
+        a = PubSub(hA, GossipSubRouter(
+            direct_peers=[hB.peer_id],
+            params=GossipSubParams(direct_connect_ticks=2,
+                                   direct_connect_initial_delay=0.1)),
+            sign_policy=LAX_NO_SIGN)
+        PubSub(hB, GossipSubRouter(direct_peers=[hA.peer_id]),
+               sign_policy=LAX_NO_SIGN)
+        net.scheduler.run_for(0.5)
+        assert hB.peer_id in hA.conns
+        hA.disconnect(hB.peer_id)
+        net.scheduler.run_for(0.1)
+        assert hB.peer_id not in hA.conns
+        net.scheduler.run_for(3.0)   # next direct-connect sweep re-dials
+        assert hB.peer_id in hA.conns
+
+
+class TestFloodPublish:
+    def _count_receivers(self, flood: bool) -> int:
+        net = Network()
+        mem = MemoryTracer()
+        nodes = []
+        for _ in range(12):
+            h = net.add_host()
+            nodes.append(PubSub(
+                h, GossipSubRouter(flood_publish=flood,
+                                   params=GossipSubParams(dhi=8)),
+                sign_policy=LAX_NO_SIGN, event_tracer=mem))
+        net.connect_all([x.host for x in nodes])
+        net.scheduler.run_for(0.2)
+        for x in nodes:
+            x.join("t").subscribe()
+        net.scheduler.run_for(2.5)
+        mem.events.clear()
+        nodes[0].my_topics["t"].publish(b"wide")
+        net.scheduler.run_for(0.2)
+        first_hop = {e["sendTo"] for e in mem.events
+                     if e["type"] == "SEND_RPC"
+                     and e["peerID"] == nodes[0].pid
+                     and any("messageID" in m
+                             for m in e.get("meta", {}).get("messages", []))}
+        return len(first_hop)
+
+    def test_flood_publish_hits_all_topic_peers(self):
+        # gossipsub_test.go:1412: with flood publish the first hop is every
+        # topic peer, not just the D-bounded mesh (gossipsub.go:989-995)
+        assert self._count_receivers(flood=True) == 11
+        assert self._count_receivers(flood=False) <= 8  # Dhi-bounded
+
+
+class TestOpportunisticGrafting:
+    def test_grafts_above_median_peers(self):
+        # gossipsub_test.go:1804: when the median mesh score sags below the
+        # threshold, heartbeats graft up to OpportunisticGraftPeers peers
+        # scoring above the median (gossipsub.go:1521-1552)
+        net = Network()
+        good_ids = set()
+        sp = PeerScoreParams(
+            app_specific_score=lambda p: 20.0 if p in good_ids else 0.0,
+            app_specific_weight=1.0,
+            decay_interval=1.0, decay_to_zero=0.01,
+            topics={"t": TopicScoreParams(topic_weight=1.0,
+                                          time_in_mesh_quantum=1.0)})
+        hub = one_node(net, score_params=sp,
+                       thresholds=PeerScoreThresholds(
+                           gossip_threshold=-10, publish_threshold=-20,
+                           graylist_threshold=-30,
+                           opportunistic_graft_threshold=5.0),
+                       params=GossipSubParams(opportunistic_graft_ticks=2))
+        # 8 zero-score leaves fill the mesh first
+        leaves = [one_node(net) for _ in range(8)]
+        for lf in leaves:
+            hub.host.connect(lf.host)
+        net.scheduler.run_for(0.2)
+        hub.join("t").subscribe()
+        for lf in leaves:
+            lf.join("t").subscribe()
+        net.scheduler.run_for(3.0)
+        assert len(hub.rt.mesh["t"]) >= 6
+        # two high-score leaves join late: only opportunistic grafting can
+        # pull them in (mesh is already >= Dlo, so no undersubscription fill)
+        good = [one_node(net) for _ in range(2)]
+        good_ids.update(g.pid for g in good)
+        for g in good:
+            hub.host.connect(g.host)
+        net.scheduler.run_for(0.1)
+        for g in good:
+            g.join("t").subscribe()
+        net.scheduler.run_for(6.0)
+        assert good_ids & hub.rt.mesh["t"], \
+            "opportunistic grafting never pulled in the high-score peers"
+
+
+class TestStarTopology:
+    def test_hub_relays_to_all_leaves(self):
+        # gossipsub_test.go:1044-1127 star topologies: every leaf only sees
+        # the hub; published messages still reach the whole network
+        net = Network()
+        hub = one_node(net)
+        leaves = [one_node(net) for _ in range(10)]
+        for lf in leaves:
+            lf.host.connect(hub.host)
+        net.scheduler.run_for(0.2)
+        subs = [x.join("t").subscribe() for x in [hub] + leaves]
+        net.scheduler.run_for(3.0)
+        leaves[0].my_topics["t"].publish(b"via hub")
+        net.scheduler.run_for(1.0)
+        for i, s in enumerate(subs):
+            got = [m for m in iter(s.next, None)]
+            assert any(m.data == b"via hub" for m in got), f"node {i} missed"
