@@ -1,0 +1,126 @@
+"""Per-phase timing of the sim step + gather microbenchmarks on the TPU.
+
+Usage: python scripts/profile_step.py [N]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from __graft_entry__ import _build
+from go_libp2p_pubsub_tpu.ops.churn import churn_edges
+from go_libp2p_pubsub_tpu.ops.heartbeat import heartbeat, edge_gather
+from go_libp2p_pubsub_tpu.ops.propagate import forward_tick, publish
+from go_libp2p_pubsub_tpu.ops.score_ops import decay_counters, compute_scores
+from go_libp2p_pubsub_tpu.sim.engine import step
+
+
+def timeit(fn, *args, reps=5, warmup=2):
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    cfg, tp, st = _build(n_peers=n, k_slots=32, degree=12, msg_window=64,
+                         publishers=8)
+    key = jax.random.PRNGKey(0)
+    k_pub, k_hb, k_fwd = jax.random.split(key, 3)
+
+    # converge a bit first
+    st = jax.jit(step, static_argnames=("cfg",))(st, cfg, tp, key)
+    jax.block_until_ready(st)
+
+    print(f"== N={n} k={cfg.k_slots} T={cfg.n_topics} M={cfg.msg_window} "
+          f"hops={cfg.prop_substeps} on {jax.devices()[0].platform} ==")
+
+    t = timeit(jax.jit(step, static_argnames=("cfg",)), st, cfg, tp, key)
+    print(f"full step:        {t*1e3:9.2f} ms")
+
+    peers = jnp.zeros(8, jnp.int32)
+    topics = jnp.zeros(8, jnp.int32)
+    t = timeit(jax.jit(publish, static_argnames=("cfg",)), st, cfg, peers, topics)
+    print(f"  publish:        {t*1e3:9.2f} ms")
+    t = timeit(jax.jit(decay_counters, static_argnames=("cfg",)), st, cfg, tp)
+    print(f"  decay_counters: {t*1e3:9.2f} ms")
+    t = timeit(jax.jit(compute_scores, static_argnames=("cfg",)), st, cfg, tp)
+    print(f"  compute_scores: {t*1e3:9.2f} ms")
+    hb_jit = jax.jit(heartbeat, static_argnames=("cfg",))
+    t = timeit(hb_jit, st, cfg, tp, k_hb)
+    print(f"  heartbeat:      {t*1e3:9.2f} ms")
+    hb = hb_jit(st, cfg, tp, k_hb)
+    jax.block_until_ready(hb)
+    t = timeit(jax.jit(forward_tick, static_argnames=("cfg",)),
+               hb.state, cfg, tp, hb.gossip_sel, hb.scores, k_fwd)
+    print(f"  forward_tick:   {t*1e3:9.2f} ms")
+
+    # ---- gather microbenchmarks ----
+    w, k = 2, cfg.k_slots
+    keyr = jax.random.PRNGKey(1)
+    x_w = jax.random.randint(keyr, (w, n), 0, 2**31 - 1, dtype=jnp.int32).astype(jnp.uint32)
+    nbr_t = jax.random.randint(keyr, (k, n), 0, n, dtype=jnp.int32)
+    nbr = nbr_t.T                                       # [N, K]
+    x_nm = x_w.T                                        # [N, W] peer-major
+
+    def g_loop(xw, nt):
+        return jnp.stack([xw[i][nt] for i in range(w)])
+
+    def g_take3d(xw, nt):
+        return xw[:, nt]
+
+    def g_rows(xnm, nb):
+        return xnm[nb]                                  # [N, K, W]
+
+    t = timeit(jax.jit(g_loop), x_w, nbr_t)
+    print(f"gather per-word loop [W={w},K,N]:   {t*1e3:9.2f} ms")
+    t = timeit(jax.jit(g_take3d), x_w, nbr_t)
+    print(f"gather 3d take      [W={w},K,N]:   {t*1e3:9.2f} ms")
+    t = timeit(jax.jit(g_rows), x_nm, nbr)
+    print(f"gather rows [N,K,W] peer-major:    {t*1e3:9.2f} ms")
+
+    # edge_gather on [N, T, K]
+    x3 = jax.random.uniform(keyr, (n, cfg.n_topics, k)) > 0.5
+    t = timeit(jax.jit(lambda x, s: edge_gather(x, s)), x3, st)
+    print(f"edge_gather [N,T,K]:               {t*1e3:9.2f} ms")
+
+    # row-based edge gather: flatten (n,t,k) -> rows by neighbor, then pick
+    # reverse_slot via one-hot dot over K (K small) vs take_along_axis
+    def edge_rows(x, s):
+        jn = jnp.clip(s.neighbors, 0, n - 1)            # [N, K]
+        rows = x[jn]                                    # [N, K, T, K'] row gather
+        rk = jnp.clip(s.reverse_slot, 0, k - 1)
+        picked = jnp.take_along_axis(
+            rows, rk[:, :, None, None], axis=-1)[..., 0]  # [N, K, T]
+        valid = ((s.neighbors >= 0) & (s.reverse_slot >= 0))[:, :, None]
+        return jnp.where(valid, picked, False).transpose(0, 2, 1)
+
+    t = timeit(jax.jit(edge_rows), x3, st)
+    print(f"edge_gather row-form:              {t*1e3:9.2f} ms")
+
+    # one-hot matmul edge pick: rows[N,K,T,K'] dot onehot(rk)[N,K,K']
+    def edge_rows_oh(x, s):
+        jn = jnp.clip(s.neighbors, 0, n - 1)
+        rows = x[jn].astype(jnp.bfloat16)               # [N, K, T, K']
+        oh = jax.nn.one_hot(jnp.clip(s.reverse_slot, 0, k - 1), k,
+                            dtype=jnp.bfloat16)         # [N, K, K']
+        picked = jnp.einsum('nktj,nkj->nkt', rows, oh)
+        valid = ((s.neighbors >= 0) & (s.reverse_slot >= 0))[:, :, None]
+        return (picked > 0.5) & valid
+
+    t = timeit(jax.jit(edge_rows_oh), x3, st)
+    print(f"edge_gather row+onehot:            {t*1e3:9.2f} ms")
+
+
+if __name__ == "__main__":
+    main()
