@@ -1,0 +1,89 @@
+"""Multi-chip execution tests: the peer-sharded step on a real 8-device mesh.
+
+conftest.py provisions 8 virtual CPU devices; these tests actually EXECUTE
+``make_sharded_step`` over a ``jax.sharding.Mesh`` of all of them and assert
+the sharded trajectory equals the single-device one. This is the TPU-native
+replacement for the reference's per-peer comm layer (comm.go:44-191) — peers
+shard across devices, cross-shard mesh edges ride XLA collectives
+(SURVEY.md §2.3, §5.7-8).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.parallel.sharding import (
+    make_mesh, make_sharded_step, shard_state)
+from go_libp2p_pubsub_tpu.sim import SimConfig, TopicParams, init_state, topology
+from go_libp2p_pubsub_tpu.sim.engine import step_jit
+
+
+def _build(n_peers=64, k_slots=8, n_topics=2, msg_window=32):
+    cfg = SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=n_topics,
+        msg_window=msg_window, publishers_per_tick=2, prop_substeps=4,
+        scoring_enabled=True, behaviour_penalty_weight=-1.0,
+        gossip_threshold=-10.0, publish_threshold=-20.0,
+        graylist_threshold=-30.0)
+    tp = TopicParams.disabled(n_topics)
+    topo = topology.sparse(n_peers, k_slots, degree=4, seed=7)
+    st = init_state(cfg, topo)
+    return cfg, tp, st
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices (conftest XLA_FLAGS)")
+    return devs[:8]
+
+
+def test_sharded_step_matches_unsharded(eight_devices):
+    """Trajectory equality: 5 sharded ticks == 5 single-device ticks."""
+    cfg, tp, st = _build()
+    mesh = make_mesh(eight_devices)
+    sharded_step = make_sharded_step(mesh, cfg, tp)
+
+    st_sh = shard_state(st, mesh, cfg)
+    st_un = st
+    key = jax.random.PRNGKey(42)
+    for i in range(5):
+        key, k = jax.random.split(key)
+        st_sh = sharded_step(st_sh, k)
+        st_un = step_jit(st_un, cfg, tp, k)
+
+    for name, a, b in zip(st_un._fields, st_un, st_sh):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5,
+            err_msg=f"field {name} diverged between sharded and unsharded")
+
+
+def test_state_actually_sharded(eight_devices):
+    """Peer-major arrays are split across devices, not replicated."""
+    cfg, tp, st = _build()
+    mesh = make_mesh(eight_devices)
+    st_sh = shard_state(st, mesh, cfg)
+    shards = st_sh.mesh.addressable_shards
+    assert len(shards) == 8
+    per_dev = cfg.n_peers // 8
+    assert shards[0].data.shape[0] == per_dev
+    assert {s.device for s in shards} == set(eight_devices)
+
+
+def test_sharded_run_executes_collectives(eight_devices):
+    """The sharded step compiles to a program with cross-device comms (the
+    neighbor gathers span shards) and still advances state."""
+    cfg, tp, st = _build()
+    mesh = make_mesh(eight_devices)
+    sharded_step = make_sharded_step(mesh, cfg, tp)
+    st_sh = shard_state(st, mesh, cfg)
+    hlo = sharded_step.lower(st_sh, jax.random.PRNGKey(0)).compile().as_text()
+    assert any(op in hlo for op in
+               ("all-gather", "collective-permute", "all-to-all")), \
+        "sharded step compiled without any cross-device collectives"
+    out = sharded_step(st_sh, jax.random.PRNGKey(0))
+    assert int(out.tick) == 1
+    # degrees stay within capacity
+    assert int(jnp.max(jnp.sum(out.mesh, -1))) <= cfg.k_slots
