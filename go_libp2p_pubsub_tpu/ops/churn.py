@@ -31,19 +31,25 @@ import jax.numpy as jnp
 
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
-from .score_ops import apply_prune_penalty
+from .score_ops import apply_prune_penalty, compute_scores
+
+
+def _symmetric_value(state: SimState, x: jnp.ndarray) -> jnp.ndarray:
+    """[N, K] per-edge values made equal on both directions of each edge: the
+    lower-id endpoint's value wins, gathered through reverse_slot."""
+    n, k = state.neighbors.shape
+    nbr = jnp.clip(state.neighbors, 0, n - 1)
+    rk = jnp.clip(state.reverse_slot, 0, k - 1)
+    x_rev = x[nbr, rk]
+    mine_wins = jnp.arange(n)[:, None] < nbr
+    return jnp.where(mine_wins, x, x_rev)
 
 
 def _symmetric_uniform(state: SimState, key: jax.Array) -> jnp.ndarray:
     """[N, K] uniform draws equal on both directions of each edge: the draw of
     the lower-id endpoint wins, gathered through reverse_slot."""
     n, k = state.neighbors.shape
-    r = jax.random.uniform(key, (n, k))
-    nbr = jnp.clip(state.neighbors, 0, n - 1)
-    rk = jnp.clip(state.reverse_slot, 0, k - 1)
-    r_rev = r[nbr, rk]
-    mine_wins = jnp.arange(n)[:, None] < nbr
-    return jnp.where(mine_wins, r, r_rev)
+    return _symmetric_value(state, jax.random.uniform(key, (n, k)))
 
 
 def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
@@ -58,7 +64,27 @@ def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
     live = known & state.connected
 
     go_down = live & (_symmetric_uniform(state, kd) < cfg.churn_disconnect_prob)
-    come_up = down & (_symmetric_uniform(state, ku) < cfg.churn_reconnect_prob)
+    if cfg.px_enabled:
+        # PX-seeded reconnects (gossipsub.go:893-973): the dialing side only
+        # gets a PX referral for well-scored peers (handlePrune's
+        # AcceptPXThreshold gate, gossipsub.go:860-866); edges to peers it
+        # scores below the threshold come back at a fraction of the rate.
+        # The dialing endpoint is the same lower-id side that decides the
+        # symmetric draw, so edges stay symmetric.
+        scores = compute_scores(state, cfg, tp, mask_disconnected=False)
+        p_up = jnp.where(scores >= cfg.accept_px_threshold,
+                         cfg.churn_reconnect_prob,
+                         cfg.churn_reconnect_prob * cfg.px_low_score_factor)
+        p_up = _symmetric_value(state, p_up)
+    else:
+        p_up = cfg.churn_reconnect_prob
+    come_up = down & (_symmetric_uniform(state, ku) < p_up)
+    # direct peers are force-redialed on a fixed cadence regardless of churn
+    # (gossipsub.go:1648-1670 directConnect, every 300 ticks). The lower-id
+    # endpoint's direct flag decides, keeping `connected` edge-symmetric
+    # even if a scenario marks direct on one side only.
+    redial = (state.tick % cfg.direct_connect_ticks) == 0
+    come_up = come_up | (down & _symmetric_value(state, state.direct) & redial)
 
     # --- RemovePeer on edges going down (gossipsub.go:575-596) ---
     down3 = go_down[:, None, :]

@@ -1,0 +1,71 @@
+"""Batched peer gater: Random-Early-Drop admission over neighbor slots.
+
+Vectorized twin of routers/peer_gater.py (mirroring peer_gater.go:119-363):
+
+- Global per-receiver ``validate``/``throttle`` counters decay with
+  ``gater_global_decay``; per-source deliver/duplicate/ignore/reject stats
+  decay with ``gater_source_decay`` (peer_gater.go:219-259 ``decayStats``).
+- ``accept_data`` reproduces ``AcceptFrom`` (peer_gater.go:320-363): gate off
+  when quiet for ``gater_quiet_ticks``, throttle is zero, or
+  throttled/validated sits under ``gater_threshold``; otherwise admit data
+  with probability (1 + deliver) / (1 + weighted total) per source, else
+  strip to control-only (AcceptControl, gossipsub.go:604-608: the router
+  keeps processing IHAVE/GRAFT but drops the payloads).
+- The reference keys source stats by IP so colocated sybils share one stats
+  record; the sim keeps stats per neighbor slot (each sybil connection builds
+  its own record) and leaves colocation punishment to P6.
+
+Throttle events come from the validation admission cap
+(``validation_queue_cap``, modeling validation.go:246-260 drop-on-full),
+charged in ops/propagate.py where arrivals are counted.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..sim.config import SimConfig
+from ..sim.state import SimState
+
+
+def gater_decay(state: SimState, cfg: SimConfig) -> SimState:
+    """Per-tick stat decay (peer_gater.go:219-259); DecayInterval == 1 tick.
+
+    The reference skips decay for disconnected sources and expires their
+    stats after ``RetainStats``; the sim decays every slot uniformly — a
+    down slot's stats keep decaying toward zero, which is the same limit the
+    reference reaches by deletion.
+    """
+    z = cfg.decay_to_zero
+
+    def dec(v, factor):
+        v = v * factor
+        return jnp.where(v < z, 0.0, v)
+
+    return state._replace(
+        gater_validate=dec(state.gater_validate, cfg.gater_global_decay),
+        gater_throttle=dec(state.gater_throttle, cfg.gater_global_decay),
+        gater_deliver=dec(state.gater_deliver, cfg.gater_source_decay),
+        gater_duplicate=dec(state.gater_duplicate, cfg.gater_source_decay),
+        gater_ignore=dec(state.gater_ignore, cfg.gater_source_decay),
+        gater_reject=dec(state.gater_reject, cfg.gater_source_decay))
+
+
+def accept_data(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
+    """[N, K] bool: receiver n admits DATA from the peer in slot k this tick
+    (AcceptFrom, peer_gater.go:320-363). Control always flows."""
+    n, k = state.gater_deliver.shape
+    quiet = (state.tick - state.gater_last_throttle) > cfg.gater_quiet_ticks
+    ratio_low = (state.gater_validate != 0.0) & \
+        (state.gater_throttle / jnp.maximum(state.gater_validate, 1e-9)
+         < cfg.gater_threshold)
+    gate_off = quiet | (state.gater_throttle == 0.0) | ratio_low      # [N]
+
+    total = (state.gater_deliver
+             + cfg.gater_duplicate_weight * state.gater_duplicate
+             + cfg.gater_ignore_weight * state.gater_ignore
+             + cfg.gater_reject_weight * state.gater_reject)          # [N, K]
+    p = (1.0 + state.gater_deliver) / (1.0 + total)
+    draw = jax.random.uniform(key, (n, k)) < p
+    return gate_off[:, None] | (total == 0.0) | draw

@@ -131,8 +131,15 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     refuse = inc_graft & (~joined | backoff_active | (s < 0)
                           | ((mesh_count_pre >= cfg.dhi) & ~out3) | direct3)
     accept = inc_graft & ~refuse
-    # graft-during-backoff behaviour penalty (gossipsub.go:781-795)
-    bp_add = jnp.sum(inc_graft & backoff_active, axis=1).astype(jnp.float32)
+    # graft-during-backoff behaviour penalty (gossipsub.go:781-795): one
+    # point always, a second point when the GRAFT lands within the flood
+    # window right after the PRUNE that set the backoff (the reference
+    # checks elapsed < GraftFloodThreshold of the prune time; the backoff
+    # expiry tick minus its span recovers that prune tick)
+    prune_tick = state.backoff - cfg.prune_backoff_ticks
+    flood = backoff_active & (tick < prune_tick + cfg.graft_flood_ticks)
+    bp_add = jnp.sum(inc_graft & backoff_active, axis=1).astype(jnp.float32) \
+        + jnp.sum(inc_graft & flood, axis=1).astype(jnp.float32)
     behaviour_penalty = state.behaviour_penalty + bp_add
 
     refused_back = edge_gather(refuse, state)

@@ -57,18 +57,21 @@ from .bits import (
     reduce_or,
     unpack_words,
 )
+from . import gater
 from .heartbeat import edge_gather
 
 
 def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
-            topics: jnp.ndarray) -> SimState:
+            topics: jnp.ndarray, key: jax.Array | None = None) -> SimState:
     """Start ``P`` new messages this tick, rotating through message slots.
 
     publishers: [P] int32 peer ids; topics: [P] int32 topic ids. Slot reuse
     resets the per-peer seen state (the timecache TTL analogue: a slot lives
     msg_window // publishers_per_tick ticks). Publishers not subscribed to
     their topic stamp ``fanout_lastpub`` (gossipsub.go:1007-1018: publish to
-    fanout, record lastpub). Malicious publishers emit invalid messages.
+    fanout, record lastpub). Malicious publishers emit invalid messages;
+    a ``cfg.ignore_fraction`` of honest messages draw validation verdict
+    IGNORE (validation.go:344-370 ValidationIgnore).
     """
     p = publishers.shape[0]
     m = cfg.msg_window
@@ -77,6 +80,12 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
     msg_topic = state.msg_topic.at[slots].set(topics)
     msg_publish_tick = state.msg_publish_tick.at[slots].set(state.tick)
     msg_invalid = state.msg_invalid.at[slots].set(state.malicious[publishers])
+    if cfg.ignore_fraction > 0.0 and key is not None:
+        ign = (jax.random.uniform(key, (p,)) < cfg.ignore_fraction) \
+            & ~state.malicious[publishers]
+    else:
+        ign = jnp.zeros((p,), bool)
+    msg_ignored = state.msg_ignored.at[slots].set(ign)
     # reset recycled slots, then mark the publisher as having it
     have = state.have.at[:, slots].set(False)
     have = have.at[publishers, slots].set(True)
@@ -89,8 +98,9 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
     fanout_lastpub = state.fanout_lastpub.at[publishers, topics].set(
         jnp.where(sub_pub, cur_lp, state.tick))
     return state._replace(msg_topic=msg_topic, msg_publish_tick=msg_publish_tick,
-                          msg_invalid=msg_invalid, have=have,
-                          deliver_tick=deliver_tick, iwant_pending=iwant_pending,
+                          msg_invalid=msg_invalid, msg_ignored=msg_ignored,
+                          have=have, deliver_tick=deliver_tick,
+                          iwant_pending=iwant_pending,
                           fanout_lastpub=fanout_lastpub)
 
 
@@ -189,11 +199,28 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     ``prop_substeps`` forwarding hops, then emit this tick's IHAVE/IWANT.
 
     ``scores`` is the heartbeat's [N, K] score cache (receiver's score of the
-    peer in slot k), used for accept/gossip gating.
+    peer in slot k), used for accept/gossip gating. Admission control layers,
+    outermost first (matching handleIncomingRPC, pubsub.go:1029-1105):
+
+    1. graylist: score < graylist_threshold drops everything (AcceptFrom,
+       gossipsub.go:598-609);
+    2. peer gater RED drop (``cfg.gater_enabled``): data stripped to
+       control-only per ops/gater.py (peer_gater.go:320-363);
+    3. per-edge queue capacity (``cfg.edge_queue_cap``): a hop whose RPC
+       would exceed the edge's per-tick message budget is dropped whole
+       (comm.go:156-191 drop-on-full, traced gossipsub.go:1195-1202);
+    4. validation admission (``cfg.validation_queue_cap``): new arrivals
+       beyond the per-receiver budget are throttled — dropped unseen and
+       charged to the gater throttle stat (validation.go:246-260).
+
+    Validation verdicts: ACCEPT delivers + forwards; REJECT marks seen +
+    counts P4 + gater reject; IGNORE marks seen only + gater ignore
+    (validation.go:344-370).
     """
     n, t, k = state.mesh.shape
     m = cfg.msg_window
     w = n_words(m)
+    k_fwd, k_gate = jax.random.split(key)
     nbr_t = jnp.clip(state.neighbors, 0, n - 1).T              # [K, N]
     mal = state.malicious
 
@@ -207,11 +234,15 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                            & live_topic[None, :])
     alive_bits = pack_bool(alive[None, :])[0]                           # [W]
     invalid_bits = pack_bool((state.msg_invalid & alive)[None, :])[0]
-    valid_msg_bits = alive_bits & ~invalid_bits
-    # per-receiver acceptance: honest peers reject invalid messages
-    # (validation.go:293-370); malicious receivers accept + forward anything
+    ignored_bits = pack_bool((state.msg_ignored & alive)[None, :])[0]
+    valid_msg_bits = alive_bits & ~invalid_bits & ~ignored_bits
+    # per-receiver deliverability: honest peers deliver only ACCEPT-verdict
+    # messages (validation.go:293-370); malicious receivers accept + forward
+    # anything. P4 charges REJECT only; IGNORE is seen-not-delivered.
     vm = jnp.where(mal[None, :], alive_bits[:, None],
                    valid_msg_bits[:, None])                             # [W,N]
+    inv_n = jnp.where(mal[None, :], U32(0), invalid_bits[:, None])      # [W,N]
+    ign_n = jnp.where(mal[None, :], U32(0), ignored_bits[:, None])      # [W,N]
 
     have_bits = pack_words(state.have)                                  # [W,N]
     dlv_bits = pack_words(state.deliver_tick < NEVER)                   # [W,N]
@@ -224,6 +255,13 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     else:
         accept_ok = jnp.ones((n, k), bool)
         gossip_ok = jnp.ones((n, k), bool)
+    # gater RED admission for DATA (control still flows); malicious
+    # receivers run no gater of their own
+    if cfg.gater_enabled:
+        data_ok = accept_ok & (gater.accept_data(state, cfg, k_gate)
+                               | mal[:, None])
+    else:
+        data_ok = accept_ok
 
     fmd_add = jnp.zeros((n, t, k), jnp.float32)
     mmd_add = jnp.zeros((n, t, k), jnp.float32)
@@ -235,59 +273,111 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     asked_k = _slot_bitplanes(state.iwant_pending, k) & alive_bits[:, None, None]
     # malicious sources never answer IWANTs (the iwantEverything-style actor
     # holds its promises open, gossipsub_spam_test.go:23-133); honest sources
-    # answer from their mcache, which rejected messages never enter
+    # answer from their mcache, which rejected/ignored messages never enter
     # (deliver_tick stays NEVER on rejection — validation.go:293-370)
     answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)             # [W,N]
     answers_k = _gather_words(answer_bits, nbr_t)                       # [W,K,N]
-    got_k = asked_k & answers_k & ~have_bits[:, None, :]
+    # pulled data is still data: graylist + gater admission apply
+    adm_kn = jnp.where(data_ok.T[None, :, :], U32(0xFFFFFFFF), U32(0))
+    got_k = asked_k & answers_k & ~have_bits[:, None, :] & adm_kn
     broken_k = asked_k & ~answers_k
     got_any = reduce_or(got_k, axis=1)                                  # [W,N]
-    # pulls cannot yield invalid messages (see above), so they are deliveries
-    for ti in range(t):
-        fmd_add = fmd_add.at[:, ti, :].add(
-            popcount_sum(got_k & topic_bits[ti][:, None, None], axis=0).T)
+    # pulled messages still go through the receiver's validation: deliver on
+    # ACCEPT, seen-only on IGNORE (an honest publisher answers pulls for its
+    # own ignore-class message), P4 on REJECT (unreachable in practice:
+    # rejecting answerers are malicious and never answer)
+    got_valid = got_k & vm[:, None, :]
+    got_valid_any = reduce_or(got_valid, axis=1)
     # broken promises: one penalty point per unfulfilled message id
     # (gossip_tracer.go:79-115, applied gossipsub.go:1620-1625)
     behaviour_penalty = state.behaviour_penalty + \
         popcount_sum(broken_k, axis=0).T
     have_bits = have_bits | got_any
-    dlv_bits = dlv_bits | got_any
+    dlv_bits = dlv_bits | got_valid_any
+
+    # per-tick admission budgets, seeded with the IWANT pulls
+    pull_per_edge = popcount_sum(got_k, axis=0, dtype=jnp.int32)        # [K,N]
+    edge_used = pull_per_edge                                           # [K,N]
+    arrivals = popcount_sum(got_any, axis=0, dtype=jnp.int32)           # [N]
+    throttled = jnp.zeros((n,), jnp.int32)
+    validated = arrivals.astype(jnp.float32)
 
     # -- step 2: eager forwarding, prop_substeps hops, fully bit-packed --
-    fwd_mask = _edge_forward_mask(state, cfg, key)
-    fwd_mask = fwd_mask & accept_ok[:, None, :]
+    fwd_mask = _edge_forward_mask(state, cfg, k_fwd)
+    fwd_mask = fwd_mask & data_ok[:, None, :]
     allowed = _edge_topic_bits(fwd_mask, topic_bits, w)                 # [W,K,N]
     mesh_eb = _edge_topic_bits(state.mesh, topic_bits, w)               # [W,K,N]
+
+    # P3 duplicate-credit window (score.go:949-981): past deliveries stay
+    # creditable for mesh_message_deliveries_window_ticks (default 0 = this
+    # tick only; the reference default window is 10ms << 1 heartbeat)
+    age_dlv = state.tick - state.deliver_tick
+    window_old = pack_words((age_dlv >= 0)
+                            & (age_dlv <= cfg.mesh_message_deliveries_window_ticks))
 
     # frontier: messages that entered this peer THIS tick (fresh publishes and
     # IWANT pulls above); peers forward a message exactly one hop after they
     # first receive it, so the per-tick event sets below are disjoint across
-    # hops and OR-accumulation counts each event exactly once
-    frontier = pack_words(state.deliver_tick == state.tick) | got_any   # [W,N]
-    nv_acc = jnp.zeros((w, k, n), U32)     # first-delivery events, per slot
-    ni_acc = jnp.zeros((w, k, n), U32)     # invalid-delivery events, per slot
+    # hops and OR-accumulation counts each event exactly once. Accumulators
+    # are seeded with the pull events so pulls share the attribution path.
+    frontier = pack_words(state.deliver_tick == state.tick) | got_valid_any
+    dlv_new = got_valid_any                # deliveries accumulated this tick
+    nv_acc = got_valid                     # first-delivery events, per slot
+    ni_acc = got_k & inv_n[:, None, :]     # reject (P4) events, per slot
+    ig_acc = got_k & ign_n[:, None, :]     # ignore events, per slot
     dup_acc = jnp.zeros((w, k, n), U32)    # mesh-duplicate events, per slot
+    gdup_acc = jnp.zeros((w, k, n), U32)   # any-duplicate events (gater)
 
     for _hop in range(cfg.prop_substeps):
         offered = _gather_words(frontier, nbr_t) & allowed              # [W,K,N]
+        if cfg.edge_queue_cap > 0:
+            # drop-on-full, whole-RPC granularity (comm.go:156-191): the
+            # hop's RPC on an edge either fits the remaining budget or drops
+            rpc_size = popcount_sum(offered, axis=0, dtype=jnp.int32)   # [K,N]
+            edge_fits = (edge_used + rpc_size) <= cfg.edge_queue_cap
+            offered = jnp.where(edge_fits[None, :, :], offered, U32(0))
+            edge_used = edge_used + jnp.where(edge_fits, rpc_size, 0)
         excl = exclusive_prefix_or(offered, axis=1)
         new_from_k = offered & ~excl & ~have_bits[:, None, :]
         new_any = (excl[:, -1] | offered[:, -1]) & ~have_bits           # [W,N]
+        if cfg.validation_queue_cap > 0:
+            # validation admission (validation.go:246-260): a receiver whose
+            # budget this hop's arrivals would blow drops them unseen
+            cnt = popcount_sum(new_any, axis=0, dtype=jnp.int32)        # [N]
+            fits = (arrivals + cnt) <= cfg.validation_queue_cap
+            fit_m = jnp.where(fits, U32(0xFFFFFFFF), U32(0))[None, :]
+            new_any = new_any & fit_m
+            new_from_k = new_from_k & fit_m[:, None, :]
+            arrivals = arrivals + jnp.where(fits, cnt, 0)
+            throttled = throttled + jnp.where(fits, 0, cnt)
+            validated = validated + jnp.where(fits, cnt, 0).astype(jnp.float32)
+        elif cfg.gater_enabled:
+            # unbounded queue: everything admitted still counts as validated
+            # (peer_gater.go:404-407 ValidateMessage fires per admitted msg)
+            validated = validated + popcount_sum(new_any, axis=0)
         new_valid = new_any & vm
         nv_acc = nv_acc | (new_from_k & vm[:, None, :])
-        ni_acc = ni_acc | (new_from_k & ~vm[:, None, :])
-        # mesh-delivery credit: any mesh sender of a message I (now) hold
-        # valid — covers first-in-mesh (score.go:938-947) and same-window
-        # duplicates (score.go:949-981; window < 1 tick -> same tick).
-        # Invalid messages never earn MMD, including for malicious
-        # receivers who "deliver" them: an adversary's own counters about
-        # its neighbors are never consulted by honest-peer defenses, and
-        # the reference's spam actors run no scoring at all
-        # (gossipsub_spam_test.go drives raw streams)
-        elig = (dlv_bits | new_valid) & valid_msg_bits[:, None]
+        ni_acc = ni_acc | (new_from_k & inv_n[:, None, :])
+        ig_acc = ig_acc | (new_from_k & ign_n[:, None, :])
+        # mesh-delivery credit: any mesh sender of a message I hold valid
+        # within the credit window — covers first-in-mesh (score.go:938-947)
+        # and windowed duplicates (score.go:949-981). Invalid messages never
+        # earn MMD, including for malicious receivers who "deliver" them: an
+        # adversary's own counters about its neighbors are never consulted
+        # by honest-peer defenses, and the reference's spam actors run no
+        # scoring at all (gossipsub_spam_test.go drives raw streams)
+        elig = (window_old | dlv_new | new_valid) & valid_msg_bits[:, None]
         dup_acc = dup_acc | (offered & mesh_eb & elig[:, None, :])
+        # gater duplicate stat: any offer of a message already seen OR won by
+        # another slot this same hop (pubsub.go:1145-1148 seen-cache hit ->
+        # DuplicateMessage; same-hop losers hit the cache the moment the
+        # winner marks it). Throttle-dropped arrivals were never marked seen,
+        # so their re-offers are not duplicates — new_any is post-throttle.
+        gdup_acc = gdup_acc | (offered & ~new_from_k
+                               & (have_bits | new_any)[:, None, :])
         have_bits = have_bits | new_any
         dlv_bits = dlv_bits | new_valid
+        dlv_new = dlv_new | new_valid
         frontier = new_valid
 
     for ti in range(t):
@@ -316,6 +406,22 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         behaviour_penalty=behaviour_penalty,
         delivered_total=state.delivered_total + delivered)
 
+    if cfg.gater_enabled:
+        # stat attribution where the reference's RawTracer hooks fire
+        # (peer_gater.go:366-453): deliver on first delivery (pulls included
+        # via the seeded accumulators), duplicate on seen-cache hits,
+        # ignore/reject on validation outcomes, throttle from the admission
+        # budget above
+        state = state._replace(
+            gater_deliver=state.gater_deliver + popcount_sum(nv_acc, axis=0).T,
+            gater_duplicate=state.gater_duplicate + popcount_sum(gdup_acc, axis=0).T,
+            gater_ignore=state.gater_ignore + popcount_sum(ig_acc, axis=0).T,
+            gater_reject=state.gater_reject + popcount_sum(ni_acc, axis=0).T,
+            gater_validate=state.gater_validate + validated,
+            gater_throttle=state.gater_throttle + throttled.astype(jnp.float32),
+            gater_last_throttle=jnp.where(throttled > 0, state.tick,
+                                          state.gater_last_throttle))
+
     # -- step 3: IHAVE/IWANT for next tick (gossipsub.go:1711-1775) --
     # receiver view of gossip edges: slot s's peer gossips topic t to me;
     # ignore IHAVE from senders I score below the gossip threshold
@@ -331,9 +437,10 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     gossip_allowed = _edge_topic_bits(inc_gossip, topic_bits, w)        # [W,K,N]
     offer = _gather_words(window_bits, nbr_t) & gossip_allowed
     if cfg.max_iwant_per_tick >= m:
-        # a sender can offer at most M ids, so the budget cannot bind: pick
-        # the lowest offering slot per message (deterministic stand-in for
-        # the reference's random IWANT pick, gossip_tracer.go:53)
+        # a sender can offer at most M ids per tick, so the iasked budget
+        # cannot bind: pick the lowest offering slot per message
+        # (deterministic stand-in for the reference's random IWANT pick,
+        # gossip_tracer.go:53)
         excl = exclusive_prefix_or(offer, axis=1)
         chosen_k = offer & ~excl & ~have_bits[:, None, :]
         iwant_pending = _bits_to_slot(chosen_k, m)
@@ -342,20 +449,35 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         # budget caps ids asked from each advertiser within a heartbeat, and
         # an id advertised by a second peer with headroom is still pulled
         # from that peer, so one flooder cannot starve honest pulls
-        # (gossipsub.go:654-676). Exact sequential selection, only on this
-        # adversarial-config path.
-        offer_u = jnp.moveaxis(unpack_words(offer.reshape(w, k * n), m)
-                               .reshape(k, n, m), 0, 1)                 # [N,K,M]
-        offer_u = offer_u & ~state.have[:, None, :]
-
-        def pick(asked_ct, off_m):                                      # [N,K]
-            avail = off_m & (asked_ct < cfg.max_iwant_per_tick)
-            slot = jnp.argmax(avail, axis=1).astype(jnp.int32)          # [N]
-            take = jnp.any(avail, axis=1)
-            oh = jax.nn.one_hot(slot, k, dtype=jnp.int32) * take[:, None]
-            return asked_ct + oh, jnp.where(take, slot, -1)
-
-        _, pend_t = jax.lax.scan(pick, jnp.zeros((n, k), jnp.int32),
-                                 jnp.moveaxis(offer_u, -1, 0))
-        iwant_pending = jnp.moveaxis(pend_t, 0, -1)                     # [N,M]
+        # (gossipsub.go:654-676). Vectorized over messages: a K-step scan
+        # assigns each wanted id to its lowest offering slot with budget
+        # headroom (slot-order tie-break as everywhere in the engine).
+        iwant_pending = _budgeted_iwant(offer, have_bits, m,
+                                        cfg.max_iwant_per_tick)
+    # the per-tick peerhave cap (MaxIHaveMessages=10, gossipsub.go:630-652)
+    # is structurally satisfied: an edge carries at most one IHAVE per tick
     return state._replace(iwant_pending=iwant_pending)
+
+
+def _budgeted_iwant(offer: jnp.ndarray, have_bits: jnp.ndarray, m: int,
+                    budget: int) -> jnp.ndarray:
+    """[W,K,N] packed offers -> [N,M] chosen slot per message (or -1), asking
+    at most ``budget`` ids from any single slot (the iasked counter,
+    gossipsub.go:654-676). Scans the K slot axis (K is small and static);
+    each step ranks the slot's still-unassigned offers and takes the first
+    ``budget`` by message index."""
+    w, k, n = offer.shape
+
+    def pick(carry, off_k):                       # off_k: [W, N]
+        assigned, pend, slot_idx = carry
+        off_u = unpack_words(off_k & ~assigned, m)                # [N, M]
+        rank = jnp.cumsum(off_u.astype(jnp.int32), axis=1)
+        take = off_u & (rank <= budget)
+        pend = jnp.where(take, slot_idx, pend)
+        assigned = assigned | pack_words(take)
+        return (assigned, pend, slot_idx + 1), None
+
+    pend0 = jnp.full((n, m), -1, jnp.int32)
+    (_, pend, _), _ = jax.lax.scan(
+        pick, (have_bits, pend0, jnp.int32(0)), jnp.moveaxis(offer, 1, 0))
+    return pend

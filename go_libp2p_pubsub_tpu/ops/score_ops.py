@@ -14,10 +14,13 @@ from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
 
 
-def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams) -> jnp.ndarray:
+def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams,
+                   mask_disconnected: bool = True) -> jnp.ndarray:
     """Score of the peer in slot k as seen by observer n -> [N, K] f32.
 
-    Mirrors score.go:265-342; disconnected/empty slots score 0.
+    Mirrors score.go:265-342; disconnected/empty slots score 0 unless
+    ``mask_disconnected=False``, which exposes the retained counters of down
+    edges (score.go:611-644 RetainScore — used by the PX reconnect gate).
     """
     if not cfg.scoring_enabled:
         return jnp.zeros(state.behaviour_penalty.shape, jnp.float32)
@@ -65,7 +68,9 @@ def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams) -> jnp.ndar
         excess = state.behaviour_penalty - cfg.behaviour_penalty_threshold
         score += jnp.where(excess > 0, excess * excess, 0.0) * cfg.behaviour_penalty_weight
 
-    return jnp.where(state.connected, score, 0.0)
+    if mask_disconnected:
+        return jnp.where(state.connected, score, 0.0)
+    return jnp.where(state.neighbors >= 0, score, 0.0)
 
 
 def decay_counters(state: SimState, cfg: SimConfig, tp: TopicParams) -> SimState:

@@ -52,8 +52,14 @@ def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
         backoff=(3, True), graft_tick=(3, True), mesh_active=(3, True),
         first_message_deliveries=(3, True), mesh_message_deliveries=(3, True),
         mesh_failure_penalty=(3, True), invalid_message_deliveries=(3, True),
-        behaviour_penalty=(2, True), msg_topic=(1, False),
+        behaviour_penalty=(2, True),
+        gater_validate=(1, True), gater_throttle=(1, True),
+        gater_last_throttle=(1, True), gater_deliver=(2, True),
+        gater_duplicate=(2, True), gater_ignore=(2, True),
+        gater_reject=(2, True),
+        msg_topic=(1, False),
         msg_publish_tick=(1, False), msg_invalid=(1, False),
+        msg_ignored=(1, False),
         have=(2, True), deliver_tick=(2, True),
         iwant_pending=(2, True), delivered_total=(0, False),
     )

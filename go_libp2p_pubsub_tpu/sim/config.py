@@ -86,10 +86,44 @@ class SimConfig:
 
     scoring_enabled: bool = True
 
+    # --- peer gater (peer_gater.go:19-116), ticks domain; off by default so
+    # non-gater configs compile the same op graph (RNG streams shifted by
+    # the extra key splits, so trajectories differ from round-1 builds) ---
+    gater_enabled: bool = False
+    gater_threshold: float = 0.33          # throttled/validated RED trigger
+    gater_global_decay: float = 0.9928     # ScoreParameterDecay(2 min) @ 1s ticks
+    gater_source_decay: float = 0.999808   # ScoreParameterDecay(1 hour)
+    gater_quiet_ticks: int = 60            # auto-off after quiet period
+    gater_duplicate_weight: float = 0.125
+    gater_ignore_weight: float = 1.0
+    gater_reject_weight: float = 16.0
+    # validation pipeline admission cap (validation.go:13-17 queue sizes):
+    # max NEW messages a receiver admits per tick; excess is throttled —
+    # dropped unseen and counted into the gater's throttle stat
+    # (validation.go:246-260 Push drop-on-full). 0 = unbounded.
+    validation_queue_cap: int = 0
+    # fraction of honest publishes whose validation verdict is IGNORE
+    # (validation.go:344-370 ValidationIgnore: dropped + marked seen, no P4)
+    ignore_fraction: float = 0.0
+    # per-edge data-plane capacity (comm.go:156-191: the 32-RPC per-peer
+    # queue, drop-on-full traced at gossipsub.go:1195-1202): max messages an
+    # edge carries per tick; a hop whose RPC would blow the budget is dropped
+    # whole (the reference drops entire RPCs). 0 = unbounded.
+    edge_queue_cap: int = 0
+
     # connection churn per tick (0.0 = off; ops/churn.py). Models the
     # dead-peer / reconnect lifecycle (pubsub.go:711-757, notify.go:11-75).
     churn_disconnect_prob: float = 0.0
     churn_reconnect_prob: float = 0.0
+    # PX-seeded reconnects (gossipsub.go:893-973 pxConnect): a down edge
+    # whose remote side the reconnecting peer scores >= accept_px_threshold
+    # reconnects at churn_reconnect_prob (a PX referral re-seeds the dial);
+    # below-threshold edges fall back to px_low_score_factor of that rate
+    # (no referral — only slow ambient discovery brings them back).
+    px_enabled: bool = False
+    px_low_score_factor: float = 0.1
+    # forced redial cadence for direct peers (gossipsub.go:1648-1670), ticks
+    direct_connect_ticks: int = 300
 
     @staticmethod
     def from_params(n_peers: int, k_slots: int, n_topics: int = 1,

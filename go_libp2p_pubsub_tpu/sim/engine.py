@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.churn import churn_edges
+from ..ops.gater import gater_decay
 from ..ops.heartbeat import heartbeat
 from ..ops.propagate import forward_tick, publish
 from ..ops.score_ops import decay_counters
@@ -49,12 +50,14 @@ def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
 
 def step(state: SimState, cfg: SimConfig, tp: TopicParams,
          key: jax.Array) -> SimState:
-    k_pub, k_hb, k_fwd, k_churn = jax.random.split(key, 4)
+    k_pub, k_hb, k_fwd, k_churn, k_ign = jax.random.split(key, 5)
     if cfg.churn_disconnect_prob > 0.0:
         state = churn_edges(state, cfg, tp, k_churn)
     peers, topics = choose_publishers(state, cfg, k_pub)
-    state = publish(state, cfg, peers, topics)
+    state = publish(state, cfg, peers, topics, k_ign)
     state = decay_counters(state, cfg, tp)
+    if cfg.gater_enabled:
+        state = gater_decay(state, cfg)
     hb = heartbeat(state, cfg, tp, k_hb)
     state = forward_tick(hb.state, cfg, tp, hb.gossip_sel, hb.scores, k_fwd)
     return state._replace(tick=state.tick + 1)

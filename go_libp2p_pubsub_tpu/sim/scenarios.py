@@ -127,7 +127,12 @@ def churn_50k(n_peers: int = 50_000, k_slots: int = 32, degree: int = 12,
         behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
         publish_threshold=-200.0, graylist_threshold=-300.0,
         retain_score_ticks=30, churn_disconnect_prob=disconnect_prob,
-        churn_reconnect_prob=reconnect_prob)
+        churn_reconnect_prob=reconnect_prob,
+        # BASELINE config #3 names "peer_gater + backoff churn": RED
+        # admission on validation overload (peer_gater.go) + PX-seeded
+        # reconnects (gossipsub.go:893-973)
+        gater_enabled=True, validation_queue_cap=64,
+        px_enabled=True, accept_px_threshold=-50.0)
     topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
     return cfg, default_topic_params(n_topics), \
         init_state(cfg, topo, subscribed=subscribed)
@@ -158,7 +163,12 @@ def sybil_100k(n_peers: int = 100_000, k_slots: int = 32, degree: int = 12,
         ip_colocation_factor_weight=-50.0, ip_colocation_factor_threshold=4,
         n_ip_groups=int(ip_group.max()) + 1,
         gossip_threshold=-10.0, publish_threshold=-50.0,
-        graylist_threshold=-100.0)
+        graylist_threshold=-100.0,
+        # churn + PX: honest peers reconnect preferentially to peers they
+        # score above the PX threshold, so the honest mesh heals while
+        # graylisted sybil edges decay (gossipsub.go:893-973)
+        churn_disconnect_prob=0.01, churn_reconnect_prob=0.2,
+        px_enabled=True, accept_px_threshold=-5.0)
     topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
     return cfg, default_topic_params(1), \
         init_state(cfg, topo, malicious=malicious, ip_group=ip_group)

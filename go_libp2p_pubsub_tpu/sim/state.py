@@ -63,11 +63,26 @@ class SimState(NamedTuple):
     invalid_message_deliveries: jnp.ndarray # [N, T, K] f32
     behaviour_penalty: jnp.ndarray    # [N, K] f32
 
+    # --- peer gater (peer_gater.go:119-151) ---
+    # global per-receiver counters; per-source stats live per neighbor slot
+    # (the reference keys them by source IP: slots sharing an IP share stats
+    # there; the sim keeps them per-slot and leans on P6 for colocation)
+    gater_validate: jnp.ndarray       # [N] f32 validated count (global)
+    gater_throttle: jnp.ndarray       # [N] f32 throttled count (global)
+    gater_last_throttle: jnp.ndarray  # [N] int32 tick of last throttle event
+    gater_deliver: jnp.ndarray        # [N, K] f32
+    gater_duplicate: jnp.ndarray      # [N, K] f32
+    gater_ignore: jnp.ndarray         # [N, K] f32
+    gater_reject: jnp.ndarray         # [N, K] f32
+
     # --- message window (rotating slots) ---
     msg_topic: jnp.ndarray            # [M] int32 topic of message slot, -1 idle
     msg_publish_tick: jnp.ndarray     # [M] int32
     msg_invalid: jnp.ndarray          # [M] bool: fails validation (honest
                                       #   receivers reject + count P4)
+    msg_ignored: jnp.ndarray          # [M] bool: validation verdict IGNORE
+                                      #   (dropped + seen, no P4, gater
+                                      #   counts ignore — validation.go:344-370)
     have: jnp.ndarray                 # [N, M] bool (seen/validated)
     deliver_tick: jnp.ndarray         # [N, M] int32, NEVER if not delivered
     iwant_pending: jnp.ndarray        # [N, M] int32 source peer for pending
@@ -114,9 +129,17 @@ def init_state(cfg: SimConfig, topo: Topology,
         mesh_failure_penalty=f32(n, t, k),
         invalid_message_deliveries=f32(n, t, k),
         behaviour_penalty=f32(n, k),
+        gater_validate=f32(n),
+        gater_throttle=f32(n),
+        gater_last_throttle=i32(n, fill=-int(NEVER)),
+        gater_deliver=f32(n, k),
+        gater_duplicate=f32(n, k),
+        gater_ignore=f32(n, k),
+        gater_reject=f32(n, k),
         msg_topic=i32(m, fill=-1),
         msg_publish_tick=i32(m, fill=int(NEVER)),
         msg_invalid=b(m),
+        msg_ignored=b(m),
         have=b(n, m),
         deliver_tick=i32(n, m, fill=int(NEVER)),
         iwant_pending=i32(n, m, fill=-1),

@@ -133,3 +133,85 @@ class TestScenarios:
         edge_to_honest = ~mal[nbr] & np.asarray(st.connected) & honest_obs[:, None]
         assert scores[edge_to_sybil].mean() < scores[edge_to_honest].mean()
         assert scores[edge_to_sybil].mean() < 0
+
+
+class TestPXAndDirectConnect:
+    """PX-seeded reconnects (gossipsub.go:893-973) and the forced direct-peer
+    redial cadence (gossipsub.go:1648-1670) in the batched churn path."""
+
+    def test_px_reconnect_prefers_high_score(self):
+        cfg = cfg_with_churn(
+            churn_disconnect_prob=0.0, churn_reconnect_prob=0.3,
+            px_enabled=True, accept_px_threshold=0.0, px_low_score_factor=0.0,
+            scoring_enabled=True, app_specific_weight=1.0)
+        topo = topology.dense(cfg.n_peers, cfg.k_slots, degree=10)
+        tp = TopicParams.disabled(cfg.n_topics)
+        # half the peers score below the PX threshold via app score
+        app = np.where(np.arange(cfg.n_peers) % 2 == 0, 1.0, -1.0
+                       ).astype(np.float32)
+        st = init_state(cfg, topo, app_score=app)
+        # take every edge down
+        st = st._replace(connected=jnp.zeros_like(st.connected),
+                         disconnect_tick=jnp.zeros_like(st.disconnect_tick))
+        key = jax.random.PRNGKey(3)
+        for i in range(20):
+            key, k = jax.random.split(key)
+            st = churn_edges(st, cfg, tp, k)
+        conn = np.asarray(st.connected)
+        nbr = np.asarray(st.neighbors)
+        known = nbr >= 0
+        # the dial decision belongs to the lower-id endpoint (the symmetric-
+        # edge tie-break): its rating of the other end sets the probability
+        from go_libp2p_pubsub_tpu.ops.churn import _symmetric_value
+        rated_good = np.asarray(_symmetric_value(
+            st, jnp.asarray((np.clip(nbr, 0, None) % 2 == 0))))
+        referred = known & rated_good      # dialer got a PX referral
+        shunned = known & ~rated_good      # below threshold: factor 0.0
+        assert conn[referred].mean() > 0.9, conn[referred].mean()
+        assert not conn[shunned].any()
+
+    def test_direct_edges_force_redial(self):
+        cfg = cfg_with_churn(churn_disconnect_prob=0.0,
+                             churn_reconnect_prob=0.0,
+                             direct_connect_ticks=4)
+        topo = topology.dense(cfg.n_peers, cfg.k_slots, degree=10)
+        tp = TopicParams.disabled(cfg.n_topics)
+        st = init_state(cfg, topo)
+        direct = st.connected & (jax.random.uniform(
+            jax.random.PRNGKey(5), st.connected.shape) < 0.3)
+        # make direct symmetric the way WithDirectPeers is (both sides list
+        # each other, gossipsub.go:331-344)
+        from go_libp2p_pubsub_tpu.ops.churn import _symmetric_value
+        direct = _symmetric_value(st, direct)
+        st = st._replace(direct=direct,
+                         connected=jnp.zeros_like(st.connected),
+                         disconnect_tick=jnp.zeros_like(st.disconnect_tick))
+        # off-cadence tick: nothing comes back
+        st = st._replace(tick=jnp.int32(3))
+        st1 = churn_edges(st, cfg, tp, jax.random.PRNGKey(6))
+        assert not bool(jnp.any(st1.connected))
+        # on-cadence tick: exactly the direct edges return
+        st = st._replace(tick=jnp.int32(4))
+        st2 = churn_edges(st, cfg, tp, jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(
+            np.asarray(st2.connected),
+            np.asarray(direct & (st.neighbors >= 0)))
+
+    def test_sybil_mesh_heals_honest_side(self):
+        """Toy sybil_100k shape: under churn with PX, honest peers keep their
+        honest-edge connectivity while sybil edges wither."""
+        import go_libp2p_pubsub_tpu.sim.scenarios as sc
+        cfg, tp, st = sc.sybil_100k(n_peers=256, k_slots=16, degree=8,
+                                    sybil_fraction=0.25, n_sybil_ips=4)
+        st = run(st, cfg, tp, jax.random.PRNGKey(11), 60)
+        mal = np.asarray(st.malicious)
+        nbr = np.clip(np.asarray(st.neighbors), 0, cfg.n_peers - 1)
+        known = np.asarray(st.neighbors) >= 0
+        conn = np.asarray(st.connected)
+        hon = ~mal
+        hh = known[hon] & ~mal[nbr[hon]]
+        hs = known[hon] & mal[nbr[hon]]
+        up_hh = conn[hon][hh].mean()
+        up_hs = conn[hon][hs].mean()
+        assert up_hh > 0.85, up_hh          # honest mesh healed
+        assert up_hs < up_hh, (up_hs, up_hh)

@@ -242,3 +242,40 @@ class TestFanout:
             st = self._tick(st, cfg, tp, jax.random.PRNGKey(i))
         assert np.asarray(st.fanout)[0, 0].sum() == 0
         assert int(st.fanout_lastpub[0, 0]) >= 2**30
+
+
+class TestGraftFloodPenalty:
+    """GRAFT during backoff: one P7 point, doubled when the GRAFT lands
+    within GraftFloodThreshold of the PRUNE (gossipsub.go:781-795)."""
+
+    def _two_peer(self, tick, prune_tick):
+        cfg = SimConfig(n_peers=2, k_slots=2, n_topics=1, msg_window=8,
+                        publishers_per_tick=1, prop_substeps=1,
+                        scoring_enabled=True,
+                        prune_backoff_ticks=60, graft_flood_ticks=10)
+        topo = topology.full(2, 2)
+        st = init_state(cfg, topo)
+        # peer 0 holds a backoff against peer 1 (slot of 1 in 0's table),
+        # set by a prune at prune_tick; peer 1's mesh is empty so its
+        # heartbeat grafts peer 0
+        slot01 = int(np.argwhere(np.asarray(st.neighbors[0]) == 1)[0, 0])
+        st = st._replace(
+            tick=jnp.int32(tick),
+            backoff=st.backoff.at[0, 0, slot01].set(prune_tick + 60))
+        return cfg, st, slot01
+
+    def test_flood_window_doubles_penalty(self):
+        # prune at 95 -> backoff till 155, flood window till 105
+        cfg, st, slot01 = self._two_peer(tick=100, prune_tick=95)
+        out = heartbeat(st, cfg, TopicParams.disabled(1), jax.random.PRNGKey(0))
+        assert float(out.state.behaviour_penalty[0, slot01]) == 2.0
+
+    def test_late_graft_single_penalty(self):
+        cfg, st, slot01 = self._two_peer(tick=120, prune_tick=95)
+        out = heartbeat(st, cfg, TopicParams.disabled(1), jax.random.PRNGKey(0))
+        assert float(out.state.behaviour_penalty[0, slot01]) == 1.0
+
+    def test_expired_backoff_no_penalty(self):
+        cfg, st, slot01 = self._two_peer(tick=200, prune_tick=95)
+        out = heartbeat(st, cfg, TopicParams.disabled(1), jax.random.PRNGKey(0))
+        assert float(out.state.behaviour_penalty[0, slot01]) == 0.0
