@@ -1,13 +1,23 @@
-"""Headline benchmark: simulated gossipsub heartbeats/sec at large N.
+"""Benchmarks: simulated gossipsub heartbeats/sec across the BASELINE configs.
 
 Runs the full batched network step (publish + decay + heartbeat mesh
 maintenance + scoring + propagation + gossip) on the default accelerator and
-prints ONE JSON line. ``vs_baseline`` is value / 1000 — the BASELINE.json
+prints ONE JSON line per config — the headline 100k-peer default-gossipsub
+line prints LAST. ``vs_baseline`` is value / 1000, the BASELINE.json
 north-star target of >= 1000 full-network heartbeats/sec at 100k peers
 (the reference router runs 1 heartbeat/sec/node in real time and publishes
 no benchmarks; see BASELINE.md).
 
-Env overrides: BENCH_N (peers, default 100_000), BENCH_TICKS (default 30).
+Configs (BASELINE.json `configs`, built in sim/scenarios.py):
+  1. 1k-peer single-topic gossipsub, default score params
+  2. 10k-peer Ethereum-beacon-style topics + scoring
+  3. 50k-peer multi-topic with peer gater + backoff churn + PX
+  4. 100k-peer mesh with 20% sybil attackers
+  5. 100k-peer floodsub / randomsub / gossipsub propagation sweep
+
+Env overrides: BENCH_N (peers for the headline config, default 100_000),
+BENCH_TICKS (default 30), BENCH_SCENARIOS (comma list to filter; "headline"
+names the final line).
 """
 
 import json
@@ -20,37 +30,64 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 TARGET_HBPS = 1000.0
 
 
-def main() -> None:
+def bench_one(name, cfg, tp, st, ticks):
     import jax
+    from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction, run
 
-    n = int(os.environ.get("BENCH_N", 100_000))
-    ticks = int(os.environ.get("BENCH_TICKS", 30))
-
-    from __graft_entry__ import _build
-    from go_libp2p_pubsub_tpu.sim.engine import run
-
-    cfg, tp, st = _build(n_peers=n, k_slots=32, degree=12, msg_window=64,
-                         publishers=8)
-    key = jax.random.PRNGKey(0)
-
+    k_warm, k_meas = jax.random.split(jax.random.PRNGKey(0))
     # warmup with the SAME n_ticks (static jit arg): compiles the measured
-    # program and converges the mesh, so the timed window is execution only
-    st = run(st, cfg, tp, key, ticks)
+    # program and converges the mesh; the measured window uses a DIFFERENT
+    # key so it is not a cache-friendly replay of the warmup traffic
+    st = run(st, cfg, tp, k_warm, ticks)
     st.tick.block_until_ready()
 
     t0 = time.perf_counter()
-    st = run(st, cfg, tp, key, ticks)
+    st = run(st, cfg, tp, k_meas, ticks)
     st.tick.block_until_ready()
     dt = time.perf_counter() - t0
 
     hbps = ticks / dt
     platform = jax.devices()[0].platform
     print(json.dumps({
-        "metric": f"gossipsub_network_heartbeats_per_sec@{n}peers[{platform}]",
+        "metric": f"network_heartbeats_per_sec@{name}[{platform}]",
         "value": round(hbps, 2),
         "unit": "heartbeats/s",
         "vs_baseline": round(hbps / TARGET_HBPS, 4),
-    }))
+        "delivery_fraction": round(float(delivery_fraction(st, cfg)), 4),
+        "n_peers": cfg.n_peers,
+    }), flush=True)
+
+
+def main() -> None:
+    from go_libp2p_pubsub_tpu.sim import scenarios
+
+    n = int(os.environ.get("BENCH_N", 100_000))
+    ticks = int(os.environ.get("BENCH_TICKS", 30))
+    only = os.environ.get("BENCH_SCENARIOS")
+    only = set(only.split(",")) if only else None
+
+    def headline():
+        from __graft_entry__ import _build
+        return _build(n_peers=n, k_slots=32, degree=12, msg_window=64,
+                      publishers=8)
+
+    specs = [
+        ("1k_single_topic", scenarios.single_topic_1k),
+        ("10k_beacon", scenarios.beacon_10k),
+        ("50k_churn_gater_px", scenarios.churn_50k),
+        ("100k_sybil20", scenarios.sybil_100k),
+        ("100k_floodsub", lambda: scenarios.router_sweep_100k("floodsub")),
+        ("100k_randomsub", lambda: scenarios.router_sweep_100k("randomsub")),
+        ("100k_gossipsub_sweep", lambda: scenarios.router_sweep_100k("gossipsub")),
+        # headline last: a single-line parse of stdout picks this one up
+        ("headline", headline),
+    ]
+    for name, build in specs:
+        if only and name not in only:
+            continue
+        cfg, tp, st = build()
+        label = f"{cfg.n_peers // 1000}k_default" if name == "headline" else name
+        bench_one(label, cfg, tp, st, ticks)
 
 
 if __name__ == "__main__":

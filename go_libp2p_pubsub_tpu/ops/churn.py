@@ -52,10 +52,67 @@ def _symmetric_uniform(state: SimState, key: jax.Array) -> jnp.ndarray:
     return _symmetric_value(state, jax.random.uniform(key, (n, k)))
 
 
+def churn_subscriptions(state: SimState, cfg: SimConfig, tp: TopicParams,
+                        key: jax.Array) -> SimState:
+    """Batched topic Join/Leave round (§3.5 topic lifecycle).
+
+    Leave (gossipsub.go:1104-1124): the leaver PRUNEs every mesh member of
+    the topic; both sides drop the edge, take the P3b prune penalty
+    (score.go:669-694 fires on Prune for either direction), and enter the
+    *unsubscribe* backoff (gossipsub.go:313-320 add_backoff is_unsubscribe).
+
+    Join (gossipsub.go:1047-1102): live fanout edges promote straight into
+    the mesh (mirrored on the remote side — the reference sends GRAFTs that
+    the fanout peers accept barring backoff, which promotion respects);
+    everything else fills in at the next heartbeat's undersubscribed graft.
+    """
+    n, t, k = state.mesh.shape
+    kj, kl = jax.random.split(key)
+    leave = state.subscribed & \
+        (jax.random.uniform(kl, (n, t)) < cfg.sub_leave_prob)
+    join = ~state.subscribed & \
+        (jax.random.uniform(kj, (n, t)) < cfg.sub_join_prob)
+
+    from .heartbeat import edge_gather  # local import: avoid cycle
+    removed = state.mesh & leave[:, :, None]
+    inc_removed = edge_gather(removed, state) & state.mesh
+    mesh_removed = removed | inc_removed
+    state = apply_prune_penalty(state, mesh_removed, tp)
+    backoff = jnp.where(mesh_removed,
+                        state.tick + cfg.unsubscribe_backoff_ticks,
+                        state.backoff)
+
+    # Join: promote fanout edges not under backoff ON EITHER SIDE (the
+    # reference's GRAFT would be refused by a remote in backoff and the
+    # joiner would drop the edge — a one-sided promote would otherwise
+    # persist as an asymmetric mesh edge until the remote's backoff expires)
+    backoff_ok = state.tick >= backoff
+    remote_ok = edge_gather(backoff_ok, state)
+    promote = join[:, :, None] & state.fanout & \
+        state.connected[:, None, :] & backoff_ok & remote_ok
+    promote_in = edge_gather(promote, state)
+    promoted = promote | promote_in
+    new_mesh = (state.mesh & ~mesh_removed) | promoted
+    subscribed = (state.subscribed | join) & ~leave
+    return state._replace(
+        mesh=new_mesh, backoff=backoff, subscribed=subscribed,
+        fanout=state.fanout & ~join[:, :, None],
+        fanout_lastpub=jnp.where(join, NEVER, state.fanout_lastpub),
+        graft_tick=jnp.where(promoted & ~state.mesh, state.tick,
+                             state.graft_tick),
+        mesh_active=state.mesh_active & ~(promoted & ~state.mesh))
+
+
 def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
-                key: jax.Array) -> SimState:
+                key: jax.Array,
+                scores_all: jnp.ndarray | None = None) -> SimState:
     """One churn round: take down a random fraction of live edges, bring back
-    a random fraction of down edges, with RemovePeer/retention semantics."""
+    a random fraction of down edges, with RemovePeer/retention semantics.
+
+    ``scores_all`` is the heartbeat's unmasked score cache (HeartbeatOut
+    .scores_all) when the engine drives churn; direct callers may omit it
+    and pay for a fresh compute.
+    """
     n, t, k = state.mesh.shape
     kd, ku = jax.random.split(key)
 
@@ -71,8 +128,16 @@ def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
         # scores below the threshold come back at a fraction of the rate.
         # The dialing endpoint is the same lower-id side that decides the
         # symmetric draw, so edges stay symmetric.
-        scores = compute_scores(state, cfg, tp, mask_disconnected=False)
-        p_up = jnp.where(scores >= cfg.accept_px_threshold,
+        if scores_all is None:
+            scores_all = compute_scores(state, cfg, tp,
+                                        mask_disconnected=False)
+        # retained counters expire after RetainScore (score.go:611-644):
+        # an edge down longer than the retention window scores 0 again, so
+        # a once-bad long-gone peer is not shunned forever
+        down_age = state.tick - state.disconnect_tick
+        px_score = jnp.where(down_age > cfg.retain_score_ticks,
+                             0.0, scores_all)
+        p_up = jnp.where(px_score >= cfg.accept_px_threshold,
                          cfg.churn_reconnect_prob,
                          cfg.churn_reconnect_prob * cfg.px_low_score_factor)
         p_up = _symmetric_value(state, p_up)

@@ -42,7 +42,10 @@ def edge_gather(x: jnp.ndarray, state: SimState, fill=False) -> jnp.ndarray:
 class HeartbeatOut(NamedTuple):
     state: SimState
     scores: jnp.ndarray      # [N, K] pre-maintenance scores (score cache,
-                             # gossipsub.go:1375-1381)
+                             # gossipsub.go:1375-1381); disconnected slots 0
+    scores_all: jnp.ndarray  # [N, K] same cache WITHOUT the connected mask —
+                             # retained scores of down edges (RetainScore),
+                             # consumed by the PX reconnect gate (ops/churn.py)
     gossip_sel: jnp.ndarray  # [N, T, K] emitGossip target edges
 
 
@@ -52,7 +55,8 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     tick = state.tick
     ks = jax.random.split(key, 8)
 
-    scores = compute_scores(state, cfg, tp)          # [N, K]
+    scores_all = compute_scores(state, cfg, tp, mask_disconnected=False)
+    scores = jnp.where(state.connected, scores_all, 0.0)         # [N, K]
     s = scores[:, None, :]                           # broadcast over T
     sb = jnp.broadcast_to(s, (n, t, k))
     joined = state.subscribed[:, :, None]
@@ -189,4 +193,5 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
                          jnp.floor(cfg.gossip_factor * n_cand).astype(jnp.int32))
     gossip_sel = select_random(gossip_cand, target, ks[6])
 
-    return HeartbeatOut(state=st, scores=scores, gossip_sel=gossip_sel)
+    return HeartbeatOut(state=st, scores=scores, scores_all=scores_all,
+                        gossip_sel=gossip_sel)

@@ -277,10 +277,27 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # (deliver_tick stays NEVER on rejection — validation.go:293-370)
     answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)             # [W,N]
     answers_k = _gather_words(answer_bits, nbr_t)                       # [W,K,N]
-    # pulled data is still data: graylist + gater admission apply
+    # pulled data is still data: graylist + gater admission apply, and pulls
+    # are charged against the same per-edge and validation budgets as eager
+    # traffic (an IHAVE-flooding adversary must not route unlimited data
+    # through the pull path)
     adm_kn = jnp.where(data_ok.T[None, :, :], U32(0xFFFFFFFF), U32(0))
     got_k = asked_k & answers_k & ~have_bits[:, None, :] & adm_kn
     broken_k = asked_k & ~answers_k
+    throttled = jnp.zeros((n,), jnp.int32)
+    if cfg.edge_queue_cap > 0:
+        pull_sz = popcount_sum(got_k, axis=0, dtype=jnp.int32)          # [K,N]
+        got_k = jnp.where((pull_sz <= cfg.edge_queue_cap)[None, :, :],
+                          got_k, U32(0))
+    if cfg.validation_queue_cap > 0:
+        cnt0 = popcount_sum(reduce_or(got_k, axis=1), axis=0,
+                            dtype=jnp.int32)                            # [N]
+        fits0 = cnt0 <= cfg.validation_queue_cap
+        got_k = got_k & jnp.where(fits0, U32(0xFFFFFFFF), U32(0))[None, None, :]
+        # over-budget pulls are dropped unseen and charged as throttle
+        # events; the unanswered promise is NOT charged to the sender (it
+        # did answer — the local queue dropped it)
+        throttled = throttled + jnp.where(fits0, 0, cnt0)
     got_any = reduce_or(got_k, axis=1)                                  # [W,N]
     # pulled messages still go through the receiver's validation: deliver on
     # ACCEPT, seen-only on IGNORE (an honest publisher answers pulls for its
@@ -295,11 +312,9 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     have_bits = have_bits | got_any
     dlv_bits = dlv_bits | got_valid_any
 
-    # per-tick admission budgets, seeded with the IWANT pulls
-    pull_per_edge = popcount_sum(got_k, axis=0, dtype=jnp.int32)        # [K,N]
-    edge_used = pull_per_edge                                           # [K,N]
+    # per-tick admission budgets, seeded with the (cap-masked) IWANT pulls
+    edge_used = popcount_sum(got_k, axis=0, dtype=jnp.int32)            # [K,N]
     arrivals = popcount_sum(got_any, axis=0, dtype=jnp.int32)           # [N]
-    throttled = jnp.zeros((n,), jnp.int32)
     validated = arrivals.astype(jnp.float32)
 
     # -- step 2: eager forwarding, prop_substeps hops, fully bit-packed --

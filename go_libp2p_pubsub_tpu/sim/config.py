@@ -124,6 +124,11 @@ class SimConfig:
     px_low_score_factor: float = 0.1
     # forced redial cadence for direct peers (gossipsub.go:1648-1670), ticks
     direct_connect_ticks: int = 300
+    # subscription churn per tick (0.0 = off): peers Leave topics (PRUNE all
+    # mesh members with the unsubscribe backoff, gossipsub.go:1104-1124) and
+    # Join them back (promoting live fanout edges, gossipsub.go:1047-1102)
+    sub_leave_prob: float = 0.0
+    sub_join_prob: float = 0.0
 
     @staticmethod
     def from_params(n_peers: int, k_slots: int, n_topics: int = 1,
@@ -178,37 +183,32 @@ class TopicParams(NamedTuple):
     @staticmethod
     def from_topic_params(topics: list[TopicScoreParams],
                           heartbeat_interval: float = 1.0) -> "TopicParams":
-        """Pack a list of per-topic params into [T] arrays (ticks domain)."""
-        def arr(get, dtype=np.float32):
-            return jnp.asarray(np.array([get(t) for t in topics], dtype=dtype))
+        """Pack a list of per-topic params into [T] arrays (ticks domain).
 
+        All 16 rows travel to the device as ONE [16, T] transfer (one host
+        link round-trip instead of sixteen tiny ones)."""
         hb = heartbeat_interval
-        return TopicParams(
-            topic_weight=arr(lambda t: t.topic_weight),
-            time_in_mesh_weight=arr(lambda t: t.time_in_mesh_weight),
-            time_in_mesh_quantum_ticks=arr(
-                lambda t: max(t.time_in_mesh_quantum / hb, 1e-9)),
-            time_in_mesh_cap=arr(lambda t: t.time_in_mesh_cap),
-            first_message_deliveries_weight=arr(lambda t: t.first_message_deliveries_weight),
-            first_message_deliveries_decay=arr(
-                lambda t: t.first_message_deliveries_decay if t.first_message_deliveries_decay else 1.0),
-            first_message_deliveries_cap=arr(
-                lambda t: t.first_message_deliveries_cap if t.first_message_deliveries_cap else math.inf),
-            mesh_message_deliveries_weight=arr(lambda t: t.mesh_message_deliveries_weight),
-            mesh_message_deliveries_decay=arr(
-                lambda t: t.mesh_message_deliveries_decay if t.mesh_message_deliveries_decay else 1.0),
-            mesh_message_deliveries_cap=arr(
-                lambda t: t.mesh_message_deliveries_cap if t.mesh_message_deliveries_cap else math.inf),
-            mesh_message_deliveries_threshold=arr(lambda t: t.mesh_message_deliveries_threshold),
-            mesh_message_deliveries_activation_ticks=arr(
-                lambda t: t.mesh_message_deliveries_activation / hb),
-            mesh_failure_penalty_weight=arr(lambda t: t.mesh_failure_penalty_weight),
-            mesh_failure_penalty_decay=arr(
-                lambda t: t.mesh_failure_penalty_decay if t.mesh_failure_penalty_decay else 1.0),
-            invalid_message_deliveries_weight=arr(lambda t: t.invalid_message_deliveries_weight),
-            invalid_message_deliveries_decay=arr(
-                lambda t: t.invalid_message_deliveries_decay if t.invalid_message_deliveries_decay else 1.0),
-        )
+        getters = [
+            lambda t: t.topic_weight,
+            lambda t: t.time_in_mesh_weight,
+            lambda t: max(t.time_in_mesh_quantum / hb, 1e-9),
+            lambda t: t.time_in_mesh_cap,
+            lambda t: t.first_message_deliveries_weight,
+            lambda t: t.first_message_deliveries_decay if t.first_message_deliveries_decay else 1.0,
+            lambda t: t.first_message_deliveries_cap if t.first_message_deliveries_cap else math.inf,
+            lambda t: t.mesh_message_deliveries_weight,
+            lambda t: t.mesh_message_deliveries_decay if t.mesh_message_deliveries_decay else 1.0,
+            lambda t: t.mesh_message_deliveries_cap if t.mesh_message_deliveries_cap else math.inf,
+            lambda t: t.mesh_message_deliveries_threshold,
+            lambda t: t.mesh_message_deliveries_activation / hb,
+            lambda t: t.mesh_failure_penalty_weight,
+            lambda t: t.mesh_failure_penalty_decay if t.mesh_failure_penalty_decay else 1.0,
+            lambda t: t.invalid_message_deliveries_weight,
+            lambda t: t.invalid_message_deliveries_decay if t.invalid_message_deliveries_decay else 1.0,
+        ]
+        mat = jnp.asarray(np.array([[g(t) for t in topics] for g in getters],
+                                   dtype=np.float32))
+        return TopicParams(*mat)
 
     @staticmethod
     def disabled(n_topics: int) -> "TopicParams":

@@ -25,7 +25,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from ..ops.churn import churn_edges
+from ..ops.churn import churn_edges, churn_subscriptions
 from ..ops.gater import gater_decay
 from ..ops.heartbeat import heartbeat
 from ..ops.propagate import forward_tick, publish
@@ -50,9 +50,9 @@ def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
 
 def step(state: SimState, cfg: SimConfig, tp: TopicParams,
          key: jax.Array) -> SimState:
-    k_pub, k_hb, k_fwd, k_churn, k_ign = jax.random.split(key, 5)
-    if cfg.churn_disconnect_prob > 0.0:
-        state = churn_edges(state, cfg, tp, k_churn)
+    k_pub, k_hb, k_fwd, k_churn, k_ign, k_sub = jax.random.split(key, 6)
+    if cfg.sub_leave_prob > 0.0 or cfg.sub_join_prob > 0.0:
+        state = churn_subscriptions(state, cfg, tp, k_sub)
     peers, topics = choose_publishers(state, cfg, k_pub)
     state = publish(state, cfg, peers, topics, k_ign)
     state = decay_counters(state, cfg, tp)
@@ -60,6 +60,12 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
         state = gater_decay(state, cfg)
     hb = heartbeat(state, cfg, tp, k_hb)
     state = forward_tick(hb.state, cfg, tp, hb.gossip_sel, hb.scores, k_fwd)
+    if cfg.churn_disconnect_prob > 0.0:
+        # connection churn closes the tick, reusing the heartbeat's score
+        # cache (its unmasked variant) for the PX reconnect gate — one
+        # compute_scores per tick, as the reference reuses its cache within
+        # a heartbeat (gossipsub.go:1375-1381)
+        state = churn_edges(state, cfg, tp, k_churn, scores_all=hb.scores_all)
     return state._replace(tick=state.tick + 1)
 
 

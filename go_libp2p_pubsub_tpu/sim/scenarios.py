@@ -166,9 +166,10 @@ def sybil_100k(n_peers: int = 100_000, k_slots: int = 32, degree: int = 12,
         graylist_threshold=-100.0,
         # churn + PX: honest peers reconnect preferentially to peers they
         # score above the PX threshold, so the honest mesh heals while
-        # graylisted sybil edges decay (gossipsub.go:893-973)
+        # graylisted sybil edges decay (gossipsub.go:893-973); long score
+        # retention keeps sybil history alive across their down-time
         churn_disconnect_prob=0.01, churn_reconnect_prob=0.2,
-        px_enabled=True, accept_px_threshold=-5.0)
+        px_enabled=True, accept_px_threshold=-5.0, retain_score_ticks=600)
     topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
     return cfg, default_topic_params(1), \
         init_state(cfg, topo, malicious=malicious, ip_group=ip_group)

@@ -215,3 +215,78 @@ class TestPXAndDirectConnect:
         up_hs = conn[hon][hs].mean()
         assert up_hh > 0.85, up_hh          # honest mesh healed
         assert up_hs < up_hh, (up_hs, up_hh)
+
+
+class TestSubscriptionChurn:
+    """Batched Join/Leave (gossipsub.go:1047-1124) with unsubscribe backoff."""
+
+    def _setup(self, **kw):
+        cfg = cfg_with_churn(churn_disconnect_prob=0.0,
+                             churn_reconnect_prob=0.0,
+                             unsubscribe_backoff_ticks=10, **kw)
+        topo = topology.dense(cfg.n_peers, cfg.k_slots, degree=10)
+        tp = TopicParams.disabled(cfg.n_topics)
+        st = init_state(cfg, topo)
+        return cfg, tp, st
+
+    def test_leave_prunes_with_backoff_and_penalty(self):
+        from go_libp2p_pubsub_tpu.ops.churn import churn_subscriptions
+        cfg, tp, st = self._setup(sub_leave_prob=0.5)
+        # full mesh on connected edges, P3 active with a deficit -> penalty
+        st = st._replace(
+            mesh=st.connected[:, None, :] & st.subscribed[:, :, None],
+            mesh_active=st.connected[:, None, :],
+            tick=jnp.int32(5))
+        tp_pen = scenarios.default_topic_params(1)
+        st2 = churn_subscriptions(st, cfg, tp_pen, jax.random.PRNGKey(1))
+        left = np.asarray(st.subscribed & ~st2.subscribed)
+        assert left.any()
+        # leavers hold no mesh edges on left topics
+        mesh2 = np.asarray(st2.mesh)
+        assert not mesh2[left[:, 0], 0, :].any()
+        # removed edges entered unsubscribe backoff and took the P3b penalty
+        removed = np.asarray(st.mesh) & ~mesh2
+        bo = np.asarray(st2.backoff)
+        assert (bo[removed] == 5 + 10).all()
+        assert float(jnp.sum(st2.mesh_failure_penalty)) > 0
+        # mesh stayed edge-symmetric
+        nbr = np.asarray(st.neighbors); rs = np.asarray(st.reverse_slot)
+        for i in range(cfg.n_peers):
+            for s in range(cfg.k_slots):
+                if nbr[i, s] >= 0 and rs[i, s] >= 0:
+                    assert mesh2[i, 0, s] == mesh2[nbr[i, s], 0, rs[i, s]]
+
+    def test_join_promotes_fanout(self):
+        from go_libp2p_pubsub_tpu.ops.churn import churn_subscriptions
+        cfg, tp, st = self._setup(sub_join_prob=1.0)
+        sub = np.zeros((cfg.n_peers, 1), bool)   # nobody subscribed
+        st = st._replace(subscribed=jnp.asarray(sub),
+                         fanout=st.connected[:, None, :],
+                         fanout_lastpub=jnp.zeros_like(st.fanout_lastpub))
+        st2 = churn_subscriptions(st, cfg, tp, jax.random.PRNGKey(2))
+        assert bool(jnp.all(st2.subscribed))
+        # fanout edges became mesh edges; fanout cleared
+        np.testing.assert_array_equal(np.asarray(st2.mesh),
+                                      np.asarray(st.fanout))
+        assert not bool(jnp.any(st2.fanout))
+
+    def test_rejoin_blocked_by_unsubscribe_backoff(self):
+        """After Leave, the next heartbeat cannot regraft until the
+        unsubscribe backoff expires (heartbeat candidate gating)."""
+        from go_libp2p_pubsub_tpu.ops.churn import churn_subscriptions
+        from go_libp2p_pubsub_tpu.ops.heartbeat import heartbeat
+        cfg, tp, st = self._setup(sub_leave_prob=0.5)
+        st = st._replace(
+            mesh=st.connected[:, None, :] & st.subscribed[:, :, None],
+            tick=jnp.int32(5))
+        st2 = churn_subscriptions(st, cfg, tp, jax.random.PRNGKey(3))
+        # resubscribe everyone immediately
+        st2 = st2._replace(subscribed=jnp.ones_like(st2.subscribed))
+        hb = heartbeat(st2, cfg, tp, jax.random.PRNGKey(4))
+        regrafted = np.asarray(hb.state.mesh) & ~np.asarray(st2.mesh) \
+            & (np.asarray(st2.backoff) > 5)
+        assert not regrafted.any()
+        # after expiry the same heartbeat regrafts freely
+        st3 = st2._replace(tick=jnp.int32(5 + 11))
+        hb2 = heartbeat(st3, cfg, tp, jax.random.PRNGKey(4))
+        assert (np.asarray(hb2.state.mesh) & ~np.asarray(st3.mesh)).any()
