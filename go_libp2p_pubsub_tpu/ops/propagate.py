@@ -343,7 +343,9 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     dup_acc = jnp.zeros((w, k, n), U32)    # mesh-duplicate events, per slot
     gdup_acc = jnp.zeros((w, k, n), U32)   # any-duplicate events (gater)
 
-    for _hop in range(cfg.prop_substeps):
+    def hop(carry, _):
+        (frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
+         dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
         offered = _gather_words(frontier, nbr_t) & allowed              # [W,K,N]
         if cfg.edge_queue_cap > 0:
             # drop-on-full, whole-RPC granularity (comm.go:156-191): the
@@ -393,7 +395,18 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         have_bits = have_bits | new_any
         dlv_bits = dlv_bits | new_valid
         dlv_new = dlv_new | new_valid
-        frontier = new_valid
+        return (new_valid, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc,
+                ig_acc, dup_acc, gdup_acc, edge_used, arrivals, throttled,
+                validated), None
+
+    # the hop loop is a lax.scan (not unrolled): one hop's code compiles
+    # once, temporaries are reused across hops, and the executable stays
+    # small at 100k peers (the unrolled form compiled to >100MB of code)
+    carry = (frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
+             dup_acc, gdup_acc, edge_used, arrivals, throttled, validated)
+    carry, _ = jax.lax.scan(hop, carry, None, length=cfg.prop_substeps)
+    (_, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
+     dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
 
     for ti in range(t):
         tb = topic_bits[ti][:, None, None]
