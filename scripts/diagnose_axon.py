@@ -1,0 +1,107 @@
+"""One-shot bisect of the axon-platform slowdown trigger.
+
+Round-1's 0.95 hb/s @100k was measured in a process where EVERYTHING ran
+~1000x slow (even `jax.random.uniform` inside an on-device lax.scan). The
+slowdown appears after sim-state construction; this script isolates which
+operation flips the platform into the slow mode, by re-measuring a canary
+after each candidate trigger.
+
+Run on the real TPU (default env). Prints one line per stage; the first
+stage whose canary regresses >10x names the trigger.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def canary():
+    """ms per iteration of a tiny on-device scan (20 iters)."""
+    @jax.jit
+    def runv(x, k):
+        ks = jax.random.split(k, 20)
+        out, _ = jax.lax.scan(
+            lambda c, kk: (c + jax.random.uniform(kk, c.shape), None), x, ks)
+        return out
+    x0 = jnp.zeros((8192, 32), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    out = runv(x0, key); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = runv(x0, key); jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / 20 * 1e3
+
+
+def stage(name, fn):
+    fn()
+    print(f"{name:44s} canary {canary():9.4f} ms/tick", flush=True)
+
+
+def main():
+    print("platform:", jax.devices()[0].platform, flush=True)
+    print(f"{'baseline':44s} canary {canary():9.4f} ms/tick", flush=True)
+
+    # candidate triggers, mildest first
+    stage("20 tiny f32 transfers",
+          lambda: [jnp.asarray(np.array([float(i)], np.float32))
+                   .block_until_ready() for i in range(20)])
+    stage("transfer containing inf",
+          lambda: jnp.asarray(np.array([np.inf], np.float32)).block_until_ready())
+    stage("jnp.full int32 2^30 [8k,32]",
+          lambda: jnp.full((8192, 32), 2**30, jnp.int32).block_until_ready())
+    stage("30 mixed zeros/full allocs (old init_state)",
+          lambda: [jnp.zeros((8192, 1, 32), jnp.float32).block_until_ready()
+                   for _ in range(10)]
+          + [jnp.full((8192, 32), 2**30, jnp.int32).block_until_ready()
+             for _ in range(10)]
+          + [jnp.zeros((8192, 64), bool).block_until_ready()
+             for _ in range(10)])
+
+    def tp_build():
+        from go_libp2p_pubsub_tpu.core.params import TopicScoreParams
+        from go_libp2p_pubsub_tpu.sim.config import TopicParams
+        tp = TopicParams.from_topic_params([TopicScoreParams(
+            skip_atomic_validation=True, time_in_mesh_quantum=1.0)])
+        jax.block_until_ready(tuple(tp))
+    stage("TopicParams (single [16,T] transfer)", tp_build)
+
+    def state_build():
+        from go_libp2p_pubsub_tpu.sim import SimConfig, init_state, topology
+        cfg = SimConfig(n_peers=8192, k_slots=32, n_topics=1, msg_window=64)
+        st = init_state(cfg, topology.sparse(8192, 32, degree=12))
+        jax.block_until_ready(st)
+    stage("init_state (jitted on-device build)", state_build)
+
+    def compile_step():
+        from __graft_entry__ import _build
+        from go_libp2p_pubsub_tpu.sim.engine import step
+        cfg, tp, st = _build(n_peers=8192, k_slots=32, degree=12,
+                             msg_window=64, publishers=8)
+        jax.jit(step, static_argnames=("cfg",)).lower(
+            st, cfg, tp, jax.random.PRNGKey(0)).compile()
+    stage("compile full step @8k (no exec)", compile_step)
+
+    def run_steps():
+        from __graft_entry__ import _build
+        from go_libp2p_pubsub_tpu.sim.engine import run
+        cfg, tp, st = _build(n_peers=8192, k_slots=32, degree=12,
+                             msg_window=64, publishers=8)
+        t0 = time.perf_counter()
+        st = run(st, cfg, tp, jax.random.PRNGKey(0), 20)
+        st.tick.block_until_ready()
+        c = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        st = run(st, cfg, tp, jax.random.PRNGKey(1), 20)
+        st.tick.block_until_ready()
+        print(f"  run(20) @8k: compile+exec {c:.1f}s, "
+              f"exec {(time.perf_counter()-t0)/20*1e3:.2f} ms/tick", flush=True)
+    stage("execute run(20) @8k", run_steps)
+
+
+if __name__ == "__main__":
+    main()
