@@ -42,6 +42,8 @@ receives it).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -59,6 +61,7 @@ from .bits import (
 )
 from . import gater
 from .heartbeat import edge_gather
+from .selection import select_random
 
 
 def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
@@ -119,17 +122,16 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.n
         # sender forwards to every subscribed neighbor (floodsub.go:76-100)
         return conn & my_sub
     if cfg.router == "randomsub":
-        # sender forwards to max(D, ceil(sqrt N)) random topic peers
-        # (randomsub.go:124-143): statistical model via per-edge Bernoulli
-        # with matching expected degree
-        target = jnp.maximum(cfg.d, jnp.ceil(jnp.sqrt(float(cfg.n_peers))))
-        # probability is per SENDER: it picks target of ITS peers; view from
-        # the receiver via the neighbor table
+        # sender forwards to EXACTLY max(D, ceil(sqrt N)) random topic peers
+        # (randomsub.go:124-143): a uniform sample without replacement from
+        # its connected subscribed neighbors, taken sender-side, then viewed
+        # from the receiver through the edge permutation
+        target = max(cfg.d, math.ceil(math.sqrt(cfg.n_peers)))
         nbr = jnp.clip(state.neighbors, 0, cfg.n_peers - 1)
-        sender_deg = jnp.maximum(jnp.sum(state.connected, -1), 1)[nbr]  # [N,K]
-        prob = jnp.minimum(target / sender_deg, 1.0)[:, None, :]
-        draw = jax.random.uniform(key, (n, t, k)) < prob
-        return conn & my_sub & draw
+        nbr_sub = jnp.transpose(state.subscribed[nbr], (0, 2, 1))   # [N,T,K]
+        cand = state.connected[:, None, :] & nbr_sub                # sender view
+        sel = select_random(cand, jnp.full((n, t), target), key)
+        return edge_gather(sel, state) & conn & my_sub
     raise ValueError(f"unknown router {cfg.router!r}")
 
 
@@ -323,6 +325,32 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     allowed = _edge_topic_bits(fwd_mask, topic_bits, w)                 # [W,K,N]
     mesh_eb = _edge_topic_bits(state.mesh, topic_bits, w)               # [W,K,N]
 
+    if cfg.flood_publish and cfg.router == "gossipsub":
+        # WithFloodPublish (gossipsub.go:989-1004): the ORIGIN sends its own
+        # publishes to every subscribed topic peer it scores >=
+        # publish_threshold — direct peers bypass the score gate, and the
+        # publisher itself need not be subscribed (flood replaces the fanout
+        # path too). Only hop 0 carries origin messages. Sender-side values
+        # (its score of me, its direct flag for me) arrive through the edge
+        # permutation.
+        jn = jnp.clip(state.neighbors, 0, n - 1)
+        rk = jnp.clip(state.reverse_slot, 0, k - 1)
+        sender_scores_me = scores[jn, rk]                               # [N,K]
+        sender_direct_me = state.direct[jn, rk]                         # [N,K]
+        flood_mask = state.connected[:, None, :] & \
+            state.subscribed[:, :, None] & \
+            (sender_direct_me
+             | (sender_scores_me >= cfg.publish_threshold))[:, None, :] & \
+            data_ok[:, None, :]
+        flood_allowed = _edge_topic_bits(flood_mask, topic_bits, w)
+        # origin set: slots this peer itself published this tick
+        origin_bits = pack_words(
+            (state.deliver_tick == state.tick)
+            & (state.msg_publish_tick == state.tick)[None, :])
+        flood_offer = _gather_words(origin_bits, nbr_t) & flood_allowed
+    else:
+        flood_offer = None
+
     # P3 duplicate-credit window (score.go:949-981): past deliveries stay
     # creditable for mesh_message_deliveries_window_ticks (default 0 = this
     # tick only; the reference default window is 10ms << 1 heartbeat)
@@ -343,10 +371,12 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     dup_acc = jnp.zeros((w, k, n), U32)    # mesh-duplicate events, per slot
     gdup_acc = jnp.zeros((w, k, n), U32)   # any-duplicate events (gater)
 
-    def hop(carry, _):
+    def hop(carry, is_first):
         (frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
          dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
         offered = _gather_words(frontier, nbr_t) & allowed              # [W,K,N]
+        if flood_offer is not None:
+            offered = offered | jnp.where(is_first, flood_offer, U32(0))
         if cfg.edge_queue_cap > 0:
             # drop-on-full, whole-RPC granularity (comm.go:156-191): the
             # hop's RPC on an edge either fits the remaining budget or drops
@@ -404,7 +434,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # small at 100k peers (the unrolled form compiled to >100MB of code)
     carry = (frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
              dup_acc, gdup_acc, edge_used, arrivals, throttled, validated)
-    carry, _ = jax.lax.scan(hop, carry, None, length=cfg.prop_substeps)
+    carry, _ = jax.lax.scan(hop, carry,
+                            jnp.arange(cfg.prop_substeps) == 0)
     (_, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
      dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
 

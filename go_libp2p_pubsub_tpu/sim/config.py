@@ -37,6 +37,10 @@ class SimConfig:
     # router variant: "gossipsub" (mesh), "floodsub" (all topic peers,
     # floodsub.go:76-100), "randomsub" (random max(D, sqrt N), randomsub.go:99-160)
     router: str = "gossipsub"
+    # WithFloodPublish (gossipsub.go:321-327): a publisher sends its OWN
+    # messages to every topic peer it scores >= publish_threshold, not just
+    # its mesh (gossipsub.go:989-1004); forwarding stays mesh-only
+    flood_publish: bool = False
     prop_substeps: int = 8    # intra-tick forwarding hops (mesh diameter bound)
 
     # overlay degree bounds (gossipsub.go:32-40)

@@ -220,3 +220,50 @@ class TestDeterminism:
         assert jnp.array_equal(a.mesh, b.mesh)
         assert jnp.array_equal(a.have, b.have)
         assert float(a.delivered_total) == float(b.delivered_total)
+
+
+class TestRandomsubExactSample:
+    def test_sender_degree_exact(self):
+        """randomsub forwards to EXACTLY max(D, ceil(sqrt N)) random topic
+        peers per sender (randomsub.go:124-143), not a Bernoulli approx."""
+        import math
+        from go_libp2p_pubsub_tpu.ops.propagate import _edge_forward_mask
+        cfg = SimConfig(n_peers=64, k_slots=32, n_topics=1, msg_window=16,
+                        router="randomsub", scoring_enabled=False, d=3)
+        topo = topology.dense(cfg.n_peers, cfg.k_slots, degree=20)
+        st = init_state(cfg, topo)
+        mask = np.asarray(_edge_forward_mask(st, cfg, jax.random.PRNGKey(0)))
+        nbr = np.asarray(st.neighbors)
+        target = max(cfg.d, math.ceil(math.sqrt(cfg.n_peers)))
+        out_deg = np.zeros(cfg.n_peers, int)
+        for i in range(cfg.n_peers):
+            for s in range(cfg.k_slots):
+                if nbr[i, s] >= 0 and mask[i, 0, s]:
+                    out_deg[nbr[i, s]] += 1
+        deg = np.asarray(st.connected).sum(axis=1)
+        expect = np.minimum(deg, target)
+        np.testing.assert_array_equal(out_deg, expect)
+
+
+class TestFloodPublish:
+    def test_origin_floods_topic_peers_despite_empty_mesh(self):
+        """WithFloodPublish (gossipsub.go:989-1004): the publisher reaches
+        every topic peer it scores above the publish threshold even with no
+        mesh; forwarding hops stay mesh-only."""
+        from go_libp2p_pubsub_tpu.ops.propagate import forward_tick, publish
+
+        def one_tick(flood):
+            cfg = SimConfig(n_peers=32, k_slots=32, n_topics=1, msg_window=8,
+                            publishers_per_tick=1, prop_substeps=2,
+                            scoring_enabled=False, flood_publish=flood)
+            topo = topology.full(cfg.n_peers, cfg.k_slots)
+            st = init_state(cfg, topo)        # mesh is empty: no heartbeat ran
+            st = publish(st, cfg, jnp.asarray([0]), jnp.asarray([0]))
+            gossip_sel = jnp.zeros_like(st.mesh)
+            scores = jnp.zeros(st.behaviour_penalty.shape, jnp.float32)
+            st = forward_tick(st, cfg, TopicParams.disabled(1), gossip_sel,
+                              scores, jax.random.PRNGKey(0))
+            return int(np.asarray(st.have)[:, 0].sum())
+
+        assert one_tick(flood=False) == 1     # only the publisher holds it
+        assert one_tick(flood=True) == 32     # everyone got the origin copy
