@@ -22,6 +22,7 @@ names the final line).
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -58,36 +59,73 @@ def bench_one(name, cfg, tp, st, ticks):
     }), flush=True)
 
 
-def main() -> None:
+NAMES = ["1k_single_topic", "10k_beacon", "50k_churn_gater_px",
+         "100k_sybil20", "100k_floodsub", "100k_randomsub",
+         "100k_gossipsub_sweep", "headline"]  # headline last: a single-line
+                                              # parse of stdout picks it up
+
+
+def run_scenario(name: str) -> None:
     from go_libp2p_pubsub_tpu.sim import scenarios
 
     n = int(os.environ.get("BENCH_N", 100_000))
     ticks = int(os.environ.get("BENCH_TICKS", 30))
-    only = os.environ.get("BENCH_SCENARIOS")
-    only = set(only.split(",")) if only else None
 
     def headline():
         from __graft_entry__ import _build
         return _build(n_peers=n, k_slots=32, degree=12, msg_window=64,
                       publishers=8)
 
-    specs = [
-        ("1k_single_topic", scenarios.single_topic_1k),
-        ("10k_beacon", scenarios.beacon_10k),
-        ("50k_churn_gater_px", scenarios.churn_50k),
-        ("100k_sybil20", scenarios.sybil_100k),
-        ("100k_floodsub", lambda: scenarios.router_sweep_100k("floodsub")),
-        ("100k_randomsub", lambda: scenarios.router_sweep_100k("randomsub")),
-        ("100k_gossipsub_sweep", lambda: scenarios.router_sweep_100k("gossipsub")),
-        # headline last: a single-line parse of stdout picks this one up
-        ("headline", headline),
-    ]
-    for name, build in specs:
-        if only and name not in only:
-            continue
-        cfg, tp, st = build()
-        label = f"{cfg.n_peers // 1000}k_default" if name == "headline" else name
-        bench_one(label, cfg, tp, st, ticks)
+    builders = {
+        "1k_single_topic": scenarios.single_topic_1k,
+        "10k_beacon": scenarios.beacon_10k,
+        "50k_churn_gater_px": scenarios.churn_50k,
+        "100k_sybil20": scenarios.sybil_100k,
+        "100k_floodsub": lambda: scenarios.router_sweep_100k("floodsub"),
+        "100k_randomsub": lambda: scenarios.router_sweep_100k("randomsub"),
+        "100k_gossipsub_sweep": lambda: scenarios.router_sweep_100k("gossipsub"),
+        "headline": headline,
+    }
+    assert set(builders) == set(NAMES), "scenario registry drifted from NAMES"
+    cfg, tp, st = builders[name]()
+    bench_one(_label(name), cfg, tp, st, ticks)
+
+
+def _label(name: str) -> str:
+    if name == "headline":
+        return f"{int(os.environ.get('BENCH_N', 100_000)) // 1000}k_default"
+    return name
+
+
+def main() -> None:
+    only = os.environ.get("BENCH_SCENARIOS")
+    names = [s for s in NAMES if not only or s in set(only.split(","))]
+    if os.environ.get("BENCH_IN_PROC") or len(names) == 1:
+        for name in names:
+            run_scenario(name)
+        return
+    # one subprocess per scenario: a platform slowdown or OOM in one config
+    # cannot taint the others' measurements
+    for name in names:
+        env = dict(os.environ, BENCH_SCENARIOS=name, BENCH_IN_PROC="1")
+        err = ""
+        try:
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True,
+                timeout=int(os.environ.get("BENCH_TIMEOUT", 1800)))
+            for line in res.stdout.splitlines():
+                if line.startswith("{"):
+                    print(line, flush=True)
+            if res.returncode != 0:
+                err = res.stderr.strip()[-300:] or f"rc={res.returncode}"
+        except subprocess.TimeoutExpired:
+            err = "timeout"
+        if err:
+            print(json.dumps({
+                "metric": f"network_heartbeats_per_sec@{_label(name)}",
+                "value": 0.0, "unit": "heartbeats/s",
+                "vs_baseline": 0.0, "error": err}), flush=True)
 
 
 if __name__ == "__main__":

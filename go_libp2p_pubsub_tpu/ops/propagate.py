@@ -337,10 +337,13 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         rk = jnp.clip(state.reverse_slot, 0, k - 1)
         sender_scores_me = scores[jn, rk]                               # [N,K]
         sender_direct_me = state.direct[jn, rk]                         # [N,K]
+        if cfg.scoring_enabled:
+            score_gate = sender_direct_me | \
+                (sender_scores_me >= cfg.publish_threshold)
+        else:
+            score_gate = jnp.ones_like(sender_direct_me)
         flood_mask = state.connected[:, None, :] & \
-            state.subscribed[:, :, None] & \
-            (sender_direct_me
-             | (sender_scores_me >= cfg.publish_threshold))[:, None, :] & \
+            state.subscribed[:, :, None] & score_gate[:, None, :] & \
             data_ok[:, None, :]
         flood_allowed = _edge_topic_bits(flood_mask, topic_bits, w)
         # origin set: slots this peer itself published this tick
