@@ -328,3 +328,198 @@ class TestStarTopology:
         for i, s in enumerate(subs):
             got = [m for m in iter(s.next, None)]
             assert any(m.data == b"via hub" for m in got), f"node {i} missed"
+
+
+class TestPeerExchange:
+    """PX: refused GRAFTs carry peer records, the pruned side dials them
+    score-permitting (gossipsub.go:893-973, 1866-1906; handlePrune
+    gossipsub.go:860-866)."""
+
+    def _node_with_full_mesh(self, net):
+        params = GossipSubParams(d=2, dlo=1, dhi=2, dscore=1, dout=0)
+        node = one_node(net, params=params, do_px=True)
+        node.join("t").subscribe()
+        raws = [RawPeer(net) for _ in range(4)]
+        for r in raws:
+            r.connect(node)
+        net.scheduler.run_for(0.2)
+        for r in raws:
+            r.subscribe(node, "t")
+        # first two graft into the mesh (fills to dhi=2)
+        for r in raws[:2]:
+            r.send(node, RPC(control=ControlMessage(
+                graft=[ControlGraft(topic="t")])))
+        net.scheduler.run_for(0.2)
+        return node, raws
+
+    def test_refused_graft_carries_px_records(self):
+        net = Network()
+        node, raws = self._node_with_full_mesh(net)
+        late = raws[2]
+        late.inbox.clear()
+        late.send(node, RPC(control=ControlMessage(
+            graft=[ControlGraft(topic="t")])))
+        net.scheduler.run_for(0.2)
+        prunes = late.received_prunes()
+        assert prunes, "expected a PRUNE refusal at Dhi"
+        assert prunes[0].backoff > 0
+        suggested = {pi.peer_id for pr in prunes for pi in pr.peers}
+        assert suggested, "PRUNE should carry PX records"
+        assert late.pid not in suggested      # never suggest the pruned peer
+        assert suggested <= {r.pid for r in raws}
+
+    def test_pruned_node_dials_px_suggestion(self):
+        # two real nodes + a raw mesh peer that prunes node1 while
+        # suggesting node2 (not yet connected)
+        net = Network()
+        node1 = one_node(net)
+        node1.join("t").subscribe()
+        node2 = one_node(net)
+        raw = RawPeer(net)
+        raw.connect(node1)
+        net.scheduler.run_for(0.2)
+        raw.subscribe(node1, "t")
+        net.scheduler.run_for(1.2)            # heartbeat grafts raw
+        assert raw.pid in node1.rt.mesh["t"]
+        assert node2.pid not in node1.peers
+        from go_libp2p_pubsub_tpu.core.types import PeerInfo
+        raw.send(node1, RPC(control=ControlMessage(prune=[ControlPrune(
+            topic="t", peers=[PeerInfo(peer_id=node2.pid)], backoff=60.0)])))
+        net.scheduler.run_for(0.5)
+        assert raw.pid not in node1.rt.mesh["t"]
+        assert node2.pid in node1.peers       # PX dial happened
+
+    def test_px_ignored_below_accept_threshold(self):
+        net = Network()
+        node1 = one_node(
+            net,
+            score_params=PeerScoreParams(app_specific_score=lambda p: 0.0,
+                                         topics={}),
+            thresholds=PeerScoreThresholds(accept_px_threshold=10.0))
+        node1.join("t").subscribe()
+        node2 = one_node(net)
+        raw = RawPeer(net)
+        raw.connect(node1)
+        net.scheduler.run_for(0.2)
+        raw.subscribe(node1, "t")
+        net.scheduler.run_for(1.2)
+        from go_libp2p_pubsub_tpu.core.types import PeerInfo
+        raw.send(node1, RPC(control=ControlMessage(prune=[ControlPrune(
+            topic="t", peers=[PeerInfo(peer_id=node2.pid)], backoff=60.0)])))
+        net.scheduler.run_for(0.5)
+        # score 0 < accept_px_threshold 10: PX records ignored
+        assert node2.pid not in node1.peers
+
+
+class TestRPCFragmentation:
+    """fragment_rpc (gossipsub.go:1204-1293; TestFragmentRPCFunction,
+    gossipsub_test.go:2338)."""
+
+    def _mk_msg(self, i, size):
+        from go_libp2p_pubsub_tpu.core.types import Message
+        return Message(from_peer="p", seqno=i.to_bytes(8, "big"), topic="t",
+                       data=b"x" * size)
+
+    def test_fragments_stay_under_limit_and_preserve_messages(self):
+        from go_libp2p_pubsub_tpu.routers.gossipsub import fragment_rpc
+        limit = 1024
+        msgs = [self._mk_msg(i, 300) for i in range(10)]
+        rpc = RPC(publish=list(msgs))
+        frags = fragment_rpc(rpc, limit)
+        assert len(frags) > 1
+        for f in frags:
+            assert f.size() < limit
+        out = [m for f in frags for m in f.publish]
+        assert [m.seqno for m in out] == [m.seqno for m in msgs]
+
+    def test_oversize_single_message_raises(self):
+        import pytest
+        from go_libp2p_pubsub_tpu.routers.gossipsub import fragment_rpc
+        rpc = RPC(publish=[self._mk_msg(0, 5000)])
+        with pytest.raises(ValueError):
+            fragment_rpc(rpc, 1024)
+
+    def test_large_ihave_id_lists_split(self):
+        from go_libp2p_pubsub_tpu.routers.gossipsub import fragment_rpc
+        limit = 512
+        ids = [f"msgid-{i:06d}" for i in range(200)]
+        rpc = RPC(control=ControlMessage(ihave=[ControlIHave(
+            topic="t", message_ids=list(ids))]))
+        frags = fragment_rpc(rpc, limit)
+        for f in frags:
+            assert f.size() < limit
+        got = [m for f in frags if f.control
+               for ih in f.control.ihave for m in ih.message_ids]
+        assert sorted(got) == sorted(ids)
+
+    def test_oversized_iwant_reply_is_fragmented_on_send(self):
+        # end-to-end: one IWANT asking for 8 large messages coalesces into a
+        # single reply RPC bigger than max_message_size, which the send path
+        # must fragment (gossipsub.go:626-627 single reply; 1167-1182)
+        net = Network()
+        node = one_node(net, params=GossipSubParams())
+        node.max_message_size = 2048
+        node.join("t").subscribe()
+        raw = RawPeer(net)
+        raw.connect(node)
+        net.scheduler.run_for(0.2)
+        raw.subscribe(node, "t")
+        net.scheduler.run_for(1.2)
+        for i in range(8):
+            node.my_topics["t"].publish(b"y" * 400)
+        net.scheduler.run_for(0.5)
+        pushed = raw.received_messages()
+        assert len(pushed) == 8
+        mids = [node.id_gen.id(m) for m in pushed]
+        raw.inbox.clear()
+        raw.send(node, RPC(control=ControlMessage(
+            iwant=[ControlIWant(message_ids=mids)])))
+        net.scheduler.run_for(0.3)
+        data_rpcs = [r for r in raw.inbox if r.publish]
+        assert len(data_rpcs) > 1, "the coalesced reply must be fragmented"
+        assert len([m for r in data_rpcs for m in r.publish]) == 8
+        for r in raw.inbox:
+            assert r.size() < 2048
+
+
+class TestPiggybacking:
+    """Queued control rides the next outbound RPC; stale entries are
+    filtered against current mesh state (gossipsub.go:1142-1160,
+    1822-1864)."""
+
+    def test_pending_graft_rides_data_rpc(self):
+        net = Network()
+        node = one_node(net)
+        node.join("t").subscribe()
+        raw = RawPeer(net)
+        raw.connect(node)
+        net.scheduler.run_for(0.2)
+        raw.subscribe(node, "t")
+        net.scheduler.run_for(1.2)
+        assert raw.pid in node.rt.mesh["t"]
+        raw.inbox.clear()
+        node.rt.push_control(raw.pid, ControlMessage(
+            graft=[ControlGraft(topic="t")]))
+        node.my_topics["t"].publish(b"payload")
+        net.scheduler.run_for(0.3)
+        combined = [r for r in raw.inbox if r.publish and r.control
+                    and r.control.graft]
+        assert combined, "pending GRAFT should piggyback on the data RPC"
+
+    def test_stale_prune_filtered(self):
+        net = Network()
+        node = one_node(net)
+        node.join("t").subscribe()
+        raw = RawPeer(net)
+        raw.connect(node)
+        net.scheduler.run_for(0.2)
+        raw.subscribe(node, "t")
+        net.scheduler.run_for(1.2)
+        assert raw.pid in node.rt.mesh["t"]
+        raw.inbox.clear()
+        # a queued PRUNE for a peer currently IN the mesh is stale: filtered
+        node.rt.push_control(raw.pid, ControlMessage(
+            prune=[ControlPrune(topic="t")]))
+        node.my_topics["t"].publish(b"payload")
+        net.scheduler.run_for(0.3)
+        assert not [r for r in raw.inbox if r.control and r.control.prune]
