@@ -113,7 +113,7 @@ def main() -> None:
             res = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)], env=env,
                 capture_output=True, text=True,
-                timeout=int(os.environ.get("BENCH_TIMEOUT", 1800)))
+                timeout=int(os.environ.get("BENCH_TIMEOUT", 900)))
             for line in res.stdout.splitlines():
                 if line.startswith("{"):
                     print(line, flush=True)

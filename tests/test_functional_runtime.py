@@ -407,3 +407,94 @@ class TestConnManagerIntegration:
         cm = a.host.conn_manager
         for peer in a.rt.mesh["t"]:
             assert cm.is_protected(peer, "pubsub:t")
+
+
+class TestReconnects:
+    def test_delivery_resumes_after_reconnect(self):
+        """floodsub_test.go:234 TestReconnects: kill the connection, watch
+        delivery stop, reconnect, watch it resume (dead-peer handling
+        pubsub.go:711-757 + notify.go re-adds the peer)."""
+        net, nodes = make_net(2, GossipSubRouter, connect="all")
+        a, b = nodes
+        sub = b.join("t").subscribe()
+        a.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        a.my_topics["t"].publish(b"one")
+        net.scheduler.run_for(0.5)
+        assert [m.data for m in drain(sub)] == [b"one"]
+
+        a.host.disconnect(b.pid)
+        net.scheduler.run_for(0.5)
+        a.my_topics["t"].publish(b"lost")
+        net.scheduler.run_for(0.5)
+        assert drain(sub) == []            # the link is down
+
+        a.host.connect(b.host)
+        net.scheduler.run_for(2.0)         # hello + heartbeat regraft
+        a.my_topics["t"].publish(b"back")
+        net.scheduler.run_for(1.5)
+        datas = [m.data for m in drain(sub)]
+        assert b"back" in datas
+
+
+class TestValidationQueueOverflow:
+    def test_queue_overflow_drops_and_traces(self):
+        """validation.go:246-260: the front-end queue cap drops messages
+        beyond queue_size in one scheduler slot; the tracer records the
+        rejections."""
+        from go_libp2p_pubsub_tpu.api.validation import Validation
+        from go_libp2p_pubsub_tpu.trace import MemoryTracer
+        from go_libp2p_pubsub_tpu.trace import events as ev
+
+        net = Network()
+        tracer = MemoryTracer()
+        ha, hb = net.add_host(), net.add_host()
+        a = PubSub(ha, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        b = PubSub(hb, GossipSubRouter(), sign_policy=LAX_NO_SIGN,
+                   validation=Validation(queue_size=2, worker_delay=0.05),
+                   event_tracer=tracer)
+        net.connect(ha, hb)
+        net.scheduler.run_for(0.2)
+        a.join("t").subscribe()
+        sub = b.join("t").subscribe()
+        b.register_topic_validator("t", lambda src, msg: 0)
+        net.scheduler.run_for(1.5)
+        # the burst lands in one scheduler slot, overflowing the 2-deep queue
+        for i in range(10):
+            a.my_topics["t"].publish(b"m%d" % i)
+        net.scheduler.run_for(1.0)
+        got = len(drain(sub))
+        rejected = [e for e in tracer.events if e.get("type") == "REJECT_MESSAGE"
+                    and e["rejectMessage"]["reason"] == ev.REJECT_VALIDATION_QUEUE_FULL]
+        assert got < 10
+        assert rejected, "queue-full drops must be traced"
+
+
+class TestValidationThrottled:
+    def test_exhausted_async_budget_throttles(self):
+        """validation.go:344-356: no async-validation budget left ->
+        RejectValidationThrottled; messages are dropped, not delivered."""
+        from go_libp2p_pubsub_tpu.api.validation import Validation
+        from go_libp2p_pubsub_tpu.trace import MemoryTracer
+        from go_libp2p_pubsub_tpu.trace import events as ev
+
+        net = Network()
+        tracer = MemoryTracer()
+        ha, hb = net.add_host(), net.add_host()
+        a = PubSub(ha, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        b = PubSub(hb, GossipSubRouter(), sign_policy=LAX_NO_SIGN,
+                   validation=Validation(throttle=0),
+                   event_tracer=tracer)
+        net.connect(ha, hb)
+        net.scheduler.run_for(0.2)
+        a.join("t").subscribe()
+        sub = b.join("t").subscribe()
+        b.register_topic_validator("t", lambda src, msg: 0)
+        net.scheduler.run_for(1.5)
+        for i in range(5):
+            a.my_topics["t"].publish(b"m%d" % i)
+        net.scheduler.run_for(1.0)
+        assert drain(sub) == []
+        throttled = [e for e in tracer.events if e.get("type") == "REJECT_MESSAGE"
+                     and e["rejectMessage"]["reason"] == ev.REJECT_VALIDATION_THROTTLED]
+        assert len(throttled) == 5
