@@ -33,7 +33,8 @@ TARGET_HBPS = 1000.0
 
 def bench_one(name, cfg, tp, st, ticks):
     import jax
-    from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction, run
+    from go_libp2p_pubsub_tpu.sim.engine import (
+        delivery_fraction, delivery_latency_ticks, run)
 
     k_warm, k_meas = jax.random.split(jax.random.PRNGKey(0))
     # warmup with the SAME n_ticks (static jit arg): compiles the measured
@@ -55,6 +56,8 @@ def bench_one(name, cfg, tp, st, ticks):
         "unit": "heartbeats/s",
         "vs_baseline": round(hbps / TARGET_HBPS, 4),
         "delivery_fraction": round(float(delivery_fraction(st, cfg)), 4),
+        "mean_delivery_latency_ticks": round(
+            float(delivery_latency_ticks(st, cfg)), 3),
         "n_peers": cfg.n_peers,
     }), flush=True)
 

@@ -9,7 +9,6 @@ test parity (gossipsub_connmgr_test.go asserts protection/tag state).
 
 from __future__ import annotations
 
-from typing import Callable
 
 from ..core.types import PeerID
 

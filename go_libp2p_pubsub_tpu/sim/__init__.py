@@ -2,7 +2,7 @@ from .config import SimConfig, TopicParams  # noqa: F401
 from .state import SimState, init_state  # noqa: F401
 from . import topology  # noqa: F401
 
-_ENGINE_EXPORTS = ("delivery_fraction", "mesh_degrees", "run", "step", "step_jit",
+_ENGINE_EXPORTS = ("delivery_fraction", "delivery_latency_ticks", "mesh_degrees", "run", "step", "step_jit",
                    "choose_publishers")
 
 

@@ -31,7 +31,7 @@ from ..ops.heartbeat import heartbeat
 from ..ops.propagate import forward_tick, publish
 from ..ops.score_ops import decay_counters
 from .config import SimConfig, TopicParams
-from .state import SimState
+from .state import NEVER, SimState
 
 
 def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
@@ -97,3 +97,22 @@ def delivery_fraction(state: SimState, cfg: SimConfig) -> jnp.ndarray:
     should = state.subscribed[:, t_m] & alive[None, :] & (state.msg_topic >= 0)[None, :]
     got = state.have & should
     return jnp.sum(got) / jnp.maximum(jnp.sum(should), 1)
+
+
+def delivery_latency_ticks(state: SimState, cfg: SimConfig) -> jnp.ndarray:
+    """Mean ticks from publish to delivery over delivered (peer, message)
+    pairs in the live window — the propagation-latency metric of BASELINE
+    config #5 (floodsub/randomsub/gossipsub sweep).
+
+    The publisher's own zero-latency pair (publish() stamps its
+    deliver_tick at the publish tick) is excluded by subtracting exactly
+    one pair per live message; receivers' genuine same-tick deliveries
+    still count as latency 0. Returns 0 when nothing but publishers
+    delivered."""
+    alive = (state.msg_publish_tick < NEVER) & \
+        ((state.tick - state.msg_publish_tick) < cfg.history_length)
+    dlv = (state.deliver_tick < NEVER) & alive[None, :]
+    lat = (state.deliver_tick - state.msg_publish_tick[None, :]).astype(jnp.float32)
+    n_msgs = jnp.sum(jnp.any(dlv, axis=0))      # one publisher pair each
+    n_pairs = jnp.sum(dlv) - n_msgs
+    return jnp.sum(jnp.where(dlv, lat, 0.0)) / jnp.maximum(n_pairs, 1)

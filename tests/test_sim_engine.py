@@ -14,7 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from go_libp2p_pubsub_tpu.core.params import PeerScoreParams, TopicScoreParams
+from go_libp2p_pubsub_tpu.core.params import TopicScoreParams
 from go_libp2p_pubsub_tpu.ops.heartbeat import edge_gather, heartbeat
 from go_libp2p_pubsub_tpu.ops.score_ops import compute_scores, decay_counters
 from go_libp2p_pubsub_tpu.sim import (
@@ -267,3 +267,50 @@ class TestFloodPublish:
 
         assert one_tick(flood=False) == 1     # only the publisher holds it
         assert one_tick(flood=True) == 32     # everyone got the origin copy
+
+
+class TestDeliveryLatency:
+    def test_latency_counts_receivers_not_publisher(self):
+        from go_libp2p_pubsub_tpu.sim import delivery_latency_ticks
+        from go_libp2p_pubsub_tpu.sim.state import NEVER
+        cfg = small_cfg(n_peers=4, k_slots=4, msg_window=4, history_length=100)
+        topo = topology.full(4, 4)
+        tp = TopicParams.disabled(1)
+        st = init_state(cfg, topo)
+        # message 0 published by peer 0 at tick 10; peers 1,2 get it at 11
+        # and 13; peer 3 never does -> mean over receivers = (1+3)/2
+        st = st._replace(
+            tick=jnp.int32(14),
+            msg_topic=st.msg_topic.at[0].set(0),
+            msg_publish_tick=st.msg_publish_tick.at[0].set(10),
+            deliver_tick=st.deliver_tick.at[0, 0].set(10)
+                                        .at[1, 0].set(11)
+                                        .at[2, 0].set(13))
+        assert float(delivery_latency_ticks(st, cfg)) == pytest.approx(2.0)
+
+    def test_publisher_only_message_reports_zero(self):
+        from go_libp2p_pubsub_tpu.sim import delivery_latency_ticks
+        cfg = small_cfg(n_peers=4, k_slots=4, msg_window=4, history_length=100)
+        topo = topology.full(4, 4)
+        tp = TopicParams.disabled(1)
+        st = init_state(cfg, topo)
+        st = st._replace(
+            tick=jnp.int32(14),
+            msg_topic=st.msg_topic.at[0].set(0),
+            msg_publish_tick=st.msg_publish_tick.at[0].set(10),
+            deliver_tick=st.deliver_tick.at[0, 0].set(10))
+        # nobody but the publisher delivered: no receiver pairs, mean 0
+        assert float(delivery_latency_ticks(st, cfg)) == 0.0
+
+    def test_expired_messages_excluded(self):
+        from go_libp2p_pubsub_tpu.sim import delivery_latency_ticks
+        cfg = small_cfg(n_peers=4, k_slots=4, msg_window=4, history_length=2)
+        topo = topology.full(4, 4)
+        tp = TopicParams.disabled(1)
+        st = init_state(cfg, topo)
+        st = st._replace(
+            tick=jnp.int32(50),                 # long past history_length
+            msg_topic=st.msg_topic.at[0].set(0),
+            msg_publish_tick=st.msg_publish_tick.at[0].set(10),
+            deliver_tick=st.deliver_tick.at[0, 0].set(10).at[1, 0].set(12))
+        assert float(delivery_latency_ticks(st, cfg)) == 0.0
