@@ -523,3 +523,74 @@ class TestPiggybacking:
         node.my_topics["t"].publish(b"payload")
         net.scheduler.run_for(0.3)
         assert not [r for r in raw.inbox if r.control and r.control.prune]
+
+
+class TestFeatureNegotiation:
+    """Protocol feature tests (gossipsub_feat.go:24-36;
+    gossipsub_matchfn_test.go): v1.0 peers participate in the mesh but
+    never receive PX records; custom feature tests rewire both."""
+
+    def _node_with_v10_mesh_peer(self, feature_test=None):
+        from go_libp2p_pubsub_tpu.routers.feat import GOSSIPSUB_ID_V10
+        net = Network()
+        kw = dict(params=GossipSubParams(d=2, dlo=1, dhi=2, dscore=1, dout=0),
+                  do_px=True)
+        if feature_test is not None:
+            kw["feature_test"] = feature_test
+        node = one_node(net, **kw)
+        sub = node.join("t").subscribe()
+        # an old v1.0 speaker plus v1.1 peers to fill the mesh
+        old = RawPeer(net)
+        old.host.set_protocols([GOSSIPSUB_ID_V10], lambda p, proto: None,
+                               lambda src, rpc: old.inbox.append(rpc))
+        news = [RawPeer(net) for _ in range(3)]
+        old.connect(node)
+        for r in news:
+            r.connect(node)
+        net.scheduler.run_for(0.2)
+        old.subscribe(node, "t")
+        for r in news:
+            r.subscribe(node, "t")
+        net.scheduler.run_for(0.2)
+        # old + one new graft in; mesh (dhi=2) fills
+        old.send(node, RPC(control=ControlMessage(graft=[ControlGraft(topic="t")])))
+        news[0].send(node, RPC(control=ControlMessage(graft=[ControlGraft(topic="t")])))
+        net.scheduler.run_for(0.2)
+        return net, node, old, news, sub
+
+    def test_v10_peer_grafts_but_gets_no_px(self):
+        net, node, old, news, sub = self._node_with_v10_mesh_peer()
+        assert old.pid in node.rt.mesh["t"]          # MESH feature: yes
+        # force a PRUNE toward the old peer by unsubscribing the topic
+        old.inbox.clear()
+        sub.cancel()
+        net.scheduler.run_for(0.3)
+        prunes = old.received_prunes()
+        assert prunes, "Leave must PRUNE the v1.0 mesh member"
+        assert all(not pr.peers for pr in prunes), \
+            "PX records must never go to a v1.0 peer"
+        assert all(pr.backoff == 0 for pr in prunes), \
+            "v1.0 prunes carry no backoff field"
+
+    def test_v11_peer_gets_px_on_leave(self):
+        net, node, old, news, sub = self._node_with_v10_mesh_peer()
+        grafted = news[0]
+        assert grafted.pid in node.rt.mesh["t"]
+        grafted.inbox.clear()
+        sub.cancel()
+        net.scheduler.run_for(0.3)
+        prunes = grafted.received_prunes()
+        assert prunes and prunes[0].backoff > 0
+        # unsubscribe-leave does PX to v1.1 peers when do_px is on
+        assert any(pr.peers for pr in prunes)
+
+    def test_custom_feature_test_disables_px_everywhere(self):
+        from go_libp2p_pubsub_tpu.routers.feat import GossipSubFeature
+        def no_px(feat, proto):
+            return feat == GossipSubFeature.MESH
+        net, node, old, news, sub = self._node_with_v10_mesh_peer(feature_test=no_px)
+        grafted = news[0]
+        grafted.inbox.clear()
+        sub.cancel()
+        net.scheduler.run_for(0.3)
+        assert all(not pr.peers for pr in grafted.received_prunes())
