@@ -590,7 +590,9 @@ class TestFeatureNegotiation:
             return feat == GossipSubFeature.MESH
         net, node, old, news, sub = self._node_with_v10_mesh_peer(feature_test=no_px)
         grafted = news[0]
+        assert grafted.pid in node.rt.mesh["t"]
         grafted.inbox.clear()
         sub.cancel()
         net.scheduler.run_for(0.3)
-        assert all(not pr.peers for pr in grafted.received_prunes())
+        prunes = grafted.received_prunes()
+        assert prunes and all(not pr.peers for pr in prunes)
