@@ -89,6 +89,11 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
     else:
         ign = jnp.zeros((p,), bool)
     msg_ignored = state.msg_ignored.at[slots].set(ign)
+    msg_publisher = state.msg_publisher.at[slots].set(publishers)
+    if cfg.record_provenance:
+        deliver_from = state.deliver_from.at[:, slots].set(-1)
+    else:
+        deliver_from = state.deliver_from      # dormant buffer, no hot-path op
     # reset recycled slots, then mark the publisher as having it
     have = state.have.at[:, slots].set(False)
     have = have.at[publishers, slots].set(True)
@@ -102,7 +107,9 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
         jnp.where(sub_pub, cur_lp, state.tick))
     return state._replace(msg_topic=msg_topic, msg_publish_tick=msg_publish_tick,
                           msg_invalid=msg_invalid, msg_ignored=msg_ignored,
+                          msg_publisher=msg_publisher,
                           have=have, deliver_tick=deliver_tick,
+                          deliver_from=deliver_from,
                           iwant_pending=iwant_pending,
                           fanout_lastpub=fanout_lastpub)
 
@@ -456,9 +463,15 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
 
     newly_dlv = dlv_bits & ~dlv_start
     have = unpack_words(have_bits, m)
-    deliver_tick = jnp.where(unpack_words(newly_dlv, m), state.tick,
-                             state.deliver_tick)
+    new_dlv_mask = unpack_words(newly_dlv, m)
+    deliver_tick = jnp.where(new_dlv_mask, state.tick, state.deliver_tick)
     delivered = popcount_sum(have_bits, axis=(0, 1)) - n_have_start
+
+    if cfg.record_provenance:
+        # winning sender slot per first delivery this tick (nv_acc holds the
+        # per-slot first-delivery bit sets, pulls included) — trace export
+        state = state._replace(deliver_from=jnp.where(
+            new_dlv_mask, _bits_to_slot(nv_acc, m), state.deliver_from))
 
     state = state._replace(
         have=have, deliver_tick=deliver_tick,

@@ -59,8 +59,8 @@ def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
         gater_reject=(2, True),
         msg_topic=(1, False),
         msg_publish_tick=(1, False), msg_invalid=(1, False),
-        msg_ignored=(1, False),
-        have=(2, True), deliver_tick=(2, True),
+        msg_ignored=(1, False), msg_publisher=(1, False),
+        have=(2, True), deliver_tick=(2, True), deliver_from=(2, True),
         iwant_pending=(2, True), delivered_total=(0, False),
     )
     assert set(layout) == set(SimState._fields), "layout drifted from SimState"

@@ -50,4 +50,7 @@ def restore(path: str, like: SimState) -> SimState:
             out = ckpt.restore(path, jax.device_get(like))
         return SimState(*[jnp.asarray(x) for x in out])
     npz = np.load(path if path.endswith(".npz") else path + ".npz")
-    return SimState(*[jnp.asarray(npz[f]) for f in SimState._fields])
+    # fields added after a checkpoint was written restore from ``like``
+    # (new fields carry inert defaults, e.g. provenance buffers at -1)
+    return SimState(*[jnp.asarray(npz[f]) if f in npz.files else getattr(like, f)
+                      for f in SimState._fields])

@@ -90,6 +90,12 @@ class SimConfig:
 
     scoring_enabled: bool = True
 
+    # record delivery provenance (msg_publisher / deliver_from) so a run can
+    # be exported as a pb/trace event stream (sim/trace_export.py); when on
+    # it costs a bit-plane decode + two scatters per tick, when off
+    # deliver_from is a dormant buffer no hot-path op touches
+    record_provenance: bool = False
+
     # --- peer gater (peer_gater.go:19-116), ticks domain; off by default so
     # non-gater configs compile the same op graph (RNG streams shifted by
     # the extra key splits, so trajectories differ from round-1 builds) ---

@@ -85,8 +85,13 @@ class SimState(NamedTuple):
     msg_ignored: jnp.ndarray          # [M] bool: validation verdict IGNORE
                                       #   (dropped + seen, no P4, gater
                                       #   counts ignore — validation.go:344-370)
+    msg_publisher: jnp.ndarray        # [M] int32 origin peer, -1 idle
     have: jnp.ndarray                 # [N, M] bool (seen/validated)
     deliver_tick: jnp.ndarray         # [N, M] int32, NEVER if not delivered
+    deliver_from: jnp.ndarray         # [N, M] int32 neighbor slot the first
+                                      #   delivery came from, -1 (self/none);
+                                      #   maintained only under
+                                      #   cfg.record_provenance (trace export)
     iwant_pending: jnp.ndarray        # [N, M] int32 source peer for pending
                                       #   gossip pull, -1 if none
 
@@ -159,8 +164,10 @@ def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
         msg_publish_tick=i32(m, fill=int(NEVER)),
         msg_invalid=b(m),
         msg_ignored=b(m),
+        msg_publisher=i32(m, fill=-1),
         have=b(n, m),
         deliver_tick=i32(n, m, fill=int(NEVER)),
+        deliver_from=i32(n, m, fill=-1),
         iwant_pending=i32(n, m, fill=-1),
         delivered_total=jnp.float32(0.0),
     )

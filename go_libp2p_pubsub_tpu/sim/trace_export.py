@@ -1,0 +1,127 @@
+"""Export a batched-sim run as a pb/trace event stream.
+
+The inverse of trace/replay.py: where replay injects a recorded event
+stream into ``SimState``, this module diffs consecutive states of a
+``cfg.record_provenance`` run into tracer-bus event dicts (trace/bus.py
+shape — the same dicts ``pb.codec.encode_trace_event`` serializes and
+``tensorize_trace`` consumes). Together they close the interop loop the
+trace schema exists for (SURVEY.md §5.1: the pb/trace contract): a sim run
+can be serialized, analyzed by trace tooling, or replayed into a fresh
+state.
+
+Event coverage: JOIN/LEAVE, ADD_PEER/REMOVE_PEER (connection churn),
+GRAFT/PRUNE, PUBLISH_MESSAGE, DELIVER_MESSAGE (with first-delivery
+provenance from ``deliver_from``). Duplicate and reject streams are NOT
+exported — the batched engine aggregates them into counters without
+per-event provenance — so a replay reproduces mesh/subscription/delivery
+state and the P1/P2 counters exactly, while P3/P4 duplicate- and
+invalid-driven counters replay as zero.
+
+Timestamps: events of the step that advanced ``tick`` T -> T+1 are stamped
+T + 0.5, so tensorize_trace's decay boundaries (at integer seconds, 1s ==
+1 tick) interleave exactly like engine.step's decay_counters call (decay
+precedes the tick's deliveries; the tick-0 decay acts on all-zero counters
+and is a no-op on both sides).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from .config import SimConfig, TopicParams
+from .state import SimState
+
+
+def default_peer_name(i: int) -> str:
+    return f"p{i}"
+
+
+def default_topic_name(t: int) -> str:
+    return f"t{t}"
+
+
+def export_events(prev: SimState, cur: SimState,
+                  peer_name=default_peer_name,
+                  topic_name=default_topic_name) -> list[dict]:
+    """Tracer-bus event dicts for one engine.step (prev -> cur)."""
+    prev = jax.device_get(prev)
+    cur = jax.device_get(cur)
+    tick = int(prev.tick)               # the step that ran
+    ts = tick + 0.5
+    out: list[dict] = []
+
+    def ev(typ, pid, key, payload):
+        out.append({"type": typ, "peerID": peer_name(pid),
+                    "timestamp": ts, key: payload})
+
+    # --- subscriptions (churn_subscriptions runs first in the step) ---
+    joined = np.argwhere(cur.subscribed & ~prev.subscribed)
+    left = np.argwhere(prev.subscribed & ~cur.subscribed)
+    for n, t in joined:
+        ev("JOIN", n, "join", {"topic": topic_name(t)})
+    for n, t in left:
+        ev("LEAVE", n, "leave", {"topic": topic_name(t)})
+
+    # --- connection churn (both directions exist in state; each side
+    # reports its own view, matching the notifiee fan-out) ---
+    nbr = np.asarray(cur.neighbors)
+    for n, k in np.argwhere(cur.connected & ~prev.connected):
+        ev("ADD_PEER", n, "addPeer", {"peerID": peer_name(nbr[n, k])})
+    for n, k in np.argwhere(prev.connected & ~cur.connected):
+        ev("REMOVE_PEER", n, "removePeer", {"peerID": peer_name(nbr[n, k])})
+
+    # --- mesh maintenance (heartbeat GRAFT/PRUNE exchange) ---
+    for n, t, k in np.argwhere(cur.mesh & ~prev.mesh):
+        ev("GRAFT", n, "graft", {"peerID": peer_name(nbr[n, k]),
+                                 "topic": topic_name(t)})
+    for n, t, k in np.argwhere(prev.mesh & ~cur.mesh):
+        ev("PRUNE", n, "prune", {"peerID": peer_name(nbr[n, k]),
+                                 "topic": topic_name(t)})
+
+    # --- data plane: publishes then deliveries ---
+    pub_slots = np.flatnonzero(np.asarray(cur.msg_publish_tick) == tick)
+    mid_of = {}
+    for s in pub_slots:
+        mid_of[s] = f"m{tick}_{s}"
+        ev("PUBLISH_MESSAGE", int(cur.msg_publisher[s]), "publishMessage",
+           {"messageID": mid_of[s], "topic": topic_name(int(cur.msg_topic[s]))})
+
+    def mid(s):
+        # a slot delivered this tick was published at msg_publish_tick[s]
+        return f"m{int(cur.msg_publish_tick[s])}_{s}"
+
+    dlv = np.argwhere((np.asarray(cur.deliver_tick) == tick)
+                      & (np.asarray(cur.msg_topic)[None, :] >= 0))
+    dfrom = np.asarray(cur.deliver_from)
+    publisher = np.asarray(cur.msg_publisher)
+    for n, s in dlv:
+        topic = topic_name(int(cur.msg_topic[s]))
+        if publisher[s] == n and int(cur.msg_publish_tick[s]) == tick:
+            rf = peer_name(n)           # local publish: received_from == self
+        else:
+            slot = dfrom[n, s]
+            rf = peer_name(nbr[n, slot]) if slot >= 0 else peer_name(n)
+        ev("DELIVER_MESSAGE", n, "deliverMessage",
+           {"messageID": mid(s), "topic": topic, "receivedFrom": rf})
+    return out
+
+
+def run_traced(state: SimState, cfg: SimConfig, tp: TopicParams, key,
+               n_ticks: int):
+    """Host-stepped run collecting the exported event stream.
+
+    Returns (final_state, events). Requires cfg.record_provenance. Intended
+    for differential testing and trace tooling at diagnostic scale — the
+    per-tick host sync makes it unfit for benchmarking.
+    """
+    assert cfg.record_provenance, "run_traced needs cfg.record_provenance"
+    from .engine import step_jit
+
+    events: list[dict] = []
+    for i in range(n_ticks):
+        key, k = jax.random.split(key)
+        nxt = step_jit(state, cfg, tp, k)
+        events.extend(export_events(state, nxt))
+        state = nxt
+    return state, events

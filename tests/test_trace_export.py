@@ -1,0 +1,89 @@
+"""Round-trip: batched-sim run -> pb/trace event stream -> replay -> state.
+
+Closes the trace-interop loop (SURVEY.md §5.1): sim/trace_export.py emits
+the same tracer-bus dicts the functional runtime's EventTracer produces;
+pb/codec serializes them; trace/replay.py re-injects them. Mesh,
+subscriptions, delivery state, and the first-delivery score counters must
+survive the full cycle exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from go_libp2p_pubsub_tpu.core.params import TopicScoreParams
+from go_libp2p_pubsub_tpu.pb.codec import decode_trace_bytes, encode_trace_event
+from go_libp2p_pubsub_tpu.pb.codec import write_uvarint
+from go_libp2p_pubsub_tpu.sim import SimConfig, init_state, topology
+from go_libp2p_pubsub_tpu.sim.state import NEVER
+from go_libp2p_pubsub_tpu.sim.trace_export import run_traced
+from go_libp2p_pubsub_tpu.trace.replay import (
+    replay_feed, replay_topic_params, tensorize_trace)
+
+TSP = TopicScoreParams(
+    topic_weight=1.0, time_in_mesh_quantum=1.0,
+    first_message_deliveries_weight=1.0, first_message_deliveries_decay=1.0,
+    first_message_deliveries_cap=100.0)
+
+N, K, TICKS = 24, 8, 6
+
+
+def _run_and_replay():
+    from go_libp2p_pubsub_tpu.sim.config import TopicParams
+
+    cfg = SimConfig(n_peers=N, k_slots=K, n_topics=1, msg_window=32,
+                    publishers_per_tick=2, prop_substeps=4,
+                    scoring_enabled=True, record_provenance=True)
+    tp = TopicParams.from_topic_params([TSP])
+    topo = topology.sparse(N, K, degree=4, seed=9)
+    st0 = init_state(cfg, topo)
+    st, events = run_traced(st0, cfg, tp, jax.random.PRNGKey(5), TICKS)
+
+    # initial conditions as events: everyone joined topic 0 at t=0
+    pre = [{"type": "JOIN", "peerID": f"p{i}", "timestamp": 0.1,
+            "join": {"topic": "t0"}} for i in range(N)]
+    events = pre + events
+
+    # serialize through the pb/trace wire format and back (schema fidelity)
+    blob = b"".join(write_uvarint(len(b)) + b
+                    for b in map(encode_trace_event, events))
+    decoded = decode_trace_bytes(blob)
+    assert len(decoded) == len(events)
+
+    peer_index = {f"p{i}": i for i in range(N)}
+    feed = tensorize_trace(decoded, peer_index, {"t0": 0},
+                           msg_window=64, decay_interval=1.0,
+                           t_end=float(TICKS))
+    rcfg = SimConfig(n_peers=N, k_slots=K, n_topics=1, msg_window=64,
+                     scoring_enabled=True)
+    rtp = replay_topic_params([TSP])
+    rst = init_state(rcfg, topo, subscribed=np.zeros((N, 1), bool))
+    rst = replay_feed(rst, rcfg, rtp, feed)
+    return st, rst, cfg, rcfg
+
+
+class TestSimTraceRoundTrip:
+    def setup_method(self):
+        self.st, self.rst, self.cfg, self.rcfg = _run_and_replay()
+
+    def test_subscriptions_match(self):
+        np.testing.assert_array_equal(np.asarray(self.st.subscribed),
+                                      np.asarray(self.rst.subscribed))
+
+    def test_mesh_matches(self):
+        np.testing.assert_array_equal(np.asarray(self.st.mesh),
+                                      np.asarray(self.rst.mesh))
+
+    def test_first_message_deliveries_match(self):
+        np.testing.assert_allclose(
+            np.asarray(self.st.first_message_deliveries),
+            np.asarray(self.rst.first_message_deliveries), atol=1e-5)
+
+    def test_delivery_counts_match(self):
+        # per-peer count of delivered messages (slot numbering differs
+        # between the run and the replay, counts must not)
+        sim_live = np.asarray(self.st.msg_topic) >= 0
+        sim_cnt = ((np.asarray(self.st.deliver_tick) < int(NEVER))
+                   & sim_live[None, :]).sum(axis=1)
+        rep_cnt = (np.asarray(self.rst.deliver_tick) < int(NEVER)).sum(axis=1)
+        np.testing.assert_array_equal(sim_cnt, rep_cnt)
