@@ -498,3 +498,67 @@ class TestValidationThrottled:
         throttled = [e for e in tracer.events if e.get("type") == "REJECT_MESSAGE"
                      and e["rejectMessage"]["reason"] == ev.REJECT_VALIDATION_THROTTLED]
         assert len(throttled) == 5
+
+
+class TestRpcInspector:
+    def test_inspector_gates_all_rpcs(self):
+        """WithAppSpecificRpcInspector (pubsub.go:1031-1037): a False verdict
+        drops the whole RPC before any processing."""
+        net = Network()
+        ha, hb = net.add_host(), net.add_host()
+        a = PubSub(ha, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        seen = []
+        def inspector(src, rpc):
+            seen.append(src)
+            return False                      # drop everything
+        b = PubSub(hb, GossipSubRouter(), sign_policy=LAX_NO_SIGN,
+                   rpc_inspector=inspector)
+        net.connect(ha, hb)
+        net.scheduler.run_for(0.2)
+        a.join("t").subscribe()
+        sub = b.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        a.my_topics["t"].publish(b"x")
+        net.scheduler.run_for(1.0)
+        assert seen, "inspector must have been consulted"
+        assert drain(sub) == []               # everything dropped
+        # b never even learned a's subscription (announcements inspected too)
+        assert a.pid not in b.topics.get("t", set())
+
+    def test_inspector_true_passes(self):
+        net = Network()
+        ha, hb = net.add_host(), net.add_host()
+        a = PubSub(ha, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        b = PubSub(hb, GossipSubRouter(), sign_policy=LAX_NO_SIGN,
+                   rpc_inspector=lambda src, rpc: True)
+        net.connect(ha, hb)
+        net.scheduler.run_for(0.2)
+        a.join("t").subscribe()
+        sub = b.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        a.my_topics["t"].publish(b"x")
+        net.scheduler.run_for(1.0)
+        assert [m.data for m in drain(sub)] == [b"x"]
+
+
+class TestRelayRefcounting:
+    def test_relay_cancel_releases(self):
+        """topic.go:186-207: relays hold the topic joined; the last cancel
+        releases it (router Leave fires)."""
+        net, nodes = make_net(3, GossipSubRouter, connect="all")
+        a, r, b = nodes
+        a.join("t").subscribe()
+        sub = b.join("t").subscribe()
+        cancel1 = r.join("t").relay()
+        cancel2 = r.my_topics["t"].relay()
+        net.scheduler.run_for(1.5)
+        assert "t" in r.rt.mesh              # relay keeps the router joined
+        a.my_topics["t"].publish(b"via-relay")
+        net.scheduler.run_for(1.0)
+        assert b"via-relay" in [m.data for m in drain(sub)]
+        cancel1()
+        net.scheduler.run_for(0.2)
+        assert "t" in r.rt.mesh              # one relay still holds it
+        cancel2()
+        net.scheduler.run_for(0.2)
+        assert "t" not in r.rt.mesh          # last cancel leaves the topic
