@@ -66,6 +66,7 @@ class Topic:
         self._event_handlers: list[TopicEventHandler] = []
         self._relay_count = 0
         self._closed = False
+        self._pending_pubs: list = []      # (Message, gate|None), FIFO
 
     # -- lifecycle --
 
@@ -130,6 +131,8 @@ class Topic:
         """topic.go:480-494: only an idle handle can be closed."""
         if self._subs or self._relay_count:
             raise RuntimeError("cannot close topic with active subscriptions or relays")
+        if self._pending_pubs:
+            raise RuntimeError("cannot close topic with pending gated publishes")
         self._closed = True
         self.p.my_topics.pop(self.name, None)
 
@@ -153,11 +156,24 @@ class Topic:
 
     # -- publish (topic.go:224-312) --
 
-    def publish(self, data: bytes, *, custom_key=None, local_only: bool = False) -> None:
+    def publish(self, data: bytes, *, custom_key=None, local_only: bool = False,
+                ready=None, ready_poll: float = 0.2) -> None:
         """Build, sign, validate and route a message. Raises ValidationError
         if local validation rejects it. ``local_only`` notifies in-process
-        subscribers without routing (WithLocalPublication, topic.go:323-331)."""
+        subscribers without routing (WithLocalPublication, topic.go:323-331).
+
+        ``ready`` is the WithReadiness gate (topic.go:270-309): a callable
+        polled on the scheduler; routing is deferred until it returns True
+        (the deterministic analogue of the reference blocking the caller
+        until RouterReady). Later publishes on the topic queue behind a
+        pending gated one so seqno order is preserved on the wire; a
+        deferred message a validator later rejects is dropped (the
+        rejection is traced by the validation pipeline — with no caller
+        left to raise into, the trace is the error surface). See
+        :meth:`ready_min_peers`."""
         self._check_closed()
+        if ready is not None and ready_poll <= 0:
+            raise ValueError("ready_poll must be positive")
         msg = Message(data=data, topic=self.name, received_from=self.p.pid,
                       local=local_only)
         if custom_key is not None:
@@ -169,7 +185,32 @@ class Topic:
                 sign_message(pid, key, msg)
         else:
             self.p.sign_and_finalize(msg)
+        if self._pending_pubs or (ready is not None and not ready()):
+            self._pending_pubs.append((msg, ready))
+            if len(self._pending_pubs) == 1:
+                self.p.scheduler.call_later(ready_poll,
+                                            lambda: self._drain_pubs(ready_poll))
+            return
         self.p.val.push_local(msg)
+
+    def _drain_pubs(self, poll: float) -> None:
+        from .validation import ValidationError
+        while self._pending_pubs:
+            msg, gate = self._pending_pubs[0]
+            if gate is not None and not gate():
+                self.p.scheduler.call_later(poll,
+                                            lambda: self._drain_pubs(poll))
+                return
+            self._pending_pubs.pop(0)
+            try:
+                self.p.val.push_local(msg)
+            except ValidationError:
+                pass    # traced by the pipeline; nothing left to raise into
+
+    def ready_min_peers(self, count: int = 1):
+        """Readiness predicate: the router reports enough topic peers
+        (MinTopicSize, discovery.go:79-83 + RouterReady, topic.go:316-321)."""
+        return lambda: self.p.rt.enough_peers(self.name, count)
 
     def set_score_params(self, params) -> None:
         """Per-topic score reconfiguration (topic.go:44-82)."""

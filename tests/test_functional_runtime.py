@@ -562,3 +562,70 @@ class TestRelayRefcounting:
         cancel2()
         net.scheduler.run_for(0.2)
         assert "t" not in r.rt.mesh          # last cancel leaves the topic
+
+
+class TestPublishReadiness:
+    def test_publish_defers_until_peers_arrive(self):
+        """WithReadiness (topic.go:270-309): routing waits for RouterReady;
+        the message goes out once the topic has enough peers."""
+        net = Network()
+        ha = net.add_host()
+        a = PubSub(ha, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        ta.subscribe()
+        # publish into an empty network with a min-1-peer readiness gate
+        ta.publish(b"wait-for-you", ready=ta.ready_min_peers(1))
+        net.scheduler.run_for(2.0)
+
+        hb = net.add_host()
+        b = PubSub(hb, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        sub = b.join("t").subscribe()
+        net.connect(ha, hb)
+        net.scheduler.run_for(3.0)           # hello + graft + deferred publish
+        assert [m.data for m in drain(sub)] == [b"wait-for-you"]
+
+    def test_ready_publish_routes_immediately(self):
+        net, nodes = make_net(2, GossipSubRouter, connect="all")
+        a, b = nodes
+        ta = a.join("t")
+        ta.subscribe()
+        sub = b.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        ta.publish(b"now", ready=ta.ready_min_peers(1))
+        net.scheduler.run_for(0.5)
+        assert [m.data for m in drain(sub)] == [b"now"]
+
+    def test_publishes_queue_behind_pending_gate_in_order(self):
+        """Later publishes on the topic wait behind a gated one so seqno
+        order is preserved for seqno-based replay validators."""
+        net = Network()
+        ha = net.add_host()
+        a = PubSub(ha, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        ta.subscribe()
+        ta.publish(b"first", ready=ta.ready_min_peers(1))
+        ta.publish(b"second")            # queues behind the gated publish
+        net.scheduler.run_for(1.0)
+        hb = net.add_host()
+        b = PubSub(hb, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        sub = b.join("t").subscribe()
+        net.connect(ha, hb)
+        net.scheduler.run_for(3.0)
+        assert [m.data for m in drain(sub)] == [b"first", b"second"]
+
+    def test_close_refuses_with_pending_publish(self):
+        import pytest
+        net = Network()
+        a = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        ta.publish(b"x", ready=lambda: False)
+        with pytest.raises(RuntimeError):
+            ta.close()
+
+    def test_zero_poll_rejected(self):
+        import pytest
+        net = Network()
+        a = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        with pytest.raises(ValueError):
+            ta.publish(b"x", ready=lambda: False, ready_poll=0.0)
