@@ -67,6 +67,7 @@ class Topic:
         self._relay_count = 0
         self._closed = False
         self._pending_pubs: list = []      # (Message, gate|None), FIFO
+        self._drain_scheduled = False      # one poll chain at a time
 
     # -- lifecycle --
 
@@ -165,12 +166,17 @@ class Topic:
         ``ready`` is the WithReadiness gate (topic.go:270-309): a callable
         polled on the scheduler; routing is deferred until it returns True
         (the deterministic analogue of the reference blocking the caller
-        until RouterReady). Later publishes on the topic queue behind a
-        pending gated one so seqno order is preserved on the wire; a
-        deferred message a validator later rejects is dropped (the
-        rejection is traced by the validation pipeline — with no caller
-        left to raise into, the trace is the error surface). See
-        :meth:`ready_min_peers`."""
+        until RouterReady). Later routed publishes on the topic queue
+        behind a pending gated one so seqno order is preserved on the
+        wire; ``local_only`` messages never touch the wire and therefore
+        bypass the queue and deliver immediately. A deferred message a
+        validator later rejects is dropped (the rejection is traced by
+        the validation pipeline — with no caller left to raise into, the
+        trace is the error surface). While a drain chain is pending, the
+        chain polls at the ``ready_poll`` of the publish that started it;
+        a later publish's ``ready_poll`` takes effect only once the queue
+        empties. A gate that never opens can be abandoned with
+        :meth:`cancel_pending_publishes`. See :meth:`ready_min_peers`."""
         self._check_closed()
         if ready is not None and ready_poll <= 0:
             raise ValueError("ready_poll must be positive")
@@ -185,9 +191,11 @@ class Topic:
                 sign_message(pid, key, msg)
         else:
             self.p.sign_and_finalize(msg)
-        if self._pending_pubs or (ready is not None and not ready()):
+        if not local_only and \
+                (self._pending_pubs or (ready is not None and not ready())):
             self._pending_pubs.append((msg, ready))
-            if len(self._pending_pubs) == 1:
+            if not self._drain_scheduled:
+                self._drain_scheduled = True
                 self.p.scheduler.call_later(ready_poll,
                                             lambda: self._drain_pubs(ready_poll))
             return
@@ -195,17 +203,40 @@ class Topic:
 
     def _drain_pubs(self, poll: float) -> None:
         from .validation import ValidationError
-        while self._pending_pubs:
-            msg, gate = self._pending_pubs[0]
-            if gate is not None and not gate():
+        # _drain_scheduled stays True for the whole drain so a reentrant
+        # publish (from a subscriber's handler) can't start a second chain.
+        try:
+            while self._pending_pubs:
+                msg, gate = self._pending_pubs[0]
+                if gate is not None and not gate():
+                    self.p.scheduler.call_later(poll,
+                                                lambda: self._drain_pubs(poll))
+                    return
+                self._pending_pubs.pop(0)
+                try:
+                    self.p.val.push_local(msg)
+                except ValidationError:
+                    pass    # traced by the pipeline; nothing left to raise into
+        except BaseException:
+            # A raising gate callable or subscriber handler must not wedge
+            # the chain: keep draining what remains, or release the flag.
+            if self._pending_pubs:
                 self.p.scheduler.call_later(poll,
                                             lambda: self._drain_pubs(poll))
-                return
-            self._pending_pubs.pop(0)
-            try:
-                self.p.val.push_local(msg)
-            except ValidationError:
-                pass    # traced by the pipeline; nothing left to raise into
+            else:
+                self._drain_scheduled = False
+            raise
+        self._drain_scheduled = False
+
+    def cancel_pending_publishes(self) -> int:
+        """Drop deferred gated publishes without routing them — the
+        deterministic analogue of cancelling the ctx that blocks the
+        reference's Topic.Publish readiness wait (topic.go:270-309).
+        Returns the number of messages dropped; after this, :meth:`close`
+        is no longer blocked by a gate that never opens."""
+        n = len(self._pending_pubs)
+        self._pending_pubs.clear()
+        return n
 
     def ready_min_peers(self, count: int = 1):
         """Readiness predicate: the router reports enough topic peers

@@ -613,6 +613,82 @@ class TestPublishReadiness:
         net.scheduler.run_for(3.0)
         assert [m.data for m in drain(sub)] == [b"first", b"second"]
 
+    def test_local_only_bypasses_pending_gate(self):
+        """local_only never touches the wire (pubsub.go `msg.local`), so it
+        must not queue behind a gated publish — it delivers immediately."""
+        net = Network()
+        a = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        sub = ta.subscribe()
+        net.scheduler.run_for(0.1)
+        ta.publish(b"gated", ready=lambda: False)   # never opens
+        ta.publish(b"local", local_only=True)
+        net.scheduler.run_for(0.5)
+        assert [m.data for m in drain(sub)] == [b"local"]
+
+    def test_reentrant_publish_single_drain_chain(self):
+        """A publish issued from a subscriber's on_message handler WHILE the
+        drain is delivering (push_local is synchronous) must not start a
+        second poll chain, duplicate, or reorder the queue."""
+        net = Network()
+        a = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        sub = ta.subscribe()
+        got = []
+
+        def handler(msg):
+            got.append(msg.data)
+            if msg.data == b"one":
+                # fires mid-drain, with "two" still queued behind us
+                ta.publish(b"reentrant", ready=lambda: True, ready_poll=0.1)
+
+        sub.on_message = handler
+        opened = [False]
+        ta.publish(b"one", ready=lambda: opened[0], ready_poll=0.1)
+        ta.publish(b"two")
+        net.scheduler.run_for(0.5)
+        assert got == []                    # gate closed: nothing delivered
+        opened[0] = True
+        net.scheduler.run_for(1.0)
+        assert got == [b"one", b"two", b"reentrant"]
+        assert not ta._pending_pubs and not ta._drain_scheduled
+
+    def test_raising_subscriber_does_not_wedge_drain(self):
+        """An exception escaping a subscriber handler mid-drain must not
+        leave the chain latched: the rest of the queue still routes."""
+        net = Network()
+        a = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        sub = ta.subscribe()
+        got = []
+
+        def handler(msg):
+            got.append(msg.data)
+            if msg.data == b"boom":
+                raise TypeError("subscriber bug")
+
+        sub.on_message = handler
+        opened = [False]
+        ta.publish(b"boom", ready=lambda: opened[0], ready_poll=0.1)
+        ta.publish(b"after")
+        opened[0] = True
+        with pytest.raises(TypeError):
+            net.scheduler.run_for(1.0)
+        net.scheduler.run_for(1.0)          # chain rescheduled, not wedged
+        assert got == [b"boom", b"after"]
+        assert not ta._pending_pubs and not ta._drain_scheduled
+
+    def test_cancel_pending_publishes_unblocks_close(self):
+        net = Network()
+        a = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        ta.publish(b"x", ready=lambda: False)
+        with pytest.raises(RuntimeError):
+            ta.close()
+        assert ta.cancel_pending_publishes() == 1
+        net.scheduler.run_for(1.0)          # poll chain notices empty queue
+        ta.close()
+
     def test_close_refuses_with_pending_publish(self):
         import pytest
         net = Network()
