@@ -79,26 +79,41 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     mesh1 = mesh & ~prune_neg
     candidate = candidate & ~prune_neg
 
+    # The regime blocks below are lax.cond-gated on "any row needs this":
+    # after mesh convergence most ticks have no under/over-subscribed rows
+    # and the opportunistic pass fires 1/60 ticks, so gating skips their
+    # selection kernels at runtime. Results are bit-identical to the
+    # ungated form — a skipped block equals selecting with count 0, and the
+    # RNG keys are pre-split so skipping consumes no randomness.
+
     # 2. undersubscribed: graft random candidates up to D (gossipsub.go:1413-1427)
     n_mesh = jnp.sum(mesh1, axis=-1)
     need = jnp.where(n_mesh < cfg.dlo, cfg.d - n_mesh, 0)
-    graft1 = select_random(candidate, need, ks[0])
+    graft1 = jax.lax.cond(
+        jnp.any(need > 0),
+        lambda: select_random(candidate, need, ks[0]),
+        lambda: jnp.zeros_like(candidate))
     mesh2 = mesh1 | graft1
 
     # 3. oversubscribed: keep top-Dscore by score + random rest to D, then
     # bubble up to Dout outbound among the kept (gossipsub.go:1430-1490)
     n2 = jnp.sum(mesh2, axis=-1)
     over = (n2 > cfg.dhi)[..., None]
-    protected = select_top(sb, mesh2, jnp.full((n, t), cfg.dscore))
-    rest = mesh2 & ~protected
-    keep_rand = select_random(rest, jnp.full((n, t), cfg.d - cfg.dscore), ks[1])
-    kept = protected | keep_rand
-    n_out_kept = jnp.sum(kept & out3, axis=-1)
-    deficit_out = jnp.clip(cfg.dout - n_out_kept, 0)
-    add_out = select_random(mesh2 & ~kept & out3, deficit_out, ks[2])
-    remove_nonout = select_random(keep_rand & ~out3,
-                                  jnp.sum(add_out, axis=-1), ks[3])
-    kept = (kept | add_out) & ~remove_nonout
+
+    def _over_block():
+        protected = select_top(sb, mesh2, jnp.full((n, t), cfg.dscore))
+        rest = mesh2 & ~protected
+        keep_rand = select_random(rest, jnp.full((n, t), cfg.d - cfg.dscore),
+                                  ks[1])
+        kept = protected | keep_rand
+        n_out_kept = jnp.sum(kept & out3, axis=-1)
+        deficit_out = jnp.clip(cfg.dout - n_out_kept, 0)
+        add_out = select_random(mesh2 & ~kept & out3, deficit_out, ks[2])
+        remove_nonout = select_random(keep_rand & ~out3,
+                                      jnp.sum(add_out, axis=-1), ks[3])
+        return (kept | add_out) & ~remove_nonout
+
+    kept = jax.lax.cond(jnp.any(over), _over_block, lambda: mesh2)
     mesh3 = jnp.where(over, kept, mesh2)
     prune_over = mesh2 & ~mesh3
 
@@ -107,18 +122,25 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     n_out = jnp.sum(mesh3 & out3, axis=-1)
     need_out = jnp.where((n3 >= cfg.dlo) & ~over[..., 0] & (n_out < cfg.dout),
                          cfg.dout - n_out, 0)
-    graft_out = select_random(candidate & out3 & ~mesh3, need_out, ks[4])
+    graft_out = jax.lax.cond(
+        jnp.any(need_out > 0),
+        lambda: select_random(candidate & out3 & ~mesh3, need_out, ks[4]),
+        lambda: jnp.zeros_like(mesh3))
     mesh4 = mesh3 | graft_out
 
     # 5. opportunistic grafting every OpportunisticGraftTicks when the median
     # mesh score sags below the threshold (gossipsub.go:1521-1552)
     og_tick = (tick % cfg.opportunistic_graft_ticks) == 0
-    med = masked_median(sb, mesh4)                    # [N, T]
-    og_cond = og_tick & (jnp.sum(mesh4, -1) > 1) & \
-        (med < cfg.opportunistic_graft_threshold)
-    og_need = jnp.where(og_cond, cfg.opportunistic_graft_peers, 0)
-    og_sel = select_random(candidate & (sb > med[..., None]) & ~mesh4,
-                           og_need, ks[5])
+
+    def _og_block():
+        med = masked_median(sb, mesh4)                # [N, T]
+        og_cond = (jnp.sum(mesh4, -1) > 1) & \
+            (med < cfg.opportunistic_graft_threshold)
+        og_need = jnp.where(og_cond, cfg.opportunistic_graft_peers, 0)
+        return select_random(candidate & (sb > med[..., None]) & ~mesh4,
+                             og_need, ks[5])
+
+    og_sel = jax.lax.cond(og_tick, _og_block, lambda: jnp.zeros_like(mesh4))
     mesh5 = mesh4 | og_sel
 
     grafts = graft1 | graft_out | og_sel
@@ -165,13 +187,20 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     fanout_alive = (state.fanout_lastpub < NEVER) & \
         (tick <= state.fanout_lastpub + cfg.fanout_ttl_ticks) & ~state.subscribed
     fa3 = fanout_alive[..., None]
-    keep_f = state.fanout & conn & nbr_sub & (s >= cfg.publish_threshold) & fa3
-    need_f = jnp.where(fanout_alive,
-                       jnp.maximum(cfg.d - jnp.sum(keep_f, -1), 0), 0)
-    add_f = select_random(
-        conn & nbr_sub & ~keep_f & ~direct3 & (s >= cfg.publish_threshold) & fa3,
-        need_f, ks[7])
-    new_fanout = keep_f | add_f
+
+    def _fanout_block():
+        keep_f = state.fanout & conn & nbr_sub & \
+            (s >= cfg.publish_threshold) & fa3
+        need_f = jnp.where(fanout_alive,
+                           jnp.maximum(cfg.d - jnp.sum(keep_f, -1), 0), 0)
+        add_f = select_random(
+            conn & nbr_sub & ~keep_f & ~direct3
+            & (s >= cfg.publish_threshold) & fa3,
+            need_f, ks[7])
+        return keep_f | add_f
+
+    new_fanout = jax.lax.cond(jnp.any(fanout_alive), _fanout_block,
+                              lambda: jnp.zeros_like(state.fanout))
     fanout_lastpub = jnp.where(fanout_alive, state.fanout_lastpub, NEVER)
 
     st = state._replace(mesh=new_mesh, backoff=new_backoff,

@@ -52,6 +52,7 @@ from ..sim.state import NEVER, SimState
 from .bits import (
     U32,
     exclusive_prefix_or,
+    gather_words_rows,
     n_words,
     pack_bool,
     pack_words,
@@ -142,16 +143,6 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.n
     raise ValueError(f"unknown router {cfg.router!r}")
 
 
-def _gather_words(x_w: jnp.ndarray, nbr_t: jnp.ndarray) -> jnp.ndarray:
-    """out[w, k, n] = x_w[w, nbr_t[k, n]] — per-word 1D neighbor gather.
-
-    The per-word form keeps both the table ([N] u32) and the result
-    peer-minor; a [N, K, W] row gather would materialize a 64x lane-padded
-    intermediate on TPU.
-    """
-    return jnp.stack([x_w[i][nbr_t] for i in range(x_w.shape[0])])
-
-
 def _edge_topic_bits(mask_ntk: jnp.ndarray, topic_bits: jnp.ndarray,
                      w: int) -> jnp.ndarray:
     """Expand a per-(peer, topic, slot) edge mask into packed per-edge message
@@ -230,7 +221,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     m = cfg.msg_window
     w = n_words(m)
     k_fwd, k_gate = jax.random.split(key)
-    nbr_t = jnp.clip(state.neighbors, 0, n - 1).T              # [K, N]
+    nbr = jnp.clip(state.neighbors, 0, n - 1)                  # [N, K]
     mal = state.malicious
 
     # --- per-tick packed masks ---
@@ -285,7 +276,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # answer from their mcache, which rejected/ignored messages never enter
     # (deliver_tick stays NEVER on rejection — validation.go:293-370)
     answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)             # [W,N]
-    answers_k = _gather_words(answer_bits, nbr_t)                       # [W,K,N]
+    answers_k = gather_words_rows(answer_bits, nbr, m)                       # [W,K,N]
     # pulled data is still data: graylist + gater admission apply, and pulls
     # are charged against the same per-edge and validation budgets as eager
     # traffic (an IHAVE-flooding adversary must not route unlimited data
@@ -340,10 +331,9 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         # path too). Only hop 0 carries origin messages. Sender-side values
         # (its score of me, its direct flag for me) arrive through the edge
         # permutation.
-        jn = jnp.clip(state.neighbors, 0, n - 1)
         rk = jnp.clip(state.reverse_slot, 0, k - 1)
-        sender_scores_me = scores[jn, rk]                               # [N,K]
-        sender_direct_me = state.direct[jn, rk]                         # [N,K]
+        sender_scores_me = scores[nbr, rk]                              # [N,K]
+        sender_direct_me = state.direct[nbr, rk]                        # [N,K]
         if cfg.scoring_enabled:
             score_gate = sender_direct_me | \
                 (sender_scores_me >= cfg.publish_threshold)
@@ -357,7 +347,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         origin_bits = pack_words(
             (state.deliver_tick == state.tick)
             & (state.msg_publish_tick == state.tick)[None, :])
-        flood_offer = _gather_words(origin_bits, nbr_t) & flood_allowed
+        flood_offer = gather_words_rows(origin_bits, nbr, m) & flood_allowed
     else:
         flood_offer = None
 
@@ -384,7 +374,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     def hop(carry, is_first):
         (frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
          dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
-        offered = _gather_words(frontier, nbr_t) & allowed              # [W,K,N]
+        offered = gather_words_rows(frontier, nbr, m) & allowed              # [W,K,N]
         if flood_offer is not None:
             offered = offered | jnp.where(is_first, flood_offer, U32(0))
         if cfg.edge_queue_cap > 0:
@@ -510,7 +500,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # malicious peers advertise everything alive (IHAVE flood)
     window_bits = jnp.where(mal[None, :], alive_bits[:, None], window_bits)
     gossip_allowed = _edge_topic_bits(inc_gossip, topic_bits, w)        # [W,K,N]
-    offer = _gather_words(window_bits, nbr_t) & gossip_allowed
+    offer = gather_words_rows(window_bits, nbr, m) & gossip_allowed
     if cfg.max_iwant_per_tick >= m:
         # a sender can offer at most M ids per tick, so the iasked budget
         # cannot bind: pick the lowest offering slot per message

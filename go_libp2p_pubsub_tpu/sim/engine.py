@@ -27,7 +27,7 @@ import jax.numpy as jnp
 
 from ..ops.churn import churn_edges, churn_subscriptions
 from ..ops.gater import gater_decay
-from ..ops.heartbeat import heartbeat
+from ..ops.heartbeat import HeartbeatOut, heartbeat
 from ..ops.propagate import forward_tick, publish
 from ..ops.score_ops import decay_counters
 from .config import SimConfig, TopicParams
@@ -58,7 +58,16 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
     state = decay_counters(state, cfg, tp)
     if cfg.gater_enabled:
         state = gater_decay(state, cfg)
-    hb = heartbeat(state, cfg, tp, k_hb)
+    if cfg.router == "gossipsub":
+        hb = heartbeat(state, cfg, tp, k_hb)
+    else:
+        # floodsub/randomsub run NO heartbeat: no mesh maintenance, no
+        # gossip, no scoring (floodsub.go/randomsub.go define none of it)
+        n, t, k = state.mesh.shape
+        hb = HeartbeatOut(state=state,
+                          scores=jnp.zeros((n, k), jnp.float32),
+                          scores_all=jnp.zeros((n, k), jnp.float32),
+                          gossip_sel=jnp.zeros((n, t, k), bool))
     state = forward_tick(hb.state, cfg, tp, hb.gossip_sel, hb.scores, k_fwd)
     if cfg.churn_disconnect_prob > 0.0:
         # connection churn closes the tick, reusing the heartbeat's score

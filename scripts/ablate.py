@@ -1,0 +1,148 @@
+"""Scanned per-phase timing: each phase runs inside a 10-iteration lax.scan
+in ONE jit call, so the remote-TPU per-dispatch latency (~14ms on axon)
+amortizes away and the number is the phase's real on-device cost per tick.
+
+Usage: python scripts/ablate.py [scenario] [iters]
+  scenario in {1k, 10k_beacon, 50k_churn, 100k_sybil, 100k_sweep, headline_N}
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from go_libp2p_pubsub_tpu.ops.churn import churn_edges, churn_subscriptions
+from go_libp2p_pubsub_tpu.ops.gater import gater_decay
+from go_libp2p_pubsub_tpu.ops.heartbeat import heartbeat, edge_gather
+from go_libp2p_pubsub_tpu.ops.propagate import (
+    _edge_forward_mask, _edge_topic_bits, forward_tick, publish)
+from go_libp2p_pubsub_tpu.ops.bits import gather_words_rows, pack_words, n_words
+from go_libp2p_pubsub_tpu.ops.score_ops import compute_scores, decay_counters
+from go_libp2p_pubsub_tpu.sim import scenarios
+from go_libp2p_pubsub_tpu.sim.engine import step
+
+
+def build(name):
+    if name == "1k":
+        return scenarios.single_topic_1k()
+    if name == "10k_beacon":
+        return scenarios.beacon_10k()
+    if name == "50k_churn":
+        return scenarios.churn_50k()
+    if name == "100k_sybil":
+        return scenarios.sybil_100k()
+    if name == "100k_sweep":
+        return scenarios.router_sweep_100k("gossipsub")
+    if name.startswith("headline"):
+        from __graft_entry__ import _build
+        n = int(name.split("_")[1]) if "_" in name else 100_000
+        return _build(n_peers=n, k_slots=32, degree=12, msg_window=64,
+                      publishers=8)
+    raise SystemExit(f"unknown scenario {name}")
+
+
+def scan_time(fn, state, iters, *, label):
+    """fn: (state, key) -> state; time per iteration inside one scan."""
+
+    @jax.jit
+    def many(st, key):
+        def body(c, k):
+            return fn(c, k), None
+        out, _ = jax.lax.scan(body, st, jax.random.split(key, iters))
+        return out
+
+    key = jax.random.PRNGKey(0)
+    out = many(state, key)            # compile + warm
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    out = many(state, jax.random.PRNGKey(1))
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{label:28s} {dt*1e3:9.3f} ms/tick", flush=True)
+    return dt
+
+
+def main():
+    name = sys.argv[1] if len(sys.argv) > 1 else "10k_beacon"
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 10
+    cfg, tp, st = build(name)
+    n, t, k = st.mesh.shape
+    m = cfg.msg_window
+    w = n_words(m)
+    print(f"== {name}: N={n} T={t} K={k} M={m} W={w} hops={cfg.prop_substeps} "
+          f"router={cfg.router} on {jax.devices()[0].platform} ==", flush=True)
+
+    # converge one step so the state is typical
+    st = jax.jit(step, static_argnames=("cfg",))(st, cfg, tp,
+                                                 jax.random.PRNGKey(42))
+    jax.block_until_ready(st)
+
+    scan_time(lambda s, k_: step(s, cfg, tp, k_), st, iters,
+              label="FULL step")
+
+    # -- phases (each returns a state so the scan carry stays uniform) --
+    def ph_publish(s, k_):
+        peers = jax.random.randint(k_, (cfg.publishers_per_tick,), 0, n)
+        topics = jnp.zeros((cfg.publishers_per_tick,), jnp.int32)
+        return publish(s, cfg, peers, topics, k_)
+
+    scan_time(ph_publish, st, iters, label="publish")
+    scan_time(lambda s, k_: decay_counters(s, cfg, tp), st, iters,
+              label="decay_counters")
+
+    def ph_scores(s, k_):
+        sc = compute_scores(s, cfg, tp)
+        return s._replace(behaviour_penalty=s.behaviour_penalty
+                          + 0.0 * sc.sum())
+    scan_time(ph_scores, st, iters, label="compute_scores")
+
+    def ph_hb(s, k_):
+        return heartbeat(s, cfg, tp, k_).state
+    scan_time(ph_hb, st, iters, label="heartbeat")
+
+    hb = jax.jit(heartbeat, static_argnames=("cfg",))(
+        st, cfg, tp, jax.random.PRNGKey(7))
+    jax.block_until_ready(hb)
+
+    def ph_fwd(s, k_):
+        return forward_tick(s, cfg, tp, hb.gossip_sel, hb.scores, k_)
+    scan_time(ph_fwd, st, iters, label="forward_tick")
+
+    if cfg.churn_disconnect_prob > 0:
+        def ph_churn(s, k_):
+            return churn_edges(s, cfg, tp, k_, scores_all=hb.scores_all)
+        scan_time(ph_churn, st, iters, label="churn_edges")
+    if cfg.gater_enabled:
+        scan_time(lambda s, k_: gater_decay(s, cfg), st, iters,
+                  label="gater_decay")
+
+    # -- forward_tick internals --
+    nbr = jnp.clip(st.neighbors, 0, n - 1)
+
+    def ph_gather(s, k_):
+        hv = pack_words(s.have)
+        g = gather_words_rows(hv, nbr, m)     # [W,K,N] the per-hop gather
+        return s._replace(behaviour_penalty=s.behaviour_penalty
+                          + 0.0 * g.sum().astype(jnp.float32))
+    scan_time(ph_gather, st, iters, label="1x neighbor word-gather")
+
+    def ph_edge_gather(s, k_):
+        eg = edge_gather(s.mesh, s)
+        return s._replace(behaviour_penalty=s.behaviour_penalty
+                          + 0.0 * eg.sum().astype(jnp.float32))
+    scan_time(ph_edge_gather, st, iters, label="1x edge_gather [N,T,K]")
+
+    def ph_fwd_mask(s, k_):
+        fm = _edge_forward_mask(s, cfg, k_)
+        return s._replace(behaviour_penalty=s.behaviour_penalty
+                          + 0.0 * fm.sum().astype(jnp.float32))
+    scan_time(ph_fwd_mask, st, iters, label="edge_forward_mask")
+
+
+if __name__ == "__main__":
+    main()

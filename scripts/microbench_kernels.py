@@ -1,0 +1,149 @@
+"""Microbenchmarks of candidate kernel formulations on the live accelerator.
+
+Each candidate runs inside a 10-iteration lax.scan in one jit call so the
+remote-dispatch latency amortizes. Shapes default to the 10k-beacon scenario
+(N=10000, T=9, K=48, M=64); pass N T K M to override.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from go_libp2p_pubsub_tpu.ops.selection import ranks_desc
+
+ITERS = 10
+
+
+def scan_time(fn, args, label):
+    @jax.jit
+    def many(a):
+        def body(c, _):
+            out = fn(*c[1:]) if isinstance(c, tuple) else fn(c)
+            # fold output back into carry position 0 to serialize iterations
+            return (out, *c[1:]) if isinstance(c, tuple) else out, None
+        (out, *_), _ = jax.lax.scan(body, a, None, length=ITERS)
+        return out
+
+    # carry: (accumulator, *inputs); accumulator must match fn output shape
+    out0 = fn(*args[1:])
+    carry = (out0, *args[1:])
+    r = many(carry)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    r = many(carry)
+    jax.block_until_ready(r)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"{label:44s} {dt*1e3:9.3f} ms", flush=True)
+    return dt
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000
+    t = int(sys.argv[2]) if len(sys.argv) > 2 else 9
+    k = int(sys.argv[3]) if len(sys.argv) > 3 else 48
+    m = int(sys.argv[4]) if len(sys.argv) > 4 else 64
+    w = (m + 31) // 32
+    print(f"== N={n} T={t} K={k} M={m} W={w} on "
+          f"{jax.devices()[0].platform} ==", flush=True)
+    key = jax.random.PRNGKey(0)
+    kk = jax.random.split(key, 10)
+
+    mask = jax.random.uniform(kk[0], (n, t, k)) < 0.5
+    score = jax.random.normal(kk[1], (n, t, k))
+    count = jax.random.randint(kk[2], (n, t), 0, k)
+    nbr = jax.random.randint(kk[3], (n, k), 0, n, dtype=jnp.int32)
+    rk = jax.random.randint(kk[4], (n, k), 0, k, dtype=jnp.int32)
+    words = jax.random.randint(kk[5], (w, n), 0, 2**31 - 1,
+                               dtype=jnp.int32).astype(jnp.uint32)
+    planes = (jax.random.uniform(kk[6], (n, m)) < 0.3)   # unpacked messages
+
+    # ---------- selection: ranks vs sort-threshold ----------
+    def sel_ranks(score, mask, count):
+        keys = jnp.where(mask, score, -1e30)
+        r = ranks_desc(keys)
+        return (r < count[..., None]) & mask
+
+    def sel_sort(score, mask, count):
+        tb = -jnp.arange(k, dtype=jnp.float32) * 1e-9
+        keys = jnp.where(mask, score + tb, -1e30)
+        srt = jnp.sort(keys, axis=-1)[..., ::-1]          # descending
+        idx = jnp.clip(count - 1, 0, k - 1)
+        thr = jnp.take_along_axis(srt, idx[..., None], axis=-1)
+        return mask & (keys >= thr) & (count[..., None] > 0)
+
+    a = sel_ranks(score, mask, count)
+    b = sel_sort(score, mask, count)
+    assert bool(jnp.all(a == b)), "sort-threshold != ranks selection"
+    scan_time(sel_ranks, (a, score, mask, count), "select: O(K^2) ranks")
+    scan_time(sel_sort, (a, score, mask, count), "select: sort+threshold")
+
+    # ---------- edge gather [N,T,K] ----------
+    def eg_adv(x):
+        j = nbr[:, None, :]
+        r = rk[:, None, :]
+        tt = jnp.arange(t)[None, :, None]
+        return x[j, tt, r]
+
+    def eg_packed(x):
+        # pack T bools into one u32 per (n,k); gather [N,K] scalars; unpack
+        tb = (jnp.uint32(1) << jnp.arange(t, dtype=jnp.uint32))
+        packed = jnp.sum(jnp.where(x, tb[None, :, None], jnp.uint32(0)),
+                         axis=1, dtype=jnp.uint32)          # [N, K]
+        g = packed[nbr, rk]                                 # [N, K] scalars
+        return (g[:, None, :] >> jnp.arange(t, dtype=jnp.uint32)[None, :, None]
+                & 1).astype(bool)
+
+    x3 = mask
+    a = eg_adv(x3)
+    b = eg_packed(x3)
+    assert bool(jnp.all(a == b))
+    scan_time(eg_adv, (a, x3), "edge_gather: advanced-index [N,T,K]")
+    scan_time(eg_packed, (a, x3), "edge_gather: T-packed u32 [N,K]")
+
+    # ---------- neighbor message gather ----------
+    nbr_t = nbr.T                                           # [K, N]
+
+    def gw_words(wds):
+        return jnp.stack([wds[i][nbr_t] for i in range(w)])  # [W,K,N]
+
+    def gw_rows_i8(pl):
+        g = pl.astype(jnp.int8)[nbr]                        # [N,K,M] row gather
+        return g
+
+    def gw_rows_u32(wds):
+        rows = wds.T[nbr]                                   # [N,K,W]
+        return rows
+
+    scan_time(gw_words, (gw_words(words), words),
+              "msg gather: per-word scalar [W,K,N]")
+    scan_time(gw_rows_i8, (gw_rows_i8(planes), planes),
+              "msg gather: row-major i8 [N,K,M]")
+    scan_time(gw_rows_u32, (gw_rows_u32(words), words),
+              "msg gather: row-major u32 [N,K,W]")
+
+    # ---------- OR-reduce over K after row gather ----------
+    rows_i8 = gw_rows_i8(planes)
+
+    def or_reduce(r):
+        return jnp.max(r, axis=1)                           # [N, M]
+
+    scan_time(or_reduce, (or_reduce(rows_i8), rows_i8),
+              "OR-reduce over K (i8 rows)")
+
+    # ---------- one-hot matmul gather (MXU) ----------
+    def gw_onehot(pl):
+        oh = jax.nn.one_hot(nbr, n, dtype=jnp.bfloat16)     # [N,K,N] -- huge
+        return jnp.einsum('nkj,jm->nkm', oh, pl.astype(jnp.bfloat16))
+
+    if n <= 4096:
+        scan_time(gw_onehot, (gw_onehot(planes), planes),
+                  "msg gather: one-hot MXU [N,K,N]@[N,M]")
+
+
+if __name__ == "__main__":
+    main()
