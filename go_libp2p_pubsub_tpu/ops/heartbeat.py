@@ -20,6 +20,7 @@ import jax.numpy as jnp
 
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
+from .bits import U32
 from .score_ops import apply_prune_penalty, compute_scores
 from .selection import masked_median, select_random, select_top
 
@@ -39,6 +40,32 @@ def edge_gather(x: jnp.ndarray, state: SimState, fill=False) -> jnp.ndarray:
     return jnp.where(valid, y, fill)
 
 
+def edge_gather_packed(masks: list, state: SimState) -> list:
+    """Gather several [N, T, K] boolean edge masks through the reverse-edge
+    permutation in ceil(B/32) uint32 scalar gathers (B = total bit-planes),
+    instead of one [N,T,K] advanced-index gather per mask. The permutation
+    gather is the expensive op on TPU (serialized scalar loads); packing
+    divides its index count by T-per-mask and amortizes it across masks,
+    while the pack/unpack shifts are cheap VPU passes."""
+    n, t, k = masks[0].shape
+    planes = jnp.concatenate(masks, axis=1)                    # [N, B, K]
+    b = planes.shape[1]
+    jn = jnp.clip(state.neighbors, 0, n - 1)
+    rk = jnp.clip(state.reverse_slot, 0, k - 1)
+    valid = ((state.neighbors >= 0) & (state.reverse_slot >= 0))[:, None, :]
+    parts = []
+    for w0 in range(0, b, 32):
+        bits = planes[:, w0:w0 + 32, :]
+        nb = bits.shape[1]
+        sh = (U32(1) << jnp.arange(nb, dtype=U32))[None, :, None]
+        payload = jnp.sum(bits.astype(U32) * sh, axis=1, dtype=U32)  # [N, K]
+        g = payload[jn, rk]                                          # [N, K]
+        parts.append(((g[:, None, :] >> jnp.arange(nb, dtype=U32)[None, :, None])
+                      & U32(1)).astype(bool))
+    flat = jnp.concatenate(parts, axis=1) & valid
+    return [flat[:, i * t:(i + 1) * t, :] for i in range(len(masks))]
+
+
 class HeartbeatOut(NamedTuple):
     state: SimState
     scores: jnp.ndarray      # [N, K] pre-maintenance scores (score cache,
@@ -46,7 +73,12 @@ class HeartbeatOut(NamedTuple):
     scores_all: jnp.ndarray  # [N, K] same cache WITHOUT the connected mask —
                              # retained scores of down edges (RetainScore),
                              # consumed by the PX reconnect gate (ops/churn.py)
-    gossip_sel: jnp.ndarray  # [N, T, K] emitGossip target edges
+    inc_gossip: jnp.ndarray  # [N, T, K] receiver view of emitGossip edges:
+                             # slot s's peer gossips topic t to me (already
+                             # gathered through the edge permutation)
+    fwd_send: jnp.ndarray    # [N, T, K] receiver view of the eager-forward
+                             # edges (sender's mesh | non-subscribed fanout),
+                             # consumed by forward_tick's gossipsub path
 
 
 def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
@@ -147,8 +179,7 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     prunes = prune_neg | prune_over
 
     # --- cross-peer exchange, all against pre-round state ---
-    inc_graft = edge_gather(grafts, state)
-    inc_prune = edge_gather(prunes, state)
+    inc_graft, inc_prune = edge_gather_packed([grafts, prunes], state)
 
     # receiver-side GRAFT vetting (gossipsub.go:741-837): refuse when not
     # joined, in backoff, sender score negative, mesh full (unless outbound),
@@ -168,7 +199,7 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
         + jnp.sum(inc_graft & flood, axis=1).astype(jnp.float32)
     behaviour_penalty = state.behaviour_penalty + bp_add
 
-    refused_back = edge_gather(refuse, state)
+    refused_back, = edge_gather_packed([refuse], state)
 
     new_mesh = ((mesh5 | accept) & ~inc_prune & ~refused_back) & joined
     pruned_any = prunes | inc_prune | refused_back
@@ -222,5 +253,11 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
                          jnp.floor(cfg.gossip_factor * n_cand).astype(jnp.int32))
     gossip_sel = select_random(gossip_cand, target, ks[6])
 
+    # one shared permutation gather hands forward_tick its receiver views:
+    # who gossips to me, and whose eager forwarding reaches me
+    # (gossipsub.go:1020-1035 mesh forward, :1007 fanout publish)
+    send = new_mesh | (new_fanout & ~state.subscribed[:, :, None])
+    inc_gossip, fwd_send = edge_gather_packed([gossip_sel, send], st)
+
     return HeartbeatOut(state=st, scores=scores, scores_all=scores_all,
-                        gossip_sel=gossip_sel)
+                        inc_gossip=inc_gossip, fwd_send=fwd_send)

@@ -115,7 +115,8 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
                           fanout_lastpub=fanout_lastpub)
 
 
-def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
+def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array,
+                       fwd_send: jnp.ndarray | None = None) -> jnp.ndarray:
     """[N, T, K] receiver-view forwarding mask: slot s's peer would forward a
     topic-t message to me. Router-variant dispatch (static)."""
     n, t, k = state.mesh.shape
@@ -123,7 +124,11 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.n
     my_sub = state.subscribed[:, :, None]
     if cfg.router == "gossipsub":
         # sender forwards along ITS mesh edges (gossipsub.go:1020-1035); a
-        # non-subscribed publisher sends along its fanout (gossipsub.go:1007)
+        # non-subscribed publisher sends along its fanout (gossipsub.go:1007);
+        # the engine passes the receiver view pre-gathered by the heartbeat's
+        # shared permutation gather, direct callers pay for their own
+        if fwd_send is not None:
+            return fwd_send
         send = state.mesh | (state.fanout & ~state.subscribed[:, :, None])
         return edge_gather(send, state)
     if cfg.router == "floodsub":
@@ -193,13 +198,17 @@ def _bits_to_slot(chosen: jnp.ndarray, m: int) -> jnp.ndarray:
 
 
 def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
-                 gossip_sel: jnp.ndarray, scores: jnp.ndarray,
-                 key: jax.Array) -> SimState:
+                 inc_gossip: jnp.ndarray, scores: jnp.ndarray,
+                 key: jax.Array, *,
+                 fwd_send: jnp.ndarray | None = None) -> SimState:
     """One tick of data-plane traffic: resolve last tick's IWANTs, run
     ``prop_substeps`` forwarding hops, then emit this tick's IHAVE/IWANT.
 
     ``scores`` is the heartbeat's [N, K] score cache (receiver's score of the
-    peer in slot k), used for accept/gossip gating. Admission control layers,
+    peer in slot k), used for accept/gossip gating. ``inc_gossip`` and
+    ``fwd_send`` are receiver views pre-gathered by the heartbeat's shared
+    edge-permutation gather (HeartbeatOut); ``fwd_send=None`` makes the
+    gossipsub path gather its own. Admission control layers,
     outermost first (matching handleIncomingRPC, pubsub.go:1029-1105):
 
     1. graylist: score < graylist_threshold drops everything (AcceptFrom,
@@ -318,7 +327,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     validated = arrivals.astype(jnp.float32)
 
     # -- step 2: eager forwarding, prop_substeps hops, fully bit-packed --
-    fwd_mask = _edge_forward_mask(state, cfg, k_fwd)
+    fwd_mask = _edge_forward_mask(state, cfg, k_fwd, fwd_send)
     fwd_mask = fwd_mask & data_ok[:, None, :]
     allowed = _edge_topic_bits(fwd_mask, topic_bits, w)                 # [W,K,N]
     mesh_eb = _edge_topic_bits(state.mesh, topic_bits, w)               # [W,K,N]
@@ -488,9 +497,13 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                                           state.gater_last_throttle))
 
     # -- step 3: IHAVE/IWANT for next tick (gossipsub.go:1711-1775) --
-    # receiver view of gossip edges: slot s's peer gossips topic t to me;
-    # ignore IHAVE from senders I score below the gossip threshold
-    inc_gossip = edge_gather(gossip_sel, state) & gossip_ok[:, None, :]
+    # receiver view of gossip edges (pre-gathered by the heartbeat): slot
+    # s's peer gossips topic t to me; ignore IHAVE from senders I score
+    # below the gossip threshold; invalid slots masked for direct callers
+    # that pass raw sender-view masks
+    valid_slots = ((state.neighbors >= 0)
+                   & (state.reverse_slot >= 0))[:, None, :]
+    inc_gossip = inc_gossip & valid_slots & gossip_ok[:, None, :]
     # sender gossip window = the mcache gossip slice: DELIVERED within the
     # last history_gossip ticks (rejected messages never enter the mcache, so
     # have-but-not-delivered is excluded)

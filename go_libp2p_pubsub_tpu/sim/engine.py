@@ -67,8 +67,11 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
         hb = HeartbeatOut(state=state,
                           scores=jnp.zeros((n, k), jnp.float32),
                           scores_all=jnp.zeros((n, k), jnp.float32),
-                          gossip_sel=jnp.zeros((n, t, k), bool))
-    state = forward_tick(hb.state, cfg, tp, hb.gossip_sel, hb.scores, k_fwd)
+                          inc_gossip=jnp.zeros((n, t, k), bool),
+                          fwd_send=jnp.zeros((n, t, k), bool))
+    state = forward_tick(hb.state, cfg, tp, hb.inc_gossip, hb.scores, k_fwd,
+                         fwd_send=hb.fwd_send if cfg.router == "gossipsub"
+                         else None)
     if cfg.churn_disconnect_prob > 0.0:
         # connection churn closes the tick, reusing the heartbeat's score
         # cache (its unmasked variant) for the PX reconnect gate — one

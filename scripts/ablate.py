@@ -110,7 +110,8 @@ def main():
     jax.block_until_ready(hb)
 
     def ph_fwd(s, k_):
-        return forward_tick(s, cfg, tp, hb.gossip_sel, hb.scores, k_)
+        return forward_tick(s, cfg, tp, hb.inc_gossip, hb.scores, k_,
+                            fwd_send=hb.fwd_send)
     scan_time(ph_fwd, st, iters, label="forward_tick")
 
     if cfg.churn_disconnect_prob > 0:

@@ -62,7 +62,7 @@ def main():
     hb = hb_jit(st, cfg, tp, k_hb)
     jax.block_until_ready(hb)
     t = timeit(jax.jit(forward_tick, static_argnames=("cfg",)),
-               hb.state, cfg, tp, hb.gossip_sel, hb.scores, k_fwd)
+               hb.state, cfg, tp, hb.inc_gossip, hb.scores, k_fwd)
     print(f"  forward_tick:   {t*1e3:9.2f} ms")
 
     # ---- gather microbenchmarks ----

@@ -207,7 +207,8 @@ class TestFanout:
 
     def _tick(self, st, cfg, tp, key):
         hb = heartbeat(st, cfg, tp, key)
-        st = forward_tick(hb.state, cfg, tp, hb.gossip_sel, hb.scores, key)
+        st = forward_tick(hb.state, cfg, tp, hb.inc_gossip, hb.scores, key,
+                          fwd_send=hb.fwd_send)
         return st._replace(tick=st.tick + 1)
 
     def test_nonsubscribed_publish_reaches_topic(self):
