@@ -380,9 +380,10 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     dup_acc = jnp.zeros((w, k, n), U32)    # mesh-duplicate events, per slot
     gdup_acc = jnp.zeros((w, k, n), U32)   # any-duplicate events (gater)
 
-    def hop(carry, is_first):
-        (frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
+    def hop(carry):
+        (i, frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
          dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
+        is_first = i == 0
         offered = gather_words_rows(frontier, nbr, m) & allowed              # [W,K,N]
         if flood_offer is not None:
             offered = offered | jnp.where(is_first, flood_offer, U32(0))
@@ -434,18 +435,22 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         have_bits = have_bits | new_any
         dlv_bits = dlv_bits | new_valid
         dlv_new = dlv_new | new_valid
-        return (new_valid, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc,
-                ig_acc, dup_acc, gdup_acc, edge_used, arrivals, throttled,
-                validated), None
+        return (i + 1, new_valid, have_bits, dlv_bits, dlv_new, nv_acc,
+                ni_acc, ig_acc, dup_acc, gdup_acc, edge_used, arrivals,
+                throttled, validated)
 
-    # the hop loop is a lax.scan (not unrolled): one hop's code compiles
-    # once, temporaries are reused across hops, and the executable stays
-    # small at 100k peers (the unrolled form compiled to >100MB of code)
-    carry = (frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
-             dup_acc, gdup_acc, edge_used, arrivals, throttled, validated)
-    carry, _ = jax.lax.scan(hop, carry,
-                            jnp.arange(cfg.prop_substeps) == 0)
-    (_, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
+    # the hop loop is a lax.while_loop (not unrolled): one hop's code
+    # compiles once, temporaries are reused across hops, the executable
+    # stays small at 100k peers (the unrolled form compiled to >100MB of
+    # code) — and the loop exits as soon as the frontier empties (message
+    # transit takes ~graph-diameter hops, typically < prop_substeps), a
+    # hop with an empty frontier being a no-op
+    carry = (jnp.int32(0), frontier, have_bits, dlv_bits, dlv_new, nv_acc,
+             ni_acc, ig_acc, dup_acc, gdup_acc, edge_used, arrivals,
+             throttled, validated)
+    carry = jax.lax.while_loop(
+        lambda c: (c[0] < cfg.prop_substeps) & jnp.any(c[1] != 0), hop, carry)
+    (_, _, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
      dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
 
     for ti in range(t):

@@ -72,6 +72,23 @@ class TestMeshFormation:
         assert float(delivery_fraction(st, cfg)) == 1.0
 
 
+class TestEdgeGatherPacked:
+    def test_matches_per_mask_edge_gather(self, converged):
+        """The packed multi-mask permutation gather must be bit-identical to
+        gathering each [N,T,K] mask separately — including across the 32-bit
+        word boundary (checked with 13 x 3 = 39 bit-planes)."""
+        from go_libp2p_pubsub_tpu.ops.heartbeat import edge_gather_packed
+        cfg, st = converged
+        n, t, k = st.mesh.shape
+        keys = jax.random.split(jax.random.PRNGKey(3), 13)
+        masks = [jax.random.uniform(kk, (n, 3, k)) < 0.4 for kk in keys]
+        st3 = st._replace(mesh=jnp.zeros((n, 3, k), bool))  # 3-topic shapes
+        got = edge_gather_packed(masks, st3)
+        for g, mk in zip(got, masks):
+            want = np.asarray(edge_gather(mk, st3))
+            assert (np.asarray(g) == want).all()
+
+
 class TestRouterVariants:
     @pytest.mark.parametrize("router", ["floodsub", "randomsub"])
     def test_variant_delivers(self, router):
