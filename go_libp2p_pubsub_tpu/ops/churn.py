@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
+from .bits import U32, pack_bool
 from .score_ops import apply_prune_penalty, compute_scores
 
 
@@ -45,11 +46,22 @@ def _symmetric_value(state: SimState, x: jnp.ndarray) -> jnp.ndarray:
     return jnp.where(mine_wins, x, x_rev)
 
 
-def _symmetric_uniform(state: SimState, key: jax.Array) -> jnp.ndarray:
-    """[N, K] uniform draws equal on both directions of each edge: the draw of
-    the lower-id endpoint wins, gathered through reverse_slot."""
+def _symmetric_bools(state: SimState, bits: list) -> list:
+    """Symmetrize boolean per-edge decisions: both directions of an edge use
+    the lower-id endpoint's bit. All planes (up to 32) share ONE packed u32
+    permutation gather — each f32 `_symmetric_value` costs its own N*K
+    serialized scalar loads on TPU, so decisions that can be taken locally
+    first (draw < prob) and exchanged as bits should be."""
     n, k = state.neighbors.shape
-    return _symmetric_value(state, jax.random.uniform(key, (n, k)))
+    nbr = jnp.clip(state.neighbors, 0, n - 1)
+    rk = jnp.clip(state.reverse_slot, 0, k - 1)
+    payload = jnp.zeros((n, k), U32)
+    for i, b in enumerate(bits):
+        payload = payload | jnp.where(b, U32(1) << U32(i), U32(0))
+    g = payload[nbr, rk]
+    mine_wins = jnp.arange(n)[:, None] < nbr
+    return [jnp.where(mine_wins, b, ((g >> U32(i)) & U32(1)).astype(bool))
+            for i, b in enumerate(bits)]
 
 
 def churn_subscriptions(state: SimState, cfg: SimConfig, tp: TopicParams,
@@ -120,7 +132,8 @@ def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
     down = known & ~state.connected
     live = known & state.connected
 
-    go_down = live & (_symmetric_uniform(state, kd) < cfg.churn_disconnect_prob)
+    n_, k_ = state.neighbors.shape
+    d_down = jax.random.uniform(kd, (n_, k_)) < cfg.churn_disconnect_prob
     if cfg.px_enabled:
         # PX-seeded reconnects (gossipsub.go:893-973): the dialing side only
         # gets a PX referral for well-scored peers (handlePrune's
@@ -140,32 +153,44 @@ def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
         p_up = jnp.where(px_score >= cfg.accept_px_threshold,
                          cfg.churn_reconnect_prob,
                          cfg.churn_reconnect_prob * cfg.px_low_score_factor)
-        p_up = _symmetric_value(state, p_up)
     else:
         p_up = cfg.churn_reconnect_prob
-    come_up = down & (_symmetric_uniform(state, ku) < p_up)
+    # decisions are taken locally (draw < prob) and the lower-id endpoint's
+    # BITS are exchanged in one packed gather — identical trajectories to
+    # symmetrizing the f32 draws/probabilities first, at a third of the
+    # permutation-gather cost
+    d_up = jax.random.uniform(ku, (n_, k_)) < p_up
+    d_down, d_up, direct_low = _symmetric_bools(
+        state, [d_down, d_up, state.direct])
+    go_down = live & d_down
+    come_up = down & d_up
     # direct peers are force-redialed on a fixed cadence regardless of churn
     # (gossipsub.go:1648-1670 directConnect, every 300 ticks). The lower-id
     # endpoint's direct flag decides, keeping `connected` edge-symmetric
     # even if a scenario marks direct on one side only.
     redial = (state.tick % cfg.direct_connect_ticks) == 0
-    come_up = come_up | (down & _symmetric_value(state, state.direct) & redial)
+    come_up = come_up | (down & direct_low & redial)
 
     # --- RemovePeer on edges going down (gossipsub.go:575-596) ---
     down3 = go_down[:, None, :]
     removed_mesh = state.mesh & down3
     state = apply_prune_penalty(state, removed_mesh, tp)
+    # a dead peer's pending gossip pulls never resolve; drop them rather
+    # than charging a broken promise (the reference cancels promises on
+    # peer removal, gossip_tracer.go:154-162). The slot-id lookup is a
+    # per-lane word shift against go_down packed along K — not a [N, M]
+    # scalar gather.
+    gd_words = pack_bool(go_down)                   # [N, ceil(K/32)] u32
+    pend = state.iwant_pending
+    pc = jnp.clip(pend, 0, k - 1)
+    sel = jnp.broadcast_to(gd_words[:, 0][:, None], pend.shape)
+    for wi in range(1, gd_words.shape[1]):
+        sel = jnp.where(pc // 32 == wi, gd_words[:, wi][:, None], sel)
+    pend_down = (((sel >> (pc % 32).astype(U32)) & U32(1)) != 0) & (pend >= 0)
     state = state._replace(
         mesh=state.mesh & ~down3,
         fanout=state.fanout & ~down3,
-        # a dead peer's pending gossip pulls never resolve; drop them rather
-        # than charging a broken promise (the reference cancels promises on
-        # peer removal, gossip_tracer.go:154-162)
-        iwant_pending=jnp.where(
-            go_down[jnp.arange(n)[:, None],
-                    jnp.clip(state.iwant_pending, 0, k - 1)]
-            & (state.iwant_pending >= 0),
-            -1, state.iwant_pending),
+        iwant_pending=jnp.where(pend_down, -1, pend),
         disconnect_tick=jnp.where(go_down, state.tick, state.disconnect_tick))
 
     # --- reconnect: expire retention, then flip the edge up ---
