@@ -106,8 +106,10 @@ def churn_subscriptions(state: SimState, cfg: SimConfig, tp: TopicParams,
     promoted = promote | promote_in
     new_mesh = (state.mesh & ~mesh_removed) | promoted
     subscribed = (state.subscribed | join) & ~leave
+    from ..sim.state import refresh_nbr_subscribed
+    state = refresh_nbr_subscribed(state._replace(subscribed=subscribed))
     return state._replace(
-        mesh=new_mesh, backoff=backoff, subscribed=subscribed,
+        mesh=new_mesh, backoff=backoff,
         fanout=state.fanout & ~join[:, :, None],
         fanout_lastpub=jnp.where(join, NEVER, state.fanout_lastpub),
         graft_tick=jnp.where(promoted & ~state.mesh, state.tick,

@@ -95,9 +95,7 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     conn = state.connected[:, None, :]
     out3 = state.outbound[:, None, :]
     direct3 = state.direct[:, None, :]
-    nbr = jnp.clip(state.neighbors, 0, n - 1)
-    nbr_sub = jnp.transpose(state.subscribed[nbr], (0, 2, 1))  # [N,T,K]
-    nbr_sub = nbr_sub & conn
+    nbr_sub = state.nbr_subscribed & conn          # cached receiver view
     backoff_ok = tick >= state.backoff
     backoff_active = ~backoff_ok
 

@@ -140,9 +140,7 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array,
         # its connected subscribed neighbors, taken sender-side, then viewed
         # from the receiver through the edge permutation
         target = max(cfg.d, math.ceil(math.sqrt(cfg.n_peers)))
-        nbr = jnp.clip(state.neighbors, 0, cfg.n_peers - 1)
-        nbr_sub = jnp.transpose(state.subscribed[nbr], (0, 2, 1))   # [N,T,K]
-        cand = state.connected[:, None, :] & nbr_sub                # sender view
+        cand = state.connected[:, None, :] & state.nbr_subscribed   # sender view
         sel = select_random(cand, jnp.full((n, t), target), key)
         return edge_gather(sel, state) & conn & my_sub
     raise ValueError(f"unknown router {cfg.router!r}")

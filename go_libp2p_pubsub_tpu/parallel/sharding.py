@@ -45,7 +45,7 @@ def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
     layout = dict(
         tick=(0, False), neighbors=(2, True), connected=(2, True),
         outbound=(2, True), reverse_slot=(2, True), subscribed=(2, True),
-        disconnect_tick=(2, True),
+        nbr_subscribed=(3, True), disconnect_tick=(2, True),
         direct=(2, True), ip_group=(1, True), app_score=(1, True),
         malicious=(1, True),
         mesh=(3, True), fanout=(3, True), fanout_lastpub=(2, True),

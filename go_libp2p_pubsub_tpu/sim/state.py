@@ -38,6 +38,15 @@ class SimState(NamedTuple):
     outbound: jnp.ndarray             # [N, K] bool
     reverse_slot: jnp.ndarray         # [N, K] int32
     subscribed: jnp.ndarray           # [N, T] bool
+    nbr_subscribed: jnp.ndarray       # [N, T, K] bool cached receiver view:
+                                      #   slot s's peer subscribes topic t
+                                      #   (invalid slots False). The topology
+                                      #   is fixed, so this changes ONLY when
+                                      #   `subscribed` does — every mutation
+                                      #   of `subscribed` must go through
+                                      #   refresh_nbr_subscribed(); reading
+                                      #   it replaces a per-tick neighbor
+                                      #   gather in heartbeat/randomsub
     disconnect_tick: jnp.ndarray      # [N, K] int32 tick the edge went down,
                                       #   NEVER if up/never-connected; drives
                                       #   RetainScore expiry (score.go:611-644)
@@ -123,6 +132,16 @@ def init_state(cfg: SimConfig, topo: Topology,
         jnp.asarray(ip_group), jnp.asarray(app_score), jnp.asarray(malicious))
 
 
+def refresh_nbr_subscribed(state: SimState) -> SimState:
+    """Recompute the cached neighbor-subscription receiver view. MUST be
+    called after any mutation of ``state.subscribed`` (topic join/leave)."""
+    n = state.subscribed.shape[0]
+    nbr = jnp.clip(state.neighbors, 0, n - 1)
+    view = jnp.transpose(state.subscribed[nbr], (0, 2, 1)) \
+        & (state.neighbors >= 0)[:, None, :]
+    return state._replace(nbr_subscribed=view)
+
+
 @partial(jax.jit, static_argnames=("cfg",))
 def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
                  subscribed, ip_group, app_score, malicious) -> SimState:
@@ -137,6 +156,9 @@ def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
         outbound=outbound,
         reverse_slot=reverse_slot,
         subscribed=subscribed,
+        nbr_subscribed=jnp.transpose(
+            subscribed[jnp.clip(neighbors, 0, n - 1)], (0, 2, 1))
+        & (neighbors >= 0)[:, None, :],
         disconnect_tick=i32(n, k, fill=int(NEVER)),
         direct=b(n, k),
         ip_group=ip_group,

@@ -404,10 +404,14 @@ def _make_branches(cfg: SimConfig, tp: TopicParams):
             jnp.where(ok, c.astype(jnp.float32), 0.0)))
 
     def join(st, a, b, c):
-        return st._replace(subscribed=st.subscribed.at[a, c].set(True))
+        from ..sim.state import refresh_nbr_subscribed
+        return refresh_nbr_subscribed(
+            st._replace(subscribed=st.subscribed.at[a, c].set(True)))
 
     def leave(st, a, b, c):
-        return st._replace(subscribed=st.subscribed.at[a, c].set(False))
+        from ..sim.state import refresh_nbr_subscribed
+        return refresh_nbr_subscribed(
+            st._replace(subscribed=st.subscribed.at[a, c].set(False)))
 
     def publish_op(st, a, b, c):
         return st._replace(

@@ -72,6 +72,23 @@ class TestMeshFormation:
         assert float(delivery_fraction(st, cfg)) == 1.0
 
 
+class TestNbrSubscribedCache:
+    def test_cache_stays_consistent_under_subscription_churn(self):
+        """nbr_subscribed is a cached gather that every subscribed-mutation
+        must refresh (state.py); run with Join/Leave churn and recheck."""
+        from go_libp2p_pubsub_tpu.sim.state import refresh_nbr_subscribed
+        cfg = SimConfig(n_peers=64, k_slots=16, n_topics=3, msg_window=16,
+                        publishers_per_tick=2, prop_substeps=4,
+                        scoring_enabled=False,
+                        sub_join_prob=0.05, sub_leave_prob=0.05)
+        topo = topology.dense(64, 16, degree=10)
+        st = init_state(cfg, topo,
+                        subscribed=np.random.default_rng(0).random((64, 3)) < 0.5)
+        st = run(st, cfg, TopicParams.disabled(3), jax.random.PRNGKey(0), 15)
+        want = np.asarray(refresh_nbr_subscribed(st).nbr_subscribed)
+        assert (np.asarray(st.nbr_subscribed) == want).all()
+
+
 class TestEdgeGatherPacked:
     def test_matches_per_mask_edge_gather(self, converged):
         """The packed multi-mask permutation gather must be bit-identical to
