@@ -45,6 +45,8 @@ class PubSub:
                  msg_id_fn: Callable[[Message], str] | None = None,
                  rpc_inspector: Callable[[PeerID, RPC], bool] | None = None,
                  peer_filter: Callable[[PeerID, str], bool] | None = None,
+                 protocol_match_fn: Callable[
+                     [str], Callable[[str], bool]] | None = None,
                  max_message_size: int = 1 << 20,
                  author: PeerID | None = None,
                  no_author: bool = False,
@@ -94,9 +96,12 @@ class PubSub:
             else Discover(discovery)
         self.disc.start(self)
 
-        # wire up the substrate (pubsub.go:321-336)
+        # wire up the substrate (pubsub.go:321-336); protocol_match_fn is
+        # WithProtocolMatchFn (pubsub.go:520-531): custom multistream
+        # acceptance, combined with the router's feature test / protocol list
         host.set_protocols(router.protocols(), self._handle_new_stream,
-                           self._handle_incoming_rpc_wire)
+                           self._handle_incoming_rpc_wire,
+                           match_fn=protocol_match_fn)
         host.notify(_Notifiee(self))
         router.attach(self)
         self.val.start(self)

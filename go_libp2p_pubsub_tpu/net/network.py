@@ -96,6 +96,7 @@ class Host:
         self.supported: list[str] = []
         self.stream_handler: Callable[[PeerID, str], None] | None = None
         self.rpc_handler: Callable[[PeerID, RPC], None] | None = None
+        self.match_fn: Callable[[str], Callable[[str], bool]] | None = None
         self._inflight: dict[PeerID, int] = {}
         self.outbound_queue_size = DEFAULT_PEER_OUTBOUND_QUEUE_SIZE
         self.dropped_rpcs = 0
@@ -106,11 +107,25 @@ class Host:
 
     def set_protocols(self, protos: list[str],
                       stream_handler: Callable[[PeerID, str], None],
-                      rpc_handler: Callable[[PeerID, RPC], None]) -> None:
-        """Register pubsub's protocol list + handlers (pubsub.go:323-329)."""
+                      rpc_handler: Callable[[PeerID, RPC], None],
+                      match_fn: Callable[[str], Callable[[str], bool]] | None
+                      = None) -> None:
+        """Register pubsub's protocol list + handlers (pubsub.go:323-329).
+
+        ``match_fn`` is the WithProtocolMatchFn hook (pubsub.go:520-531):
+        maps each locally supported base protocol to a predicate over a
+        peer's proposed protocol id, replacing exact multistream matching
+        (e.g. semver-range acceptance, gossipsub_matchfn_test.go:79-90)."""
         self.supported = list(protos)
         self.stream_handler = stream_handler
         self.rpc_handler = rpc_handler
+        self.match_fn = match_fn
+
+    def accepts(self, proposal: str) -> bool:
+        """Would this host's mux accept a peer's proposed protocol id?"""
+        if self.match_fn is None:
+            return proposal in self.supported
+        return any(self.match_fn(base)(proposal) for base in self.supported)
 
     def notify(self, n: Notifiee) -> None:
         self._notifiees.append(n)
@@ -118,18 +133,26 @@ class Host:
     # -- connectivity --
 
     def connect(self, other: "Host") -> bool:
-        """Dial ``other``; negotiates the first mutually supported protocol
-        (the multistream-select analogue). Returns False if no overlap."""
+        """Dial ``other``; negotiates each direction's stream protocol: the
+        dialer proposes its list in order, the listener's mux accepts via
+        exact match or its match_fn (the multistream-select analogue; the
+        per-direction proposal mirrors the reference opening one outbound
+        stream per side, comm.go:114-133). Returns False when either
+        direction has no acceptable proposal — a simplification of the
+        reference, where the transport connection survives but no pubsub
+        streams open (observable pubsub behavior is identical)."""
         if other.peer_id in self.conns:
             return True
-        proto = next((p for p in self.supported if p in other.supported), None)
-        if self.supported and other.supported and proto is None:
+        proto_out = next((p for p in self.supported if other.accepts(p)), None)
+        proto_in = next((q for q in other.supported if self.accepts(q)), None)
+        if self.supported and other.supported and \
+                (proto_out is None or proto_in is None):
             return False
         self.conns[other.peer_id] = "outbound"
         other.conns[self.peer_id] = "inbound"
-        if proto is not None:
-            self.protocols[other.peer_id] = proto
-            other.protocols[self.peer_id] = proto
+        if proto_out is not None:
+            self.protocols[other.peer_id] = proto_out
+            other.protocols[self.peer_id] = proto_in
         for n in self._notifiees:
             n.connected(other.peer_id)
         for n in other._notifiees:

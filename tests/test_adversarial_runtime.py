@@ -525,6 +525,52 @@ class TestPiggybacking:
         assert not [r for r in raw.inbox if r.control and r.control.prune]
 
 
+class TestProtocolMatchFn:
+    """WithProtocolMatchFn (pubsub.go:520-531; gossipsub_matchfn_test.go:12):
+    custom multistream acceptance — semver-sloppy custom protocols mesh with
+    their base name, different names don't connect."""
+
+    def test_name_match_connects_custom_versions(self):
+        from go_libp2p_pubsub_tpu.routers.feat import GOSSIPSUB_ID_V11
+
+        def name_match(base):
+            base_name = base.split("/")[1]
+
+            def check(proposal):
+                return proposal.split("/")[1] == base_name
+            return check
+
+        custom_a100 = "/customsub_a/1.0.0"
+        custom_a101b = "/customsub_a/1.0.1-beta"
+        custom_b100 = "/customsub_b/1.0.0"
+        net = Network()
+        protos = [[custom_a100, GOSSIPSUB_ID_V11], [custom_a101b],
+                  [GOSSIPSUB_ID_V11], [custom_b100]]
+        nodes = [PubSub(net.add_host(),
+                        GossipSubRouter(protocols=pl_),
+                        sign_policy=LAX_NO_SIGN,
+                        protocol_match_fn=name_match)
+                 for pl_ in protos]
+        hubs = [n.host for n in nodes]
+        assert hubs[0].connect(hubs[1])        # via customsub_a name
+        assert hubs[0].connect(hubs[2])        # via exact v1.1
+        assert not hubs[0].connect(hubs[3])    # different names: no streams
+        subs = [n.join("t").subscribe() for n in nodes]
+        net.scheduler.run_for(2.0)
+        nodes[0].my_topics["t"].publish(b"m")
+        net.scheduler.run_for(1.0)
+
+        def drain(s):
+            out = []
+            while s.pending():
+                out.append(s.next().data)
+            return out
+
+        assert drain(subs[1]) == [b"m"]
+        assert drain(subs[2]) == [b"m"]
+        assert drain(subs[3]) == []
+
+
 class TestFeatureNegotiation:
     """Protocol feature tests (gossipsub_feat.go:24-36;
     gossipsub_matchfn_test.go): v1.0 peers participate in the mesh but
