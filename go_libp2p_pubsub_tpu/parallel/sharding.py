@@ -24,6 +24,7 @@ from ..sim.config import SimConfig, TopicParams
 from ..sim.state import SimState
 
 PEER_AXIS = "peers"
+DCN_AXIS = "dcn"
 
 
 def make_mesh(devices=None) -> Mesh:
@@ -31,14 +32,33 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.array(devices), (PEER_AXIS,))
 
 
+def make_mesh_2d(n_hosts: int, devices=None) -> Mesh:
+    """A (dcn, peers) mesh for multi-host runs: the peer axis shards over
+    BOTH axes (hosts-major), so a contiguous block of peers lives on each
+    host and the bulk of the per-hop exchange — neighbor-plane all-gathers
+    between chips of one host — rides ICI, with only the host-boundary
+    slices crossing DCN. This is the layout SURVEY.md §2.3 prescribes as
+    the stand-in for the reference's per-connection streams (comm.go:44-191)
+    scaled past one host."""
+    devices = devices if devices is not None else jax.devices()
+    devices = np.array(devices)
+    assert devices.size % n_hosts == 0, \
+        f"{devices.size} devices do not split over {n_hosts} hosts"
+    return Mesh(devices.reshape(n_hosts, -1), (DCN_AXIS, PEER_AXIS))
+
+
 def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
     """A SimState-shaped pytree of NamedShardings: peer-major arrays shard on
     axis 0, the global message table replicates, scalars replicate."""
     n = cfg.n_peers
+    # on a 2-D (dcn, peers) mesh the peer axis shards over both axes,
+    # hosts-major (see make_mesh_2d)
+    peer_axes = (DCN_AXIS, PEER_AXIS) if DCN_AXIS in mesh.axis_names \
+        else PEER_AXIS
 
     def spec_for(leaf_name: str, ndim: int, leading_n: bool):
         if leading_n:
-            return NamedSharding(mesh, P(PEER_AXIS, *([None] * (ndim - 1))))
+            return NamedSharding(mesh, P(peer_axes, *([None] * (ndim - 1))))
         return NamedSharding(mesh, P(*([None] * ndim)))
 
     # field -> (ndim, leading axis is N)
