@@ -124,32 +124,51 @@ def main() -> None:
         for name in names:
             run_scenario(name)
         return
+    def cpu_fallback_env():
+        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
+        # CPU is far slower per tick at 100k; keep the measured window
+        # short so scenarios fit the per-scenario timeout
+        env.setdefault("BENCH_TICKS", os.environ.get("BENCH_TICKS", "10"))
+        return env
+
     fallback_env = {}
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         pass        # CPU cannot wedge on the tunnel; skip the probe cost
     elif not _probe_default_platform():
         print(json.dumps({"warning": "default platform unreachable; "
                           "benching on CPU"}), flush=True)
-        fallback_env = {"JAX_PLATFORMS": "cpu"}
-        fallback_env["PALLAS_AXON_POOL_IPS"] = ""
+        fallback_env = cpu_fallback_env()
     # one subprocess per scenario: a platform slowdown or OOM in one config
     # cannot taint the others' measurements
     for name in names:
-        env = dict(os.environ, BENCH_SCENARIOS=name, BENCH_IN_PROC="1",
-                   **fallback_env)
-        err = ""
-        try:
-            res = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)], env=env,
-                capture_output=True, text=True,
-                timeout=int(os.environ.get("BENCH_TIMEOUT", 900)))
-            for line in res.stdout.splitlines():
-                if line.startswith("{"):
-                    print(line, flush=True)
-            if res.returncode != 0:
-                err = res.stderr.strip()[-300:] or f"rc={res.returncode}"
-        except subprocess.TimeoutExpired:
-            err = "timeout"
+        attempts = 0
+        while True:
+            attempts += 1
+            env = dict(os.environ, BENCH_SCENARIOS=name, BENCH_IN_PROC="1",
+                       **fallback_env)
+            err = ""
+            try:
+                res = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)], env=env,
+                    capture_output=True, text=True,
+                    timeout=int(os.environ.get("BENCH_TIMEOUT", 900)))
+                for line in res.stdout.splitlines():
+                    if line.startswith("{"):
+                        print(line, flush=True)
+                if res.returncode != 0:
+                    err = res.stderr.strip()[-300:] or f"rc={res.returncode}"
+            except subprocess.TimeoutExpired:
+                err = "timeout"
+            if err == "timeout" and not fallback_env and attempts == 1 \
+                    and not _probe_default_platform():
+                # the tunnel wedged MID-RUN (round-2 failure mode: every
+                # backend init hangs): finish the suite on CPU instead of
+                # timing out zeros for every remaining scenario
+                print(json.dumps({"warning": "default platform wedged "
+                                  "mid-run; continuing on CPU"}), flush=True)
+                fallback_env = cpu_fallback_env()
+                continue
+            break
         if err:
             print(json.dumps({
                 "metric": f"network_heartbeats_per_sec@{_label(name)}",
