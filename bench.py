@@ -125,11 +125,10 @@ def main() -> None:
             run_scenario(name)
         return
     def cpu_fallback_env():
-        env = {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": ""}
         # CPU is far slower per tick at 100k; keep the measured window
         # short so scenarios fit the per-scenario timeout
-        env.setdefault("BENCH_TICKS", os.environ.get("BENCH_TICKS", "10"))
-        return env
+        return {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "BENCH_TICKS": os.environ.get("BENCH_TICKS", "10")}
 
     fallback_env = {}
     if os.environ.get("JAX_PLATFORMS") == "cpu":

@@ -282,13 +282,24 @@ class PubSub:
 
     # ---- public API (L6) ----
 
-    def join(self, topic_name: str) -> "Topic":
-        """pubsub.go:1228-1279 (tryJoin)."""
+    def join(self, topic_name: str, *, msg_id_fn=None) -> "Topic":
+        """pubsub.go:1228-1279 (tryJoin). ``msg_id_fn`` is the
+        WithTopicMessageIdFn TopicOpt (pubsub.go:1219-1224): a per-topic
+        message-id override consulted by dedup, mcache, and tracing."""
         if self.sub_filter is not None and not self.sub_filter.can_subscribe(topic_name):
             raise ValueError(f"topic is not allowed by the subscription filter: {topic_name}")
         t = self.my_topics.get(topic_name)
         if t is not None:
+            if msg_id_fn is not None:
+                # the reference refuses Join on an existing topic outright
+                # (pubsub.go:1229-1232); we allow handle reuse but never
+                # silently drop a requested option
+                raise ValueError(
+                    f"topic already joined: {topic_name}; per-topic "
+                    "msg_id_fn must be set on the first join")
             return t
+        if msg_id_fn is not None:
+            self.id_gen.set(topic_name, msg_id_fn)
         from .topic import Topic
         t = Topic(self, topic_name)
         self.my_topics[topic_name] = t
@@ -320,22 +331,43 @@ class PubSub:
 
     # ---- outbound ----
 
-    def send_rpc(self, peer: PeerID, rpc: RPC) -> None:
+    def send_rpc(self, peer: PeerID, rpc: RPC) -> bool:
         """Send with drop-trace on queue overflow (pubsub.go:917-925 announce
-        path and gossipsub.go:1195-1202 both land here)."""
+        path and gossipsub.go:1195-1202 both land here). Returns whether the
+        RPC entered the peer's queue (empty-after-trim counts as sent)."""
         out = trim_rpc(rpc)
         if out is None:
-            return
+            return True
         if self.host.send(peer, out):
             self.tracer.send_rpc(out, peer)
-        else:
-            self.tracer.drop_rpc(out, peer)
+            return True
+        self.tracer.drop_rpc(out, peer)
+        return False
 
     def announce(self, topic: str, subscribe: bool) -> None:
         """Announce (un)subscription to every peer (pubsub.go:910-927)."""
-        rpc = RPC(subscriptions=[SubOpts(subscribe, topic)])
         for peer in sorted(self.peers):
-            self.send_rpc(peer, RPC(subscriptions=list(rpc.subscriptions)))
+            self._announce_to_peer(peer, topic, subscribe)
+
+    def _announce_to_peer(self, peer: PeerID, topic: str,
+                          subscribe: bool) -> None:
+        """One peer's announcement; a queue-overflow drop schedules a
+        jittered retry (1..1000ms) that re-checks the (un)subscription
+        still holds before resending (pubsub.go:917-925 + announceRetry
+        pubsub.go:929-969)."""
+        if self.send_rpc(peer, RPC(subscriptions=[SubOpts(subscribe, topic)])):
+            return
+        delay = 0.001 * (1 + self.rng.randrange(1000))
+
+        def retry():
+            if peer not in self.peers:
+                return
+            t = self.my_topics.get(topic)
+            wanted = t is not None and (bool(t._subs) or t._relay_count > 0)
+            if wanted == subscribe:
+                self._announce_to_peer(peer, topic, subscribe)
+
+        self.scheduler.call_later(delay, retry)
 
     def sign_and_finalize(self, msg: Message) -> None:
         """Attach author/seqno/signature per policy (topic.go:252-264)."""

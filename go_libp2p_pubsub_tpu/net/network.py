@@ -120,6 +120,27 @@ class Host:
         self.stream_handler = stream_handler
         self.rpc_handler = rpc_handler
         self.match_fn = match_fn
+        # late registration: negotiate streams over connections that existed
+        # before this protocol handler did (the reference opens streams
+        # lazily per peer, so a pubsub attached after dialing still works —
+        # exercised by its preconnected-nodes scenario). Re-fires connected
+        # notifications so both sides' pubsubs re-evaluate the peer.
+        for peer in list(self.conns):
+            other = self.network.hosts.get(peer)
+            if other is None or peer in self.protocols:
+                continue
+            proto_out = next((p for p in self.supported
+                              if other.accepts(p)), None)
+            proto_in = next((q for q in other.supported
+                             if self.accepts(q)), None)
+            if proto_out is None or proto_in is None:
+                continue
+            self.protocols[peer] = proto_out
+            other.protocols[self.peer_id] = proto_in
+            for n in self._notifiees:
+                n.connected(peer)
+            for n in other._notifiees:
+                n.connected(self.peer_id)
 
     def accepts(self, proposal: str) -> bool:
         """Would this host's mux accept a peer's proposed protocol id?"""

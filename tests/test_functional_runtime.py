@@ -564,6 +564,94 @@ class TestRelayRefcounting:
         assert "t" not in r.rt.mesh          # last cancel leaves the topic
 
 
+class TestAnnounceRetry:
+    def test_dropped_announce_retried_with_jitter(self):
+        """pubsub.go:917-969: an announcement dropped on a full peer queue
+        is retried after 1..1000ms, re-checking the subscription holds."""
+        net, nodes = make_net(2, GossipSubRouter, connect="all")
+        a, b = nodes
+        a.host.outbound_queue_size = 0          # every send drops
+        a.join("t").subscribe()
+        net.scheduler.run_for(0.01)
+        assert a.pid not in b.topics.get("t", set())
+        a.host.outbound_queue_size = 32         # queue drains; retry lands
+        net.scheduler.run_for(1.5)
+        assert a.pid in b.topics.get("t", set())
+
+    def test_retry_skipped_after_unsubscribe(self):
+        net, nodes = make_net(2, GossipSubRouter, connect="all")
+        a, b = nodes
+        a.host.outbound_queue_size = 0
+        sub = a.join("t").subscribe()
+        net.scheduler.run_for(0.01)
+        sub.cancel()                            # unsubscribe before retry
+        a.host.outbound_queue_size = 32
+        net.scheduler.run_for(1.5)
+        # the subscribe retry noticed the cancel; only the unsubscribe
+        # state (possibly also dropped+retried) may have announced
+        assert a.pid not in b.topics.get("t", set())
+
+
+class TestTopicMsgIdFn:
+    def test_per_topic_id_function_drives_dedup(self):
+        """WithTopicMessageIdFn (pubsub.go:1219-1224): two distinct
+        publishes whose custom id collides dedup to one delivery."""
+        net, nodes = make_net(2, GossipSubRouter, connect="all")
+        a, b = nodes
+        ta = a.join("t", msg_id_fn=lambda m: "constant-id")
+        tb = b.join("t", msg_id_fn=lambda m: "constant-id")
+        sub = tb.subscribe()
+        ta.subscribe()
+        net.scheduler.run_for(1.5)
+        ta.publish(b"one")
+        ta.publish(b"two")                      # same custom id: seen-cached
+        net.scheduler.run_for(1.0)
+        assert [m.data for m in drain(sub)] == [b"one"]
+
+    def test_msg_id_fn_on_already_joined_topic_rejected(self):
+        net, nodes = make_net(1, GossipSubRouter)
+        nodes[0].join("t")
+        with pytest.raises(ValueError):
+            nodes[0].join("t", msg_id_fn=lambda m: "x")
+
+
+class TestTreeTopology:
+    def test_multihop_delivery_along_tree(self):
+        """TestGossipsubTreeTopology semantics: a message published at a
+        leaf crosses multiple hops to every other node."""
+        net = Network()
+        nodes = [PubSub(net.add_host(), GossipSubRouter(),
+                        sign_policy=LAX_NO_SIGN) for _ in range(10)]
+        hosts = [n.host for n in nodes]
+        # binary-ish tree: i connects to (i-1)//2
+        for i in range(1, 10):
+            net.connect(hosts[i], hosts[(i - 1) // 2])
+        subs = [n.join("t").subscribe() for n in nodes]
+        net.scheduler.run_for(3.0)
+        nodes[9].my_topics["t"].publish(b"leaf")
+        net.scheduler.run_for(3.0)
+        for i, s in enumerate(subs):
+            assert [m.data for m in drain(s)] == [b"leaf"], f"node {i}"
+
+
+class TestPreconnectedNodes:
+    def test_pubsub_attaches_to_existing_connections(self):
+        """pubsub.go:336: PubSub constructed AFTER the host connected still
+        sweeps existing connections and routes."""
+        net = Network()
+        ha, hb = net.add_host(), net.add_host()
+        a = PubSub(ha, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        # connect while b has no PubSub yet; empty supported list accepts
+        net.connect(ha, hb)
+        b = PubSub(hb, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        sub = b.join("t").subscribe()
+        a.join("t").subscribe()
+        net.scheduler.run_for(2.0)
+        a.my_topics["t"].publish(b"pre")
+        net.scheduler.run_for(1.0)
+        assert [m.data for m in drain(sub)] == [b"pre"]
+
+
 class TestPublishReadiness:
     def test_publish_defers_until_peers_arrive(self):
         """WithReadiness (topic.go:270-309): routing waits for RouterReady;
