@@ -30,9 +30,22 @@ class TopicEventHandler:
     pairs for the same peer cancel out, mirroring the reference's
     peer-event coalescing."""
 
-    def __init__(self):
+    def __init__(self, topic: "Topic | None" = None):
+        self._topic = topic
         self._pending: dict[PeerID, str] = {}
         self._order: list[PeerID] = []
+
+    def cancel(self) -> None:
+        """Stop receiving events (topic.go:432-436 TopicEventHandler.Cancel);
+        drops anything buffered; idempotent."""
+        if self._topic is not None:
+            try:
+                self._topic._event_handlers.remove(self)
+            except ValueError:
+                pass
+            self._topic = None
+        self._pending.clear()
+        self._order.clear()
 
     def _push(self, ev: PeerEvent) -> None:
         cur = self._pending.get(ev.peer)
@@ -50,10 +63,6 @@ class TopicEventHandler:
             if typ is not None:
                 return PeerEvent(typ, peer)
         return None
-
-    def cancel(self) -> None:
-        self._pending.clear()
-        self._order.clear()
 
 
 class Topic:
@@ -142,7 +151,7 @@ class Topic:
     def event_handler(self) -> TopicEventHandler:
         """topic.go:392-430; pre-seeds with currently known topic peers."""
         self._check_closed()
-        h = TopicEventHandler()
+        h = TopicEventHandler(self)
         for peer in sorted(self.p.topics.get(self.name, ())):
             h._push(PeerEvent("join", peer))
         self._event_handlers.append(h)

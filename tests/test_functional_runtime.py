@@ -564,6 +564,60 @@ class TestRelayRefcounting:
         assert "t" not in r.rt.mesh          # last cancel leaves the topic
 
 
+class TestBlacklistLifecycle:
+    def test_blacklist_after_subscribe_blocks_messages(self):
+        """TestBlacklist2 (blacklist_test.go:65): blacklisting an already
+        connected, announced peer stops its messages."""
+        net, nodes = make_net(2, GossipSubRouter, connect="all")
+        a, b = nodes
+        a.join("t").subscribe()
+        sub = b.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        b.blacklist_peer(a.pid)
+        net.scheduler.run_for(0.2)
+        a.my_topics["t"].publish(b"m")
+        net.scheduler.run_for(1.0)
+        assert drain(sub) == []
+
+    def test_blacklist_before_connect_blocks_announcements(self):
+        """TestBlacklist3 (blacklist_test.go:98): a peer blacklisted before
+        connecting never registers as a topic peer and delivers nothing."""
+        net = Network()
+        a = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        b = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        b.blacklist_peer(a.pid)
+        net.connect(a.host, b.host)
+        a.join("t").subscribe()
+        sub = b.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        assert a.pid not in b.topics.get("t", set())
+        a.my_topics["t"].publish(b"m")
+        net.scheduler.run_for(1.0)
+        assert drain(sub) == []
+
+
+class TestTopicEventHandlerCancel:
+    def test_cancelled_handler_stops_receiving(self):
+        """TestTopicEventHandlerCancel (topic_test.go): after Cancel, peer
+        join events no longer reach the handler."""
+        net = Network()
+        a = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        ta = a.join("t")
+        ta.subscribe()
+        h = ta.event_handler()
+        h.cancel()
+        h.cancel()                              # idempotent
+        b = PubSub(net.add_host(), GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        b.join("t").subscribe()
+        net.connect(a.host, b.host)
+        net.scheduler.run_for(1.0)
+        assert h.next_peer_event() is None
+        # a live handler on the same topic still sees the join
+        h2 = ta.event_handler()
+        ev = h2.next_peer_event()
+        assert ev is not None and ev.type == "join" and ev.peer == b.pid
+
+
 class TestAnnounceRetry:
     def test_dropped_announce_retried_with_jitter(self):
         """pubsub.go:917-969: an announcement dropped on a full peer queue
