@@ -1,0 +1,162 @@
+"""ctypes binding for the native RPC wire scanner (native/rpc_codec.cpp).
+
+Bulk host-side RPC streams — interop captures, adversarial load fixtures,
+differential-test corpora — are framed exactly like the reference's wire
+(uvarint length prefix per RPC, comm.go:157-171). Scanning them frame by
+frame through pb/codec.py builds a Python object per message; this path
+walks the stream natively and returns three arrays:
+
+  stats  [F, 8] int64 — per frame: subscriptions, publish count, publish
+         data bytes, IHAVE ids, IWANT ids, GRAFTs, PRUNEs, PX records
+  msgs   [M, 4] int64 — per publish message: frame idx, topic id,
+         data length, big-endian seqno
+  topics list[str] — topic_id -> topic name (first-seen order)
+
+``scan_bytes`` uses the native library when buildable and falls back to
+the pure-Python scan (same contract; tests/test_native_codec.py asserts
+array equality between the two).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "rpc_codec.cpp")
+_SO = os.path.join(_NATIVE_DIR, "librpccodec.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load():
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.rpc_codec_scan.restype = ctypes.c_int
+        lib.rpc_codec_scan.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.rpc_codec_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def scan_bytes_python(data: bytes, max_frame: int = 0):
+    """Pure-Python twin of the native scan (the fallback + parity oracle)."""
+    from .codec import read_uvarint, decode_rpc
+
+    stats, msgs, topics, topic_ids = [], [], [], {}
+    pos, frame = 0, 0
+    while pos < len(data):
+        flen, pos = read_uvarint(data, pos)
+        if flen > len(data) - pos:
+            raise ValueError("malformed frame")
+        if max_frame and flen > max_frame:
+            raise ValueError("oversize frame")
+        rpc = decode_rpc(data[pos:pos + flen])
+        pos += flen
+        st = [0] * 8
+        st[0] = len(rpc.subscriptions)
+        st[1] = len(rpc.publish)
+        for m in rpc.publish:
+            tid = topic_ids.get(m.topic)
+            if tid is None and m.topic:
+                tid = len(topics)
+                topics.append(m.topic)
+                topic_ids[m.topic] = tid
+            data_len = len(m.data or b"")
+            st[2] += data_len
+            seqno = int.from_bytes((m.seqno or b"")[:8], "big")
+            msgs.append([frame, tid if tid is not None else -1,
+                         data_len, seqno])
+        c = rpc.control
+        if c is not None:
+            st[3] = sum(len(ih.message_ids) for ih in c.ihave)
+            st[4] = sum(len(iw.message_ids) for iw in c.iwant)
+            st[5] = len(c.graft)
+            st[6] = len(c.prune)
+            st[7] = sum(len(pr.peers) for pr in c.prune)
+        stats.append(st)
+        frame += 1
+    return (np.asarray(stats, np.int64).reshape(-1, 8),
+            np.asarray(msgs, np.int64).reshape(-1, 4), topics)
+
+
+def scan_bytes(data: bytes, max_frame: int = 0):
+    """Scan an RPC frame stream -> (stats [F,8], msgs [M,4], topics)."""
+    lib = load()
+    if lib is None:
+        return scan_bytes_python(data, max_frame)
+    stats_p = ctypes.POINTER(ctypes.c_int64)()
+    msgs_p = ctypes.POINTER(ctypes.c_int64)()
+    topics_p = ctypes.POINTER(ctypes.c_char)()
+    n_frames = ctypes.c_long()
+    n_msgs = ctypes.c_long()
+    topics_bytes = ctypes.c_long()
+    rc = lib.rpc_codec_scan(
+        data, len(data), max_frame,
+        ctypes.byref(stats_p), ctypes.byref(n_frames),
+        ctypes.byref(msgs_p), ctypes.byref(n_msgs),
+        ctypes.byref(topics_p), ctypes.byref(topics_bytes))
+    if rc != 0:
+        raise ValueError(f"native rpc scan failed (rc={rc}): "
+                         + ("oversize frame" if rc == 3 else "malformed"))
+    try:
+        stats = np.ctypeslib.as_array(
+            stats_p, shape=(n_frames.value, 8)).copy() \
+            if n_frames.value else np.zeros((0, 8), np.int64)
+        msgs = np.ctypeslib.as_array(
+            msgs_p, shape=(n_msgs.value, 4)).copy() \
+            if n_msgs.value else np.zeros((0, 4), np.int64)
+        raw = ctypes.string_at(topics_p, topics_bytes.value) \
+            if topics_bytes.value else b""
+    finally:
+        lib.rpc_codec_free(stats_p)
+        lib.rpc_codec_free(msgs_p)
+        lib.rpc_codec_free(topics_p)
+    topics, off = [], 0
+    while off < len(raw):
+        ln = int.from_bytes(raw[off:off + 4], "little")
+        off += 4
+        topics.append(raw[off:off + ln].decode("utf-8"))
+        off += ln
+    return stats.astype(np.int64), msgs.astype(np.int64), topics

@@ -71,3 +71,79 @@ class TestNativeParity:
     def test_empty_stream_noop(self):
         feed = native.tensorize_bytes(b"", {"a": 0}, {TOPIC: 0}, msg_window=8)
         assert list(feed.op) == [0]  # OP_NOP
+
+
+class TestNativeRpcScanner:
+    """native/rpc_codec.cpp vs the pure-Python scan: identical arrays over a
+    randomized uvarint-framed RPC stream (comm.go:157-171 framing over
+    pb/rpc.proto)."""
+
+    def _random_stream(self, seed=7, frames=60):
+        import random
+        from go_libp2p_pubsub_tpu.core.types import (
+            ControlGraft, ControlIHave, ControlIWant, ControlMessage,
+            ControlPrune, Message, PeerInfo, RPC, SubOpts)
+        rng = random.Random(seed)
+        out = bytearray()
+        for _ in range(frames):
+            rpc = RPC()
+            for _ in range(rng.randrange(3)):
+                rpc.subscriptions.append(
+                    SubOpts(rng.random() < 0.5, f"t{rng.randrange(5)}"))
+            for _ in range(rng.randrange(4)):
+                m = Message(data=bytes(rng.randrange(40)),
+                            topic=f"t{rng.randrange(5)}")
+                m.from_peer = f"peer{rng.randrange(9)}"
+                m.seqno = rng.randrange(1 << 48).to_bytes(8, "big")
+                rpc.publish.append(m)
+            if rng.random() < 0.7:
+                c = ControlMessage()
+                for _ in range(rng.randrange(3)):
+                    c.ihave.append(ControlIHave(
+                        topic=f"t{rng.randrange(5)}",
+                        message_ids=[f"m{rng.randrange(50)}"
+                                     for _ in range(rng.randrange(6))]))
+                for _ in range(rng.randrange(2)):
+                    c.iwant.append(ControlIWant(
+                        message_ids=[f"m{rng.randrange(50)}"
+                                     for _ in range(rng.randrange(4))]))
+                for _ in range(rng.randrange(2)):
+                    c.graft.append(ControlGraft(topic="g"))
+                for _ in range(rng.randrange(2)):
+                    pr = ControlPrune(topic="p", backoff=rng.randrange(90))
+                    for _ in range(rng.randrange(3)):
+                        pr.peers.append(PeerInfo(peer_id=f"px{rng.randrange(7)}"))
+                    c.prune.append(pr)
+                if not c.is_empty():
+                    rpc.control = c
+            out += codec.frame_rpc(rpc)
+        return bytes(out)
+
+    def test_native_matches_python(self):
+        from go_libp2p_pubsub_tpu.pb import native_rpc
+        if not native_rpc.available():
+            pytest.skip("no native toolchain")
+        data = self._random_stream()
+        s_n, m_n, t_n = native_rpc.scan_bytes(data)
+        s_p, m_p, t_p = native_rpc.scan_bytes_python(data)
+        np.testing.assert_array_equal(s_n, s_p)
+        np.testing.assert_array_equal(m_n, m_p)
+        assert t_n == t_p
+        assert s_n.shape[0] == 60 and s_n[:, 1].sum() == m_n.shape[0]
+
+    def test_oversize_frame_rejected(self):
+        from go_libp2p_pubsub_tpu.pb import native_rpc
+        from go_libp2p_pubsub_tpu.core.types import Message, RPC
+        rpc = RPC()
+        rpc.publish.append(Message(data=b"x" * 4096, topic="t"))
+        data = codec.frame_rpc(rpc)
+        with pytest.raises(ValueError):
+            native_rpc.scan_bytes(data, max_frame=1024)
+        if native_rpc.available():
+            with pytest.raises(ValueError):
+                native_rpc.scan_bytes_python(data, max_frame=1024)
+
+    def test_empty_stream(self):
+        from go_libp2p_pubsub_tpu.pb import native_rpc
+        s, m, t = native_rpc.scan_bytes(b"")
+        assert s.shape == (0, 8) and m.shape == (0, 4) and t == []
