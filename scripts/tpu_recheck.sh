@@ -1,0 +1,16 @@
+#!/bin/bash
+# One-shot TPU re-measurement after the kernel rebuild: per-phase ablations
+# at the two scales that exposed the scalar-gather pathology, then the full
+# benchmark suite. Each step logs independently so a tunnel wedge mid-way
+# loses only the remaining steps.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p /tmp/tpu_recheck
+for step in "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
+            "ablate_100k:python scripts/ablate.py 100k_sweep 5" \
+            "bench:python bench.py"; do
+  name="${step%%:*}"; cmd="${step#*:}"
+  echo "== $name: $cmd =="
+  timeout 1500 $cmd 2>&1 | grep -v WARNING | tee "/tmp/tpu_recheck/$name.log"
+  echo "== $name done (rc=$?) =="
+done
