@@ -18,11 +18,14 @@ FLOODSUB_TOPIC_SEARCH_SIZE = 5  # floodsub.go:13
 
 
 class FloodSubRouter:
-    def __init__(self):
+    def __init__(self, protocols: list[str] | None = None):
+        """``protocols`` is NewFloodsubWithProtocols (floodsub.go:29-38):
+        a custom protocol list replacing the default floodsub id."""
         self.p: "PubSub | None" = None
+        self._protocols = list(protocols) if protocols else [FLOODSUB_ID]
 
     def protocols(self) -> list[str]:
-        return [FLOODSUB_ID]
+        return list(self._protocols)
 
     def attach(self, p: "PubSub") -> None:
         self.p = p

@@ -564,6 +564,87 @@ class TestRelayRefcounting:
         assert "t" not in r.rt.mesh          # last cancel leaves the topic
 
 
+class TestSubscriptionMultiplicity:
+    def test_subscribe_multiple_times_both_delivered(self):
+        """TestSubscribeMultipleTimes (pubsub_test.go): two subscriptions on
+        one topic each receive every message."""
+        net, nodes = make_net(2, GossipSubRouter, connect="all")
+        a, b = nodes
+        ta = a.join("t")
+        s1, s2 = ta.subscribe(), ta.subscribe()
+        b.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        b.my_topics["t"].publish(b"m")
+        net.scheduler.run_for(1.0)
+        assert [m.data for m in drain(s1)] == [b"m"]
+        assert [m.data for m in drain(s2)] == [b"m"]
+
+    def test_topic_reporting(self):
+        """TestPeerTopicReporting/TestSubReporting semantics: GetTopics and
+        ListPeers reflect live subscription state."""
+        net, nodes = make_net(3, GossipSubRouter, connect="all")
+        a, b, c = nodes
+        sa = a.join("x").subscribe()
+        b.join("x").subscribe()
+        b.join("y").subscribe()
+        c.join("y").subscribe()
+        net.scheduler.run_for(1.0)
+        assert a.get_topics() == ["x"]
+        assert sorted(b.get_topics()) == ["x", "y"]
+        assert set(a.list_peers("x")) == {b.pid}
+        assert set(c.list_peers("y")) == {b.pid}
+        sa.cancel()
+        net.scheduler.run_for(1.0)
+        assert a.get_topics() == []
+        assert a.pid not in set(b.list_peers("x"))
+
+
+class TestInvalidAuthor:
+    def test_forged_author_rejected(self):
+        """TestWithInvalidMessageAuthor semantics: a signed message whose
+        author does not match the signing key is rejected at validation."""
+        from go_libp2p_pubsub_tpu.api import STRICT_SIGN, generate_keypair
+        net = Network()
+        key_a, pid_a = generate_keypair(seed=b"real-author")
+        key_f, pid_f = generate_keypair(seed=b"forger")
+        a = PubSub(net.add_host(peer_id=pid_a), GossipSubRouter(),
+                   sign_policy=STRICT_SIGN, sign_key=key_a)
+        b = PubSub(net.add_host(peer_id=pid_f), GossipSubRouter(),
+                   sign_policy=STRICT_SIGN, sign_key=key_f)
+        net.connect(a.host, b.host)
+        sub = b.join("t").subscribe()
+        ta = a.join("t")
+        ta.subscribe()
+        net.scheduler.run_for(1.5)
+        # forge: sign with the forger's key but claim the real author's id
+        with pytest.raises(ValidationError):
+            ta.publish(b"forged", custom_key=(pid_a, key_f))
+        net.scheduler.run_for(1.0)
+        assert drain(sub) == []
+
+
+class TestFloodsubPluggableProtocol:
+    def test_custom_protocol_interops(self):
+        """TestFloodSubPluggableProtocol (floodsub_test.go): floodsub nodes
+        on a custom protocol id route among themselves; a default-protocol
+        node cannot join them."""
+        custom = "/myfloodsub/0.1.0"
+        net = Network()
+        nodes = [PubSub(net.add_host(),
+                        FloodSubRouter(protocols=[custom]),
+                        sign_policy=LAX_NO_SIGN) for _ in range(3)]
+        net.connect_all([n.host for n in nodes])
+        subs = [n.join("t").subscribe() for n in nodes]
+        net.scheduler.run_for(0.5)
+        nodes[0].my_topics["t"].publish(b"m")
+        net.scheduler.run_for(0.5)
+        for s in subs:
+            assert [m.data for m in drain(s)] == [b"m"]
+        vanilla = PubSub(net.add_host(), FloodSubRouter(),
+                         sign_policy=LAX_NO_SIGN)
+        assert not vanilla.host.connect(nodes[0].host)
+
+
 class TestBlacklistLifecycle:
     def test_blacklist_after_subscribe_blocks_messages(self):
         """TestBlacklist2 (blacklist_test.go:65): blacklisting an already
