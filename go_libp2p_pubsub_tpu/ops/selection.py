@@ -31,6 +31,35 @@ def ranks_desc(keys: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(beats, axis=-1)
 
 
+def _select_by_keys(keys: jnp.ndarray, mask: jnp.ndarray,
+                    count: jnp.ndarray) -> jnp.ndarray:
+    """Top-``count`` by key per row, masked. Two formulations with
+    identical results on distinct keys (ties occur only between masked
+    NEG_INF entries, which are excluded): the fused O(K^2) comparison rank
+    wins on TPU (no [..., K, K] materialization survives fusion), a sort +
+    per-row threshold wins on CPU where the comparison matrix is ~30%
+    slower at beacon shapes (scripts/microbench_kernels.py)."""
+    k = keys.shape[-1]
+    if jax.default_backend() == "cpu":
+        # exact tie handling (float32 keys DO collide at 4M draws/call)
+        # without x64: lexicographic two-key sort on (inverted sortable
+        # bits, slot), so equal keys break toward the lower slot — the
+        # same order ranks_desc defines — then select by per-row
+        # count-th-smallest threshold pair
+        u = jax.lax.bitcast_convert_type(keys, jnp.uint32)
+        u = jnp.where(keys < 0, ~u, u | jnp.uint32(0x80000000))
+        p = ~u                                     # ascending = best first
+        slot = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), keys.shape)
+        sp, ss = jax.lax.sort((p, slot), dimension=-1, num_keys=2)
+        idx = jnp.clip(count[..., None] - 1, 0, k - 1)
+        p_thr = jnp.take_along_axis(sp, idx, axis=-1)
+        s_thr = jnp.take_along_axis(ss, idx, axis=-1)
+        sel = (p < p_thr) | ((p == p_thr) & (slot <= s_thr))
+        return mask & sel & (count[..., None] > 0)
+    r = ranks_desc(keys)
+    return (r < count[..., None]) & mask
+
+
 def select_random(mask: jnp.ndarray, count: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
     """Uniformly choose up to ``count`` True positions per row of ``mask``.
 
@@ -38,8 +67,7 @@ def select_random(mask: jnp.ndarray, count: jnp.ndarray, key: jax.Array) -> jnp.
     """
     noise = jax.random.uniform(key, mask.shape)
     keys = jnp.where(mask, noise, NEG_INF)
-    r = ranks_desc(keys)
-    return (r < count[..., None]) & mask
+    return _select_by_keys(keys, mask, count)
 
 
 def select_top(score: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
@@ -51,8 +79,7 @@ def select_top(score: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray) -> jnp
     k = mask.shape[-1]
     tiebreak = -jnp.arange(k, dtype=jnp.float32) * 1e-9
     keys = jnp.where(mask, score + tiebreak, NEG_INF)
-    r = ranks_desc(keys)
-    return (r < count[..., None]) & mask
+    return _select_by_keys(keys, mask, count)
 
 
 def masked_median(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:
