@@ -116,11 +116,15 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     # ungated form — a skipped block equals selecting with count 0, and the
     # RNG keys are pre-split so skipping consumes no randomness.
 
-    # 2. undersubscribed: graft random candidates up to D (gossipsub.go:1413-1427)
+    # 2. undersubscribed: graft random candidates up to D (gossipsub.go:1413-1427).
+    # The gate requires need AND at least one candidate: sparse corners sit
+    # permanently under Dlo with nothing to graft, and would otherwise keep
+    # the selection kernel live every tick (a no-op row selects nothing
+    # either way, so the gate never changes results).
     n_mesh = jnp.sum(mesh1, axis=-1)
     need = jnp.where(n_mesh < cfg.dlo, cfg.d - n_mesh, 0)
     graft1 = jax.lax.cond(
-        jnp.any(need > 0),
+        jnp.any((need > 0) & jnp.any(candidate, -1)),
         lambda: select_random(candidate, need, ks[0]),
         lambda: jnp.zeros_like(candidate))
     mesh2 = mesh1 | graft1
@@ -152,9 +156,10 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     n_out = jnp.sum(mesh3 & out3, axis=-1)
     need_out = jnp.where((n3 >= cfg.dlo) & ~over[..., 0] & (n_out < cfg.dout),
                          cfg.dout - n_out, 0)
+    out_cand = candidate & out3 & ~mesh3
     graft_out = jax.lax.cond(
-        jnp.any(need_out > 0),
-        lambda: select_random(candidate & out3 & ~mesh3, need_out, ks[4]),
+        jnp.any((need_out > 0) & jnp.any(out_cand, -1)),
+        lambda: select_random(out_cand, need_out, ks[4]),
         lambda: jnp.zeros_like(mesh3))
     mesh4 = mesh3 | graft_out
 
