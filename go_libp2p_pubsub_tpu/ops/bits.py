@@ -65,12 +65,16 @@ def unpack_words(p: jnp.ndarray, m: int) -> jnp.ndarray:
 def gather_words_rows(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int) -> jnp.ndarray:
     """out[w, k, n] = x_w[w, nbr[n, k]] — neighbor gather of packed words.
 
-    Implemented as an unpack -> row gather -> repack: TPU lowers the direct
+    On TPU: unpack -> row gather -> repack, because XLA lowers the direct
     per-word scalar-index gather (``x_w[i][nbr.T]``) to serialized scalar
     loads (~5ms per 480k indices measured on v5e), while gathering [M]-lane
     boolean rows hits the vector DMA path (~2.5x faster at 10k peers, wider
-    at 100k where the scalar form is ~3.2M loads per word).
+    at 100k where the scalar form is ~3.2M loads per word). On CPU the
+    scalar-index gather is the fast path and the unpack/repack only adds
+    passes, so dispatch by backend.
     """
+    if jax.default_backend() == "cpu":
+        return jnp.stack([x_w[i][nbr.T] for i in range(x_w.shape[0])])
     planes = unpack_words(x_w, m)                    # [N, M] bool
     rows = planes[nbr]                               # [N, K, M] row gather
     return jnp.transpose(pack_bool(rows), (2, 1, 0))  # [W, K, N]
