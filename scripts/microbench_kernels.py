@@ -98,12 +98,25 @@ def main():
         return (g[:, None, :] >> jnp.arange(t, dtype=jnp.uint32)[None, :, None]
                 & 1).astype(bool)
 
+    def eg_rows_pick(x):
+        # pack T -> u32 [N,K]; ROW-gather each receiver's neighbor K'-rows
+        # ([N,K,K'] u32); pick reverse_slot per edge via bitplane select
+        tb = (jnp.uint32(1) << jnp.arange(t, dtype=jnp.uint32))
+        packed = jnp.sum(jnp.where(x, tb[None, :, None], jnp.uint32(0)),
+                         axis=1, dtype=jnp.uint32)          # [N, K]
+        rows = packed[nbr]                                  # [N, K, K'] rows
+        g = jnp.take_along_axis(rows, rk[:, :, None], axis=-1)[..., 0]
+        return (g[:, None, :] >> jnp.arange(t, dtype=jnp.uint32)[None, :, None]
+                & 1).astype(bool)
+
     x3 = mask
     a = eg_adv(x3)
     b = eg_packed(x3)
-    assert bool(jnp.all(a == b))
+    c = eg_rows_pick(x3)
+    assert bool(jnp.all(a == b)) and bool(jnp.all(a == c))
     scan_time(eg_adv, (a, x3), "edge_gather: advanced-index [N,T,K]")
     scan_time(eg_packed, (a, x3), "edge_gather: T-packed u32 [N,K]")
+    scan_time(eg_rows_pick, (a, x3), "edge_gather: row-gather + lane pick")
 
     # ---------- neighbor message gather ----------
     nbr_t = nbr.T                                           # [K, N]
