@@ -564,6 +564,56 @@ class TestRelayRefcounting:
         assert "t" not in r.rt.mesh          # last cancel leaves the topic
 
 
+class TestRandomsubMixed:
+    def test_mixed_floodsub_randomsub_delivers(self):
+        """TestRandomsubMixed: floodsub and randomsub nodes interoperate on
+        the same topic."""
+        net = Network()
+        nodes = []
+        for i in range(16):
+            h = net.add_host()
+            rt = RandomSubRouter(16) if i % 2 == 0 else FloodSubRouter()
+            nodes.append(PubSub(h, rt, sign_policy=LAX_NO_SIGN))
+        net.dense_connect([x.host for x in nodes], degree=10)
+        net.scheduler.run_for(0.1)
+        subs = [x.join("t").subscribe() for x in nodes]
+        net.scheduler.run_for(0.5)
+        for i in range(4):
+            nodes[i].my_topics["t"].publish(b"m%d" % i)
+            net.scheduler.run_for(0.5)
+        counts = [len(drain(s)) for s in subs]
+        assert min(counts) >= 3            # randomsub is probabilistic
+
+
+class TestAssortedOptions:
+    def test_many_options_compose(self):
+        """TestPubsubWithAssortedOptions-style smoke: several orthogonal
+        options wired at once still route."""
+        from go_libp2p_pubsub_tpu.utils.blacklist import MapBlacklist
+        from go_libp2p_pubsub_tpu.utils.timecache import Strategy
+        net = Network()
+        nodes = []
+        for i in range(2):
+            h = net.add_host()
+            nodes.append(PubSub(
+                h, GossipSubRouter(), sign_policy=LAX_NO_SIGN,
+                msg_id_fn=lambda m: (m.from_peer or "") + "|"
+                + (m.seqno or b"").hex(),
+                blacklist=MapBlacklist(),
+                seen_ttl=60.0, seen_strategy=Strategy.LAST_SEEN,
+                max_message_size=1 << 16,
+                rpc_inspector=lambda peer, rpc: True,
+                peer_filter=lambda pid, topic: True))
+        net.connect_all([x.host for x in nodes])
+        a, b = nodes
+        sub = b.join("t").subscribe()
+        a.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        a.my_topics["t"].publish(b"opts")
+        net.scheduler.run_for(1.0)
+        assert [m.data for m in drain(sub)] == [b"opts"]
+
+
 class TestSubscriptionMultiplicity:
     def test_subscribe_multiple_times_both_delivered(self):
         """TestSubscribeMultipleTimes (pubsub_test.go): two subscriptions on
