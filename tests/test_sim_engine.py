@@ -72,6 +72,50 @@ class TestMeshFormation:
         assert float(delivery_fraction(st, cfg)) == 1.0
 
 
+class TestFreeRunningCrossValidation:
+    def test_mesh_statistics_match_functional_runtime(self):
+        """SURVEY.md §7: free-running mode is validated statistically —
+        the batched sim and the per-node functional runtime, run on
+        same-sized networks with default parameters, must converge to the
+        same mesh-degree regime ([dlo, dhi], symmetric) and both deliver
+        every message."""
+        # functional runtime: 24 nodes, dense
+        from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+        from go_libp2p_pubsub_tpu.net import Network
+        from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+        fnet = Network()
+        fnodes = [PubSub(fnet.add_host(), GossipSubRouter(),
+                         sign_policy=LAX_NO_SIGN) for _ in range(24)]
+        fnet.dense_connect([x.host for x in fnodes], degree=10)
+        fsubs = [x.join("t").subscribe() for x in fnodes]
+        fnet.scheduler.run_for(6.0)
+        fnodes[0].my_topics["t"].publish(b"x")
+        fnet.scheduler.run_for(3.0)
+        fdegs = np.array([len(x.rt.mesh["t"]) for x in fnodes])
+        fdeliv = sum(1 for s in fsubs if any(True for _ in iter(s.next, None)))
+
+        # batched sim: same scale and degree budget
+        cfg = SimConfig(n_peers=24, k_slots=16, n_topics=1, msg_window=8,
+                        publishers_per_tick=1, prop_substeps=6,
+                        scoring_enabled=False)
+        topo = topology.dense(24, 16, degree=10)
+        st = init_state(cfg, topo)
+        st = run(st, cfg, TopicParams.disabled(1), jax.random.PRNGKey(0), 9)
+        sdegs = np.asarray(mesh_degrees(st))[:, 0]
+
+        from go_libp2p_pubsub_tpu.core.params import GossipSubParams
+        p = GossipSubParams()
+        for name, degs in (("functional", fdegs), ("sim", sdegs)):
+            assert degs.max() <= p.dhi, name
+            assert degs.min() >= 1, name
+        # same regime: mean degrees within 2 of each other, around D
+        assert abs(fdegs.mean() - sdegs.mean()) <= 2.0, (fdegs.mean(),
+                                                        sdegs.mean())
+        assert fdeliv == 24
+        from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction
+        assert float(delivery_fraction(st, cfg)) == 1.0
+
+
 class TestNbrSubscribedCache:
     def test_cache_stays_consistent_under_subscription_churn(self):
         """nbr_subscribed is a cached gather that every subscribed-mutation
