@@ -1,0 +1,44 @@
+"""Quickstart: the batched TPU simulation engine.
+
+The same gossipsub semantics vectorized over all peers: state is a pytree
+of arrays, one tick is a jitted function, a whole run is one lax.scan on
+device — and the peer axis shards across a jax.sharding.Mesh for
+multi-chip (see go_libp2p_pubsub_tpu/parallel/sharding.py).
+
+Run:  python examples/quickstart_sim.py          (single device)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from go_libp2p_pubsub_tpu.sim import (  # noqa: E402
+    SimConfig, TopicParams, init_state, topology)
+from go_libp2p_pubsub_tpu.sim.engine import (  # noqa: E402
+    delivery_fraction, mesh_degrees, run)
+
+
+def main():
+    cfg = SimConfig(
+        n_peers=4096, k_slots=32, n_topics=1, msg_window=64,
+        publishers_per_tick=8, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        gossip_threshold=-100.0, publish_threshold=-200.0,
+        graylist_threshold=-300.0)
+    tp = TopicParams.disabled(1)
+    topo = topology.sparse(cfg.n_peers, cfg.k_slots, degree=12, seed=42)
+    state = init_state(cfg, topo)
+
+    state = run(state, cfg, tp, jax.random.PRNGKey(0), 30)   # 30 heartbeats
+    deg = mesh_degrees(state)
+    print(f"{cfg.n_peers} peers, 30 ticks on {jax.devices()[0].platform}: "
+          f"delivery {float(delivery_fraction(state, cfg)):.4f}, "
+          f"mean mesh degree {float(deg.mean()):.2f}")
+    assert float(delivery_fraction(state, cfg)) > 0.99
+
+
+if __name__ == "__main__":
+    main()
