@@ -22,7 +22,8 @@ class FloodSubRouter:
         """``protocols`` is NewFloodsubWithProtocols (floodsub.go:29-38):
         a custom protocol list replacing the default floodsub id."""
         self.p: "PubSub | None" = None
-        self._protocols = list(protocols) if protocols else [FLOODSUB_ID]
+        self._protocols = list(protocols) if protocols is not None \
+            else [FLOODSUB_ID]
 
     def protocols(self) -> list[str]:
         return list(self._protocols)

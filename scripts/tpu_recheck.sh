@@ -12,5 +12,6 @@ for step in "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
   name="${step%%:*}"; cmd="${step#*:}"
   echo "== $name: $cmd =="
   timeout 1500 $cmd 2>&1 | grep -v WARNING | tee "/tmp/tpu_recheck/$name.log"
-  echo "== $name done (rc=$?) =="
+  rc=${PIPESTATUS[0]}
+  echo "== $name done (rc=$rc) =="
 done
