@@ -6,7 +6,9 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/tpu_recheck
-for step in "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
+for step in "microbench_beacon:python scripts/microbench_kernels.py 10000 9 48 64" \
+            "microbench_100k:python scripts/microbench_kernels.py 100000 1 32 64" \
+            "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
             "ablate_100k:python scripts/ablate.py 100k_sweep 5" \
             "bench:python bench.py"; do
   name="${step%%:*}"; cmd="${step#*:}"
