@@ -1,0 +1,32 @@
+"""The driver contract for bench.py: every result line is standalone JSON
+with metric/value/unit/vs_baseline keys, and the headline scenario prints
+LAST so a single-line parse of stdout picks it up."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_driver_parseable_json():
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               BENCH_SCENARIOS="1k_single_topic,headline",
+               BENCH_N="256", BENCH_TICKS="3")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=480, env=env, cwd=REPO)
+    assert res.returncode == 0, res.stderr[-500:]
+    lines = [ln for ln in res.stdout.splitlines() if ln.startswith("{")]
+    metrics = [json.loads(ln) for ln in lines]
+    metrics = [m for m in metrics if "metric" in m]
+    assert len(metrics) == 2
+    for m in metrics:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(m)
+        assert m["unit"] == "heartbeats/s"
+        assert m["value"] > 0, m
+    # headline (BENCH_N-peer default config) prints last
+    assert metrics[-1]["metric"].startswith("network_heartbeats_per_sec@0k_default") or \
+        metrics[-1]["metric"].startswith("network_heartbeats_per_sec@256")
