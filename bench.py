@@ -54,6 +54,7 @@ def bench_one(name, cfg, tp, st, ticks):
         "metric": f"network_heartbeats_per_sec@{name}[{platform}]",
         "value": round(hbps, 2),
         "unit": "heartbeats/s",
+        "platform": platform,
         "vs_baseline": round(hbps / TARGET_HBPS, 4),
         "delivery_fraction": round(float(delivery_fraction(st, cfg)), 4),
         "mean_delivery_latency_ticks": round(
@@ -103,18 +104,10 @@ def _label(name: str) -> str:
 def _probe_default_platform() -> bool:
     """True when the default JAX backend initializes and computes within a
     bounded time. The remote-TPU tunnel in this environment can wedge so
-    hard that even `import jax` blocks; benching on CPU then still yields
-    real numbers where waiting would yield only timeout zeros."""
-    try:
-        res = subprocess.run(
-            [sys.executable, "-c",
-             "import jax, jax.numpy as jnp; "
-             "print(float(jnp.ones((8, 8)).sum()))"],
-            capture_output=True, text=True,
-            timeout=int(os.environ.get("BENCH_PROBE_TIMEOUT", 180)))
-        return res.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    hard that waiting would yield only timeout zeros; benching on CPU then
+    still yields real numbers (tagged with platform=cpu)."""
+    from go_libp2p_pubsub_tpu.utils.platform_probe import probe_default_platform
+    return probe_default_platform()[0]
 
 
 def main() -> None:
@@ -125,10 +118,12 @@ def main() -> None:
             run_scenario(name)
         return
     def cpu_fallback_env():
+        from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
         # CPU is far slower per tick at 100k; keep the measured window
         # short so scenarios fit the per-scenario timeout
-        return {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
-                "BENCH_TICKS": os.environ.get("BENCH_TICKS", "10")}
+        env = cpu_mesh_env({})
+        env["BENCH_TICKS"] = os.environ.get("BENCH_TICKS", "10")
+        return env
 
     fallback_env = {}
     if os.environ.get("JAX_PLATFORMS") == "cpu":

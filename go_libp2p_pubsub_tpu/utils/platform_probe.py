@@ -1,0 +1,65 @@
+"""Wedge-proof default-platform probing, shared by bench.py and
+__graft_entry__.py.
+
+The remote-TPU ("axon") plugin in this environment can wedge backend
+initialization so hard that any in-process ``jax.devices()`` or jit call
+blocks forever — and jax initializes every registered backend together, so
+probe ordering cannot dodge it. The only safe probe is a bounded-timeout
+subprocess; the only safe fallback is a child process whose environment
+disables the plugin and forces the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+
+def probe_default_platform(timeout: int | None = None) -> tuple[bool, int]:
+    """(alive, n_devices) of the DEFAULT jax backend, measured in a
+    bounded-timeout subprocess so a wedged platform plugin costs a timeout,
+    not a hang."""
+    timeout = timeout if timeout is not None else int(
+        os.environ.get("GRAFT_PROBE_TIMEOUT",
+                       os.environ.get("BENCH_PROBE_TIMEOUT", 180)))
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "assert float(jnp.ones((8, 8)).sum()) == 64.0; "
+             "print('NDEV', len(jax.devices()))"],
+            capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, 0
+    if res.returncode != 0:
+        return False, 0
+    for line in res.stdout.splitlines():
+        if line.startswith("NDEV "):
+            return True, int(line.split()[1])
+    return False, 0
+
+
+def cpu_mesh_env(env: dict, n_devices: int | None = None) -> dict:
+    """A child env forcing the CPU platform with the axon TPU plugin
+    disabled (it can wedge backend init even under JAX_PLATFORMS=cpu unless
+    its pool address list is cleared). With ``n_devices``, also force an
+    n-device virtual CPU mesh."""
+    env = dict(env)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    if n_devices is not None:
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "") +
+            f" --xla_force_host_platform_device_count={n_devices}")
+    return env
+
+
+def forced_cpu_device_count(env: dict | None = None) -> int:
+    """The virtual CPU device count a JAX_PLATFORMS=cpu process will see,
+    parsed from XLA_FLAGS (last flag wins, matching XLA), default 1."""
+    env = env if env is not None else os.environ
+    hits = re.findall(r"--xla_force_host_platform_device_count=(\d+)",
+                      env.get("XLA_FLAGS", ""))
+    return int(hits[-1]) if hits else 1
