@@ -109,7 +109,8 @@ struct Payload {
 //   duplicateMessage: 1 mid, 2 peer, 3 topic
 //   deliverMessage: 1 mid, 2 topic, 3 peer
 //   addPeer: 1 peer, 2 proto ; removePeer: 1 peer
-//   join/leave: 1 topic ; graft/prune: 1 peer, 2 topic
+//   join: 1 topic ; leave: 2 topic (the proto's one oddity, trace.proto:94)
+//   graft/prune: 1 peer, 2 topic
 bool parse_payload(int ev_type, Slice s, Payload* out_p) {
   Payload& out = *out_p;
   return walk_fields(s.p, s.len, [&](uint32_t f, uint32_t w, uint64_t, Slice v) {
@@ -135,8 +136,10 @@ bool parse_payload(int ev_type, Slice s, Payload* out_p) {
         if (f == 1) out.peer = v;
         break;
       case EV_JOIN:
-      case EV_LEAVE:
         if (f == 1) out.topic = v;
+        break;
+      case EV_LEAVE:
+        if (f == 2) out.topic = v;
         break;
       case EV_GRAFT:
       case EV_PRUNE:

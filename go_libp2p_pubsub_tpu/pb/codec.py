@@ -331,6 +331,9 @@ _PAYLOAD_FIELDS = {
 }
 
 # sub-message schemas: payload key -> list of (field_no, kind, dict key)
+# NOTE Leave.topic is field 2, not 1 (pb/trace.proto:94 — the only payload
+# whose first field number is not 1; verified against trace.pb.go's
+# TraceEvent_Leave.MarshalToSizedBuffer tag byte 0x12).
 _PAYLOAD_SCHEMAS: dict[str, list[tuple[int, str, str]]] = {
     "publishMessage": [(1, "mid", "messageID"), (2, "str", "topic")],
     "rejectMessage": [(1, "mid", "messageID"), (2, "peer", "receivedFrom"),
@@ -341,11 +344,11 @@ _PAYLOAD_SCHEMAS: dict[str, list[tuple[int, str, str]]] = {
                        (3, "peer", "receivedFrom")],
     "addPeer": [(1, "peer", "peerID"), (2, "str", "proto")],
     "removePeer": [(1, "peer", "peerID")],
-    "recvRPC": [(1, "peer", "receivedFrom")],
-    "sendRPC": [(1, "peer", "sendTo")],
-    "dropRPC": [(1, "peer", "sendTo")],
+    "recvRPC": [(1, "peer", "receivedFrom"), (2, "meta", "meta")],
+    "sendRPC": [(1, "peer", "sendTo"), (2, "meta", "meta")],
+    "dropRPC": [(1, "peer", "sendTo"), (2, "meta", "meta")],
     "join": [(1, "str", "topic")],
-    "leave": [(1, "str", "topic")],
+    "leave": [(2, "str", "topic")],
     "graft": [(1, "peer", "peerID"), (2, "str", "topic")],
     "prune": [(1, "peer", "peerID"), (2, "str", "topic")],
 }
@@ -359,6 +362,115 @@ _TYPE_TO_PAYLOAD_KEY = {
 }
 
 
+def _peer_field(field: int, s: str) -> bytes:
+    # peer ids are raw multihash bytes surviving in str via surrogateescape
+    return _bytes_field(field, s.encode("utf-8", "surrogateescape"))
+
+
+def _encode_rpc_meta(meta: dict) -> bytes:
+    """TraceEvent.RPCMeta (pb/trace.proto:106-110), dict shape as produced by
+    trace/bus.py's _rpc_meta: messages / subscription / control."""
+    out = bytearray()
+    for mm in meta.get("messages", ()):
+        body = bytearray()
+        if mm.get("messageID") is not None:
+            body += _mid_field(1, mm["messageID"])
+        if mm.get("topic") is not None:
+            body += _str_field(2, mm["topic"])
+        out += _bytes_field(1, bytes(body))
+    for sm in meta.get("subscription", ()):
+        body = bytearray()
+        if sm.get("subscribe") is not None:
+            body += _varint_field(1, 1 if sm["subscribe"] else 0)
+        if sm.get("topic") is not None:
+            body += _str_field(2, sm["topic"])
+        out += _bytes_field(2, bytes(body))
+    ctl = meta.get("control")
+    if ctl is not None:
+        body = bytearray()
+        for ih in ctl.get("ihave", ()):
+            b2 = bytearray()
+            if ih.get("topic") is not None:
+                b2 += _str_field(1, ih["topic"])
+            for mid in ih.get("messageIDs", ()):
+                b2 += _mid_field(2, mid)
+            body += _bytes_field(1, bytes(b2))
+        for iw in ctl.get("iwant", ()):
+            b2 = bytearray()
+            for mid in iw.get("messageIDs", ()):
+                b2 += _mid_field(1, mid)
+            body += _bytes_field(2, bytes(b2))
+        for g in ctl.get("graft", ()):
+            b2 = _str_field(1, g["topic"]) if g.get("topic") is not None else b""
+            body += _bytes_field(3, bytes(b2))
+        for p in ctl.get("prune", ()):
+            b2 = bytearray()
+            if p.get("topic") is not None:
+                b2 += _str_field(1, p["topic"])
+            for pid in p.get("peers", ()):
+                b2 += _peer_field(2, pid)
+            body += _bytes_field(4, bytes(b2))
+        out += _bytes_field(3, bytes(body))
+    return bytes(out)
+
+
+def _decode_rpc_meta(buf: bytes) -> dict:
+    meta: dict = {}
+    for field, _, val in _iter_fields(buf):
+        if field == 1:
+            mm: dict = {}
+            for f2, _, v2 in _iter_fields(val):
+                if f2 == 1:
+                    mm["messageID"] = v2.decode("latin-1")
+                elif f2 == 2:
+                    mm["topic"] = v2.decode("utf-8")
+            meta.setdefault("messages", []).append(mm)
+        elif field == 2:
+            sm: dict = {}
+            for f2, _, v2 in _iter_fields(val):
+                if f2 == 1:
+                    sm["subscribe"] = bool(v2)
+                elif f2 == 2:
+                    sm["topic"] = v2.decode("utf-8")
+            meta.setdefault("subscription", []).append(sm)
+        elif field == 3:
+            ctl: dict = {}
+            for f2, _, v2 in _iter_fields(val):
+                if f2 == 1:
+                    ih: dict = {}
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            ih["topic"] = v3.decode("utf-8")
+                        elif f3 == 2:
+                            ih.setdefault("messageIDs", []).append(
+                                v3.decode("latin-1"))
+                    ctl.setdefault("ihave", []).append(ih)
+                elif f2 == 2:
+                    iw: dict = {}
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            iw.setdefault("messageIDs", []).append(
+                                v3.decode("latin-1"))
+                    ctl.setdefault("iwant", []).append(iw)
+                elif f2 == 3:
+                    g: dict = {}
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            g["topic"] = v3.decode("utf-8")
+                    ctl.setdefault("graft", []).append(g)
+                elif f2 == 4:
+                    p: dict = {}
+                    for f3, _, v3 in _iter_fields(v2):
+                        if f3 == 1:
+                            p["topic"] = v3.decode("utf-8")
+                        elif f3 == 2:
+                            p.setdefault("peers", []).append(
+                                v3.decode("utf-8", "surrogateescape"))
+                    ctl.setdefault("prune", []).append(p)
+            meta["control"] = ctl
+    return meta
+
+
 def _encode_payload(key: str, payload: dict) -> bytes:
     out = bytearray()
     for field, kind, name in _PAYLOAD_SCHEMAS[key]:
@@ -368,7 +480,9 @@ def _encode_payload(key: str, payload: dict) -> bytes:
         if kind == "mid":
             out += _mid_field(field, v)
         elif kind == "peer":
-            out += _bytes_field(field, v.encode("utf-8"))
+            out += _peer_field(field, v)
+        elif kind == "meta":
+            out += _bytes_field(field, _encode_rpc_meta(v))
         else:
             out += _str_field(field, v)
     return bytes(out)
@@ -385,6 +499,8 @@ def _decode_payload(key: str, buf: bytes) -> dict:
             out[name] = val.decode("latin-1")
         elif kind == "peer":
             out[name] = val.decode("utf-8", "surrogateescape")
+        elif kind == "meta":
+            out[name] = _decode_rpc_meta(val)
         else:
             out[name] = val.decode("utf-8")
     return out
@@ -394,20 +510,24 @@ def encode_trace_event(evt: dict) -> bytes:
     """Encode a tracer-bus event dict (trace/bus.py shape) to TraceEvent bytes.
 
     Timestamps are virtual-clock seconds scaled to int64 nanoseconds, matching
-    the reference's UnixNano timestamps (trace.go:90)."""
+    the reference's UnixNano timestamps (trace.go:90); an integer
+    ``timestamp_ns`` takes precedence so real UnixNano values (> 2**53, not
+    exactly representable as float seconds) round-trip bit-exactly."""
     typ = evt["type"]
     out = bytearray()
     out += _varint_field(1, TRACE_TYPES[typ])
     if "peerID" in evt:
-        out += _bytes_field(2, evt["peerID"].encode("utf-8"))
-    if "timestamp" in evt:
+        out += _peer_field(2, evt["peerID"])
+    if "timestamp_ns" in evt:
+        out += _varint_field(3, int(evt["timestamp_ns"]))
+    elif "timestamp" in evt:
         out += _varint_field(3, int(evt["timestamp"] * 1e9))
     key = _TYPE_TO_PAYLOAD_KEY[typ]
     payload = evt.get(key)
     if payload is None:
-        # RPC events carry their peer at the top level of the bus dict
+        # RPC events carry their peer + meta at the top level of the bus dict
         payload = {k: v for k, v in evt.items()
-                   if k in ("receivedFrom", "sendTo")}
+                   if k in ("receivedFrom", "sendTo", "meta")}
     if payload:
         out += _bytes_field(_PAYLOAD_FIELDS[typ], _encode_payload(key, payload))
     return bytes(out)
@@ -423,6 +543,7 @@ def decode_trace_event(buf: bytes) -> dict:
             evt["peerID"] = val.decode("utf-8", "surrogateescape")
         elif field == 3:
             evt["timestamp"] = val / 1e9
+            evt["timestamp_ns"] = val
         elif field in payload_field_to_type:
             typ = payload_field_to_type[field]
             evt[_TYPE_TO_PAYLOAD_KEY[typ]] = _decode_payload(

@@ -1,0 +1,349 @@
+"""Golden-trace differential gate: the repo's TraceEvent codec and replay
+against a fixture assembled to the REFERENCE's wire encoding, not the repo's.
+
+The byte stream below is hand-assembled by a mini-marshaller whose tag bytes
+and field ordering are copied literally from the reference's generated
+encoder (`/root/reference/pb/trace.pb.go` MarshalToSizedBuffer functions —
+gogo-proto writes fields back-to-front, yielding ascending field order with
+minimal varints; schema `/root/reference/pb/trace.proto:5-150`). It shares
+no code with `pb/codec.py`, so a wire-layout divergence in either the
+encoder or the decoder fails these tests — this closed VERDICT r2 "Missing
+#1" (the previous differential loop only consumed repo-produced traces, and
+indeed the repo encoded Leave.topic as field 1 where the reference uses
+field 2, trace.pb.go TraceEvent_Leave tag byte 0x12).
+
+Checks:
+  1. decoding the golden bytes yields the expected event dicts;
+  2. re-encoding those dicts via pb/codec.py is BYTE-EXACT to the fixture
+     (realistic UnixNano timestamps > 2**53 exercise the timestamp_ns path);
+  3. the decoded stream replays through trace/replay.py with the mesh /
+     score / delivery semantics the reference's tracer hooks imply
+     (trace.go:70-531, score.go:899-981);
+  4. the native C++ tensorizer consumes the same bytes to the same feed as
+     the Python tensorizer (catches native/Python schema drift — the Leave
+     field bug existed in both).
+"""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.pb import codec
+from go_libp2p_pubsub_tpu.sim import SimConfig, init_state, topology
+from go_libp2p_pubsub_tpu.core.params import TopicScoreParams
+from go_libp2p_pubsub_tpu.trace import native as trace_native
+from go_libp2p_pubsub_tpu.trace import (
+    replay_feed,
+    replay_topic_params,
+    tensorize_trace,
+)
+
+# --- mini gogo-proto marshaller (tag bytes from trace.pb.go, see docstring) —
+# deliberately NOT pb/codec.py ---
+
+
+def _uv(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _ld(tag: bytes, payload: bytes) -> bytes:
+    # length-delimited field: literal tag byte(s) + varint length + payload
+    return tag + _uv(len(payload)) + payload
+
+
+def _publish(mid: bytes, topic: str) -> bytes:
+    # TraceEvent_PublishMessage: messageID 0xa, topic 0x12
+    return _ld(b"\x0a", mid) + _ld(b"\x12", topic.encode())
+
+
+def _reject(mid: bytes, frm: bytes, reason: str, topic: str) -> bytes:
+    # RejectMessage: messageID 0xa, receivedFrom 0x12, reason 0x1a, topic 0x22
+    return (_ld(b"\x0a", mid) + _ld(b"\x12", frm) +
+            _ld(b"\x1a", reason.encode()) + _ld(b"\x22", topic.encode()))
+
+
+def _duplicate(mid: bytes, frm: bytes, topic: str) -> bytes:
+    # DuplicateMessage: messageID 0xa, receivedFrom 0x12, topic 0x1a
+    return _ld(b"\x0a", mid) + _ld(b"\x12", frm) + _ld(b"\x1a", topic.encode())
+
+
+def _deliver(mid: bytes, topic: str, frm: bytes) -> bytes:
+    # DeliverMessage: messageID 0xa, topic 0x12, receivedFrom 0x1a
+    return _ld(b"\x0a", mid) + _ld(b"\x12", topic.encode()) + _ld(b"\x1a", frm)
+
+
+def _add_peer(pid: bytes, proto: str) -> bytes:
+    # AddPeer: peerID 0xa, proto 0x12
+    return _ld(b"\x0a", pid) + _ld(b"\x12", proto.encode())
+
+
+def _remove_peer(pid: bytes) -> bytes:
+    return _ld(b"\x0a", pid)                      # RemovePeer: peerID 0xa
+
+
+def _rpc(peer: bytes, meta: bytes) -> bytes:
+    # RecvRPC/SendRPC/DropRPC: receivedFrom|sendTo 0xa, meta 0x12
+    return _ld(b"\x0a", peer) + _ld(b"\x12", meta)
+
+
+def _join(topic: str) -> bytes:
+    return _ld(b"\x0a", topic.encode())           # Join: topic 0xa
+
+
+def _leave(topic: str) -> bytes:
+    # Leave: topic is FIELD 2 — tag 0x12 (trace.pb.go TraceEvent_Leave)
+    return _ld(b"\x12", topic.encode())
+
+
+def _graft_or_prune(pid: bytes, topic: str) -> bytes:
+    # Graft/Prune: peerID 0xa, topic 0x12
+    return _ld(b"\x0a", pid) + _ld(b"\x12", topic.encode())
+
+
+def _meta(messages=(), subscription=(), control=None) -> bytes:
+    # RPCMeta: messages 0xa, subscription 0x12, control 0x1a
+    out = bytearray()
+    for mid, topic in messages:
+        out += _ld(b"\x0a", _ld(b"\x0a", mid) + _ld(b"\x12", topic.encode()))
+    for subscribe, topic in subscription:
+        out += _ld(b"\x12", b"\x08" + _uv(1 if subscribe else 0) +
+                   _ld(b"\x12", topic.encode()))
+    if control is not None:
+        out += _ld(b"\x1a", control)
+    return bytes(out)
+
+
+def _control(ihave=(), iwant=(), graft=(), prune=()) -> bytes:
+    # ControlMeta: ihave 0xa, iwant 0x12, graft 0x1a, prune 0x22
+    out = bytearray()
+    for topic, mids in ihave:                     # IHaveMeta: topic 0xa, mids 0x12
+        body = _ld(b"\x0a", topic.encode())
+        for m in mids:
+            body += _ld(b"\x12", m)
+        out += _ld(b"\x0a", body)
+    for mids in iwant:                            # IWantMeta: mids 0xa
+        body = b"".join(_ld(b"\x0a", m) for m in mids)
+        out += _ld(b"\x12", body)
+    for topic in graft:                           # GraftMeta: topic 0xa
+        out += _ld(b"\x1a", _ld(b"\x0a", topic.encode()))
+    for topic, peers in prune:                    # PruneMeta: topic 0xa, peers 0x12
+        body = _ld(b"\x0a", topic.encode())
+        for p in peers:
+            body += _ld(b"\x12", p)
+        out += _ld(b"\x22", body)
+    return bytes(out)
+
+
+_PAYLOAD_TAGS = {  # TraceEvent payload fields 4..16 (trace.pb.go:1603-1776)
+    "PUBLISH_MESSAGE": b"\x22", "REJECT_MESSAGE": b"\x2a",
+    "DUPLICATE_MESSAGE": b"\x32", "DELIVER_MESSAGE": b"\x3a",
+    "ADD_PEER": b"\x42", "REMOVE_PEER": b"\x4a", "RECV_RPC": b"\x52",
+    "SEND_RPC": b"\x5a", "DROP_RPC": b"\x62", "JOIN": b"\x6a",
+    "LEAVE": b"\x72", "GRAFT": b"\x7a", "PRUNE": b"\x82\x01",
+}
+
+
+def _event(typ: str, observer: bytes, ts_ns: int, payload: bytes) -> bytes:
+    # TraceEvent: type 0x08 varint, peerID 0x12, timestamp 0x18 varint
+    body = (b"\x08" + _uv(codec.TRACE_TYPES[typ]) + _ld(b"\x12", observer) +
+            b"\x18" + _uv(ts_ns) + _ld(_PAYLOAD_TAGS[typ], payload))
+    return _uv(len(body)) + body                  # uvarint-delimited framing
+
+
+# --- the fixture: a 2-peer session touching all 13 event types ---
+
+PEER_A = bytes([0x12, 0x20]) + bytes(range(0xA0, 0xC0))  # raw sha256 multihash
+PEER_B = bytes([0x12, 0x20]) + bytes(range(0x60, 0x80))
+A = PEER_A.decode("utf-8", "surrogateescape")
+B = PEER_B.decode("utf-8", "surrogateescape")
+MID1, MID2 = b"\x01\x02\x03\x04", b"\xff\xfe\xfd\xfc"
+TOPIC = "test-topic"
+PROTO = "/meshsub/1.1.0"
+T0_NS = 1_785_000_000_000_000_000   # ~2026 UnixNano, NOT float-representable
+
+
+def _ts(k: int) -> int:
+    return T0_NS + k * 250_000_000  # quarter-second steps
+
+
+def build_golden(t0_ns: int = T0_NS) -> bytes:
+    def ts(k):
+        return t0_ns + k * 250_000_000
+
+    full_meta = _meta(
+        subscription=[(True, TOPIC)],
+        control=_control(graft=[TOPIC]))
+    return b"".join([
+        _event("ADD_PEER", PEER_A, ts(0), _add_peer(PEER_B, PROTO)),
+        _event("ADD_PEER", PEER_B, ts(1), _add_peer(PEER_A, PROTO)),
+        _event("JOIN", PEER_A, ts(2), _join(TOPIC)),
+        _event("JOIN", PEER_B, ts(3), _join(TOPIC)),
+        _event("GRAFT", PEER_A, ts(4), _graft_or_prune(PEER_B, TOPIC)),
+        _event("SEND_RPC", PEER_A, ts(5), _rpc(PEER_B, full_meta)),
+        _event("RECV_RPC", PEER_B, ts(6), _rpc(PEER_A, full_meta)),
+        _event("GRAFT", PEER_B, ts(7), _graft_or_prune(PEER_A, TOPIC)),
+        _event("PUBLISH_MESSAGE", PEER_A, ts(8), _publish(MID1, TOPIC)),
+        _event("SEND_RPC", PEER_A, ts(8), _rpc(PEER_B, _meta(
+            messages=[(MID1, TOPIC)],
+            control=_control(ihave=[(TOPIC, [MID1])])))),
+        _event("DELIVER_MESSAGE", PEER_B, ts(9), _deliver(MID1, TOPIC, PEER_A)),
+        _event("DUPLICATE_MESSAGE", PEER_B, ts(9), _duplicate(MID1, PEER_A, TOPIC)),
+        _event("REJECT_MESSAGE", PEER_B, ts(11),
+               _reject(MID2, PEER_A, "invalid signature", TOPIC)),
+        _event("DROP_RPC", PEER_A, ts(12), _rpc(PEER_B, _meta(
+            control=_control(iwant=[[MID1]],
+                             prune=[(TOPIC, [PEER_B])])))),
+        _event("PRUNE", PEER_A, ts(13), _graft_or_prune(PEER_B, TOPIC)),
+        _event("LEAVE", PEER_B, ts(14), _leave(TOPIC)),
+        _event("REMOVE_PEER", PEER_A, ts(15), _remove_peer(PEER_B)),
+    ])
+
+
+GOLDEN = build_golden()
+
+_FULL_META = {
+    "subscription": [{"subscribe": True, "topic": TOPIC}],
+    "control": {"graft": [{"topic": TOPIC}]},
+}
+_M1, _M2 = MID1.decode("latin-1"), MID2.decode("latin-1")
+
+
+def _exp(typ, obs, k, **payload):
+    ns = _ts(k)
+    return {"type": typ, "peerID": obs, "timestamp": ns / 1e9,
+            "timestamp_ns": ns, **payload}
+
+
+EXPECTED = [
+    _exp("ADD_PEER", A, 0, addPeer={"peerID": B, "proto": PROTO}),
+    _exp("ADD_PEER", B, 1, addPeer={"peerID": A, "proto": PROTO}),
+    _exp("JOIN", A, 2, join={"topic": TOPIC}),
+    _exp("JOIN", B, 3, join={"topic": TOPIC}),
+    _exp("GRAFT", A, 4, graft={"peerID": B, "topic": TOPIC}),
+    _exp("SEND_RPC", A, 5, sendRPC={"sendTo": B, "meta": _FULL_META}),
+    _exp("RECV_RPC", B, 6, recvRPC={"receivedFrom": A, "meta": _FULL_META}),
+    _exp("GRAFT", B, 7, graft={"peerID": A, "topic": TOPIC}),
+    _exp("PUBLISH_MESSAGE", A, 8,
+         publishMessage={"messageID": _M1, "topic": TOPIC}),
+    _exp("SEND_RPC", A, 8, sendRPC={"sendTo": B, "meta": {
+        "messages": [{"messageID": _M1, "topic": TOPIC}],
+        "control": {"ihave": [{"topic": TOPIC, "messageIDs": [_M1]}]}}}),
+    _exp("DELIVER_MESSAGE", B, 9, deliverMessage={
+        "messageID": _M1, "topic": TOPIC, "receivedFrom": A}),
+    _exp("DUPLICATE_MESSAGE", B, 9, duplicateMessage={
+        "messageID": _M1, "receivedFrom": A, "topic": TOPIC}),
+    _exp("REJECT_MESSAGE", B, 11, rejectMessage={
+        "messageID": _M2, "receivedFrom": A, "reason": "invalid signature",
+        "topic": TOPIC}),
+    _exp("DROP_RPC", A, 12, dropRPC={"sendTo": B, "meta": {
+        "control": {"iwant": [{"messageIDs": [_M1]}],
+                    "prune": [{"topic": TOPIC, "peers": [B]}]}}}),
+    _exp("PRUNE", A, 13, prune={"peerID": B, "topic": TOPIC}),
+    _exp("LEAVE", B, 14, leave={"topic": TOPIC}),
+    _exp("REMOVE_PEER", A, 15, removePeer={"peerID": B}),
+]
+
+
+class TestGoldenWire:
+    def test_decode_golden(self):
+        assert codec.decode_trace_bytes(GOLDEN) == EXPECTED
+
+    def test_encode_byte_exact(self):
+        """pb/codec.py must reproduce the reference encoder's exact bytes."""
+        enc = b"".join(
+            codec.write_uvarint(len(e)) + e
+            for e in (codec.encode_trace_event(evt) for evt in EXPECTED))
+        assert enc == GOLDEN
+
+    def test_every_event_type_covered(self):
+        assert {e["type"] for e in EXPECTED} == set(codec.TRACE_TYPES)
+
+    def test_realistic_timestamps_not_float_exact(self):
+        """The fixture must exercise the timestamp_ns path: UnixNano values
+        this large do not survive a float-seconds round-trip."""
+        assert int((_ts(1) / 1e9) * 1e9) != _ts(1)
+
+
+# --- replay the decoded golden stream into the batched engine ---
+
+
+def _replay_setup():
+    # timestamps rebased to small values: replay decay boundaries are
+    # absolute multiples of decay_interval (trace/replay.py:136)
+    events = codec.decode_trace_bytes(build_golden(t0_ns=250_000_000))
+    peer_index = {A: 0, B: 1}
+    topic_index = {TOPIC: 0}
+    feed = tensorize_trace(events, peer_index, topic_index,
+                              msg_window=16, decay_interval=1.0, t_end=5.0)
+    cfg = SimConfig(n_peers=2, k_slots=4, n_topics=1, msg_window=16,
+                    scoring_enabled=True)
+    topo = topology.full(2, 4)   # slot 0 of each peer is the other peer
+    st = init_state(cfg, topo, subscribed=np.zeros((2, 1), bool))
+    tp = replay_topic_params([TopicScoreParams(
+        topic_weight=1.0, time_in_mesh_weight=0.05, time_in_mesh_quantum=1.0,
+        time_in_mesh_cap=100.0, first_message_deliveries_weight=1.0,
+        first_message_deliveries_decay=0.9, first_message_deliveries_cap=50.0,
+        mesh_message_deliveries_weight=-0.5, mesh_message_deliveries_decay=0.8,
+        mesh_message_deliveries_cap=30.0, mesh_message_deliveries_threshold=3.0,
+        mesh_message_deliveries_window=0.05,
+        mesh_message_deliveries_activation=4.0,
+        mesh_failure_penalty_weight=-1.0, mesh_failure_penalty_decay=0.7,
+        invalid_message_deliveries_weight=-5.0,
+        invalid_message_deliveries_decay=0.9)])
+    st = replay_feed(st, cfg, tp, feed)
+    return st, feed, events
+
+
+@pytest.fixture(scope="module")
+def golden_replay():
+    return _replay_setup()
+
+
+class TestGoldenReplay:
+    def test_mesh_final_state(self, golden_replay):
+        st, _, _ = golden_replay
+        mesh = np.asarray(st.mesh_active)
+        # A grafted B then pruned; B grafted A then left the topic
+        assert not mesh.any()
+
+    def test_first_delivery_credited(self, golden_replay):
+        st, _, _ = golden_replay
+        fmd = np.asarray(st.first_message_deliveries)
+        # B's slot-0 neighbor is A: DELIVER(mid1 from A) -> P2 credit at B
+        assert fmd[1, 0, 0] > 0.0
+        # A received nothing
+        assert fmd[0].sum() == 0.0
+
+    def test_invalid_delivery_credited(self, golden_replay):
+        st, _, _ = golden_replay
+        inv = np.asarray(st.invalid_message_deliveries)
+        # REJECT(mid2 from A, "invalid signature") -> P4 debit at B for A
+        assert inv[1, 0, 0] > 0.0
+
+    def test_subscription_final_state(self, golden_replay):
+        st, _, _ = golden_replay
+        sub = np.asarray(st.subscribed)
+        assert sub[0, 0] and not sub[1, 0]   # A joined; B joined then left
+
+
+class TestGoldenNativeParity:
+    def test_native_tensorizer_matches_python(self):
+        if not trace_native.available():
+            pytest.skip("no native toolchain")
+        data = build_golden(t0_ns=250_000_000)
+        events = codec.decode_trace_bytes(data)
+        peer_index = {A: 0, B: 1}
+        kw = dict(msg_window=16, decay_interval=1.0, t_end=5.0)
+        py = tensorize_trace(events, peer_index, {TOPIC: 0}, **kw)
+        nat = trace_native.tensorize_bytes(data, peer_index, {TOPIC: 0}, **kw)
+        assert nat is not None
+        for name in ("op", "a", "b", "c"):
+            np.testing.assert_array_equal(
+                getattr(py, name), getattr(nat, name), err_msg=name)
