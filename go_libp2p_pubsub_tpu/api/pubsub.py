@@ -67,6 +67,17 @@ class PubSub:
             self.sign_policy &= ~SignPolicy.MSG_SIGNING
         if self.sign_policy.must_sign and sign_key is None:
             raise ValueError(f"can't sign for peer {self.pid}: no private key")
+        if sign_key is not None and host.local_record is None:
+            # publish a sealed self-record so peers can vouch for us over PX
+            # (the identify/peerstore flow feeding cab.GetPeerRecord,
+            # gossipsub.go:1885-1893); only a self-certifying id can seal a
+            # record that validates, so skip when signing as someone else
+            from .peer_record import PeerRecord, seal_record
+            from .sign import peer_id_from_key
+            if peer_id_from_key(sign_key.public_key()) == self.pid:
+                host.local_record = seal_record(
+                    PeerRecord(peer_id=self.pid, seq=1, addrs=(host.addr,)),
+                    sign_key)
 
         self.id_gen = MsgIdGenerator()
         if msg_id_fn is not None:

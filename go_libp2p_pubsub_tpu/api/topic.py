@@ -145,6 +145,12 @@ class Topic:
             raise RuntimeError("cannot close topic with pending gated publishes")
         self._closed = True
         self.p.my_topics.pop(self.name, None)
+        # drop any per-topic msg-id fn so a later join(topic) starts from
+        # the default instead of silently inheriting the closed handle's
+        # custom fn (the reference never deletes, midgen.go — an explicit
+        # divergence: join() insists the fn be set on first join, so
+        # surviving close would contradict that contract)
+        self.p.id_gen._topic_gens.pop(self.name, None)
 
     # -- events --
 

@@ -125,7 +125,10 @@ struct Scanner {
         seqno = 0;
         for (uint64_t i = 0; i < f.len && i < 8; i++)
           seqno = (seqno << 8) | f.p[i];
-      } else if (f.num == 4) {
+      } else if (f.num == 4 && f.len > 0) {
+        // empty topic stays -1: the Python twin decodes proto2 absent and
+        // present-but-empty to the same "" and interns neither, so the
+        // native path must not invent a topic id for it
         topic_id = intern(f.p, f.len);
       }
     }

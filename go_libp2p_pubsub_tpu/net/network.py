@@ -100,6 +100,11 @@ class Host:
         self._inflight: dict[PeerID, int] = {}
         self.outbound_queue_size = DEFAULT_PEER_OUTBOUND_QUEUE_SIZE
         self.dropped_rpcs = 0
+        # certified-addr-book analogue (peerstore.GetCertifiedAddrBook):
+        # this host's own sealed record + validated records learned from
+        # peers (identify exchange on connect, ConsumePeerRecord after PX)
+        self.local_record: bytes | None = None
+        self.certified_records: dict[PeerID, bytes] = {}
         from .connmgr import ConnManager
         self.conn_manager = ConnManager(network.scheduler)
 
@@ -171,6 +176,11 @@ class Host:
             return False
         self.conns[other.peer_id] = "outbound"
         other.conns[self.peer_id] = "inbound"
+        # identify exchange: each side learns the other's signed record
+        if other.local_record is not None:
+            self.certified_records[other.peer_id] = other.local_record
+        if self.local_record is not None:
+            other.certified_records[self.peer_id] = self.local_record
         if proto_out is not None:
             self.protocols[other.peer_id] = proto_out
             other.protocols[self.peer_id] = proto_in

@@ -566,3 +566,17 @@ def read_trace_file(path: str) -> list[dict]:
     """Read a PBTracer output file (uvarint-delimited TraceEvents)."""
     with open(path, "rb") as f:
         return decode_trace_bytes(f.read())
+
+
+def encode_trace_event_batch(events: list[dict]) -> bytes:
+    """TraceEventBatch{batch=1 repeated TraceEvent} (pb/trace.proto:148-150),
+    the RemoteTracer wire unit (tracer.go:239)."""
+    out = bytearray()
+    for e in events:
+        out += _bytes_field(1, encode_trace_event(e))
+    return bytes(out)
+
+
+def decode_trace_event_batch(buf: bytes) -> list[dict]:
+    return [decode_trace_event(val)
+            for field, _, val in _iter_fields(buf) if field == 1]

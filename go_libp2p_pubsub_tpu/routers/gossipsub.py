@@ -9,7 +9,9 @@ scheduled connect) with node-seeded RNG instead of Go's global shuffles.
 
 from __future__ import annotations
 
+import logging
 import random
+import time
 from typing import TYPE_CHECKING
 
 from ..core.params import GossipSubParams, PeerScoreParams, PeerScoreThresholds
@@ -84,7 +86,7 @@ class GossipSubRouter:
         self.score: PeerScore | None = None
         self.gossip_tracer: GossipPromiseTracker | None = None
         self.gate = gater
-        self.tag_tracer = None  # wired by attach when connmgr support lands
+        self.tag_tracer = None  # wired in attach (connmgr decaying tags)
         self.mcache = MessageCache(self.params.history_gossip,
                                    self.params.history_length)
         self.rng = random.Random(0)
@@ -325,8 +327,13 @@ class GossipSubRouter:
 
     def px_connect(self, peers: list[PeerInfo]) -> None:
         """gossipsub.go:893-943: dial up to PrunePeers learned peers, bounded
-        pending queue, via the scheduler (the connector goroutines)."""
+        pending queue, via the scheduler (the connector goroutines). A
+        PeerInfo carrying a signed record must validate — envelope signature
+        over the peer-record domain AND record id matching the announced id
+        — or the peer is skipped entirely (gossipsub.go:909-926)."""
         assert self.p is not None
+        from ..api.peer_record import RecordError, consume_peer_record
+
         if len(peers) > self.params.prune_peers:
             peers = list(peers)
             self.rng.shuffle(peers)
@@ -334,6 +341,13 @@ class GossipSubRouter:
         for pi in peers:
             if pi.peer_id in self.peers:
                 continue
+            if pi.signed_peer_record is not None:
+                try:
+                    rec = consume_peer_record(pi.signed_peer_record)
+                except RecordError:
+                    continue    # bogus envelope obtained through px
+                if rec.peer_id != pi.peer_id:
+                    continue    # record doesn't certify the announced peer
             if len(self._pending_connects) >= self.params.max_pending_connections:
                 break
             self._pending_connects.append(pi)
@@ -346,7 +360,12 @@ class GossipSubRouter:
         for pi in pending:
             other = self.p.host.network.hosts.get(pi.peer_id)
             if other is not None and pi.peer_id not in self.p.host.conns:
-                self.p.host.connect(other)
+                if self.p.host.connect(other) \
+                        and pi.signed_peer_record is not None:
+                    # validated in px_connect; persist like ConsumePeerRecord
+                    # only after the dial succeeds (gossipsub.go:954-958)
+                    self.p.host.certified_records[pi.peer_id] = \
+                        pi.signed_peer_record
 
     def _connect_direct(self) -> None:
         assert self.p is not None
@@ -507,7 +526,13 @@ class GossipSubRouter:
         if do_px:
             plst = self.get_peers(topic, self.params.prune_peers, lambda xp: (
                 xp != peer and self._score_of(xp) >= 0))
-            px = [PeerInfo(peer_id=p) for p in plst]
+            # attach the signed record when the certified store has one;
+            # otherwise just the id — unsigned PX addresses can't be
+            # trusted anyway (gossipsub.go:1885-1901)
+            px = [PeerInfo(peer_id=p,
+                           signed_peer_record=(
+                               self.p.host.certified_records.get(p)))
+                  for p in plst]
         return ControlPrune(topic=topic, peers=px, backoff=backoff)
 
     def get_peers(self, topic: str, count: int, flt) -> list[PeerID]:
@@ -527,6 +552,23 @@ class GossipSubRouter:
     # -- heartbeat (gossipsub.go:1345-1606) --
 
     def heartbeat(self) -> None:
+        """Timed wrapper: warn when one heartbeat burns more wall-clock than
+        slow_heartbeat_warning x the (virtual) interval — the reference's
+        slow-heartbeat observability (gossipsub.go:1346-1354)."""
+        start = time.perf_counter()
+        try:
+            self._heartbeat()
+        finally:
+            if self.params.slow_heartbeat_warning > 0:
+                dt = time.perf_counter() - start
+                slow = (self.params.slow_heartbeat_warning *
+                        self.params.heartbeat_interval)
+                if dt > slow:
+                    logging.getLogger(__name__).warning(
+                        "slow heartbeat: took %.3fs (interval %.1fs)",
+                        dt, self.params.heartbeat_interval)
+
+    def _heartbeat(self) -> None:
         assert self.p is not None
         self.heartbeat_ticks += 1
         tograft: dict[PeerID, list[str]] = {}

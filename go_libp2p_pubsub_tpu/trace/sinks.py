@@ -90,27 +90,69 @@ class PBTracer(_BufferedTracer):
 
 
 class RemoteTracer(_BufferedTracer):
-    """Batched gzip sink (tracer.go:186-303): lossy, batches of at least
-    MIN_TRACE_BATCH_SIZE events compressed and handed to a collector callable
-    (the substrate stand-in for the remote libp2p stream)."""
+    """Remote collector sink (tracer.go:186-303): lossy buffering,
+    MIN_TRACE_BATCH_SIZE-gated flushing, and gzip'd delimited
+    ``TraceEventBatch`` frames — the reference's exact wire unit
+    (tracer.go:211-239) — written to a persistent stream.
 
-    def __init__(self, send: Callable[[bytes], None]):
+    ``open_stream`` is the substrate's NewStream analogue: a zero-arg
+    callable returning a write callable, raising on dial failure. A write
+    failure resets the stream and reopens it once per flush
+    (tracer.go:268-276 ``s.Reset()`` + ``openStream``); if the reopen or the
+    retry also fails the batch is dropped (the sink is lossy by contract).
+    Passing a plain write callable models a stream that never fails.
+    Divergence from the reference, declared in MIGRATION.md: gzip is
+    per-batch rather than one stream-long gzip writer, so each batch is
+    independently decompressible (no gzip state rides the stream)."""
+
+    def __init__(self, send: Callable[[bytes], None] | None = None, *,
+                 open_stream: Callable[[], Callable[[bytes], None]] | None
+                 = None):
         super().__init__(lossy=True)
-        self._send = send
+        if (send is None) == (open_stream is None):
+            raise ValueError("pass exactly one of send / open_stream")
+        self._open = open_stream if open_stream is not None \
+            else (lambda: send)
+        self._stream: Callable[[bytes], None] | None = None
 
     def flush(self) -> None:
         if len(self.buf) < MIN_TRACE_BATCH_SIZE:
             return
+        self._write_batch()
+
+    def _write_batch(self) -> None:
+        from ..pb import codec
+
         batch, self.buf = self.buf, []
-        payload = gzip.compress(json.dumps({"batch": batch}).encode())
-        self._send(payload)
+        body = codec.encode_trace_event_batch(batch)
+        payload = gzip.compress(codec.write_uvarint(len(body)) + body)
+        for _attempt in range(2):
+            if self._stream is None:
+                try:
+                    self._stream = self._open()
+                except Exception:
+                    break               # collector unreachable: drop batch
+            try:
+                self._stream(payload)
+                return
+            except Exception:
+                self._stream = None     # reset + reopen once, then give up
+        self.dropped += len(batch)
 
     def close(self) -> None:
         if self.buf:
-            batch, self.buf = self.buf, []
-            self._send(gzip.compress(json.dumps({"batch": batch}).encode()))
+            self._write_batch()
         self.closed = True
 
     @staticmethod
     def decode_batch(payload: bytes) -> list[dict]:
-        return json.loads(zlib.decompress(payload, wbits=31))["batch"]
+        from ..pb import codec
+
+        data = zlib.decompress(payload, wbits=31)
+        events: list[dict] = []
+        pos = 0
+        while pos < len(data):
+            ln, pos = codec.read_uvarint(data, pos)
+            events.extend(codec.decode_trace_event_batch(data[pos:pos + ln]))
+            pos += ln
+        return events

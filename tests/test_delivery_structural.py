@@ -1,0 +1,88 @@
+"""Pin the 10k-beacon delivery-loss mechanism (VERDICT r2 weak #5).
+
+The beacon scenario's sub-1.0 delivery fraction is STRUCTURAL: attestation
+subnets are joined by ~15% of peers, so the subscriber-induced subgraph has
+mean degree ~2.4 on a degree-16 underlay — below connectivity, leaving some
+subscribers with zero subscribed neighbors (or in small components away
+from the publisher). No overlay protocol can deliver to them: gossipsub
+meshes, gossip, and IWANT all ride existing connections between peers in
+the topic (comm.go:156-191 is the only transport; the reference has the
+same reachability floor). This test proves every missed (peer, message)
+pair is graph-unreachable from its publisher through subscribers, and that
+delivery over REACHABLE pairs is exactly 1.0 — i.e. the engine loses
+nothing to gater admission, edge-capacity drops, or window expiry in this
+configuration.
+"""
+
+from collections import deque
+
+import jax
+import numpy as np
+
+from go_libp2p_pubsub_tpu.sim import scenarios
+from go_libp2p_pubsub_tpu.sim.engine import run
+
+
+def _reachable_from(publisher: int, subs_t: np.ndarray, nbr: np.ndarray,
+                    conn: np.ndarray) -> np.ndarray:
+    """BFS over the subscriber-induced subgraph (message relays only flow
+    between peers subscribed to the topic)."""
+    n = nbr.shape[0]
+    seen = np.zeros(n, bool)
+    seen[publisher] = True
+    q = deque([publisher])
+    while q:
+        p = q.popleft()
+        for s, nb in zip(conn[p], nbr[p]):
+            if s and nb >= 0 and subs_t[nb] and not seen[nb]:
+                seen[nb] = True
+                q.append(nb)
+    return seen
+
+
+class TestBeaconDeliveryIsStructural:
+    def test_all_misses_unreachable_and_reachable_is_total(self):
+        cfg, tp, st = scenarios.beacon_10k(n_peers=2000, k_slots=32,
+                                           degree=16)
+        st = run(st, cfg, tp, jax.random.PRNGKey(0), 10)
+        st.tick.block_until_ready()
+
+        tick = int(st.tick)
+        msg_topic = np.asarray(st.msg_topic)
+        msg_pub = np.asarray(st.msg_publish_tick)
+        msg_from = np.asarray(st.msg_publisher)
+        have = np.asarray(st.have)
+        sub = np.asarray(st.subscribed)
+        nbr = np.asarray(st.neighbors)
+        conn = np.asarray(st.connected).astype(bool)
+
+        alive = (tick - msg_pub) < cfg.history_length
+        valid = (msg_topic >= 0) & alive
+        slots = np.where(valid)[0]
+        assert slots.size > 0
+
+        n_missed = n_checked = 0
+        for s in slots:
+            t = int(msg_topic[s])
+            subs_t = sub[:, t]
+            # messages this old have finished propagating (prop_substeps
+            # hops/tick); younger ones may still be legitimately in flight
+            if tick - msg_pub[s] < 3:
+                continue
+            reach = _reachable_from(int(msg_from[s]), subs_t, nbr, conn)
+            should = subs_t & valid[s]
+            missed = should & ~have[:, s]
+            # every miss is structurally unreachable from the publisher
+            assert not (missed & reach).any(), (
+                f"msg slot {s} topic {t}: reachable subscriber missed — "
+                f"a real drop, not topology")
+            # and every reachable subscriber WAS delivered
+            assert (have[:, s] | ~reach | ~should).all()
+            n_missed += int(missed.sum())
+            n_checked += 1
+        # the scenario genuinely exercises the structural-loss path
+        assert n_checked >= 5
+        assert n_missed > 0, (
+            "expected some structurally isolated subnet subscribers; if the "
+            "topology changed to make all subnets connected, this test's "
+            "premise is gone — revisit BASELINE notes for config 2")

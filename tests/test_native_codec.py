@@ -131,6 +131,28 @@ class TestNativeRpcScanner:
         assert t_n == t_p
         assert s_n.shape[0] == 60 and s_n[:, 1].sum() == m_n.shape[0]
 
+    def test_empty_topic_parity(self):
+        """A publish whose topic field is PRESENT but empty (len 0) must scan
+        identically in both paths: proto2 decode can't distinguish absent
+        from empty on the Python side, so neither path interns it and the
+        message records topic_id -1 (foreign encoders can emit this; ours
+        skips empty topics, codec.encode_message)."""
+        from go_libp2p_pubsub_tpu.pb import native_rpc
+        from go_libp2p_pubsub_tpu.pb.codec import (
+            _bytes_field, _str_field, write_uvarint)
+        # Message{data="xx", topic=""} then Message{data="y", topic="t0"}
+        msg_empty = _bytes_field(2, b"xx") + _str_field(4, "")
+        msg_named = _bytes_field(2, b"y") + _str_field(4, "t0")
+        payload = _bytes_field(2, msg_empty) + _bytes_field(2, msg_named)
+        data = bytes(write_uvarint(len(payload)) + payload)
+        s_p, m_p, t_p = native_rpc.scan_bytes_python(data)
+        assert m_p[0, 1] == -1 and t_p == ["t0"] and m_p[1, 1] == 0
+        if native_rpc.available():
+            s_n, m_n, t_n = native_rpc.scan_bytes(data)
+            np.testing.assert_array_equal(s_n, s_p)
+            np.testing.assert_array_equal(m_n, m_p)
+            assert t_n == t_p
+
     def test_oversize_frame_rejected(self):
         from go_libp2p_pubsub_tpu.pb import native_rpc
         from go_libp2p_pubsub_tpu.core.types import Message, RPC

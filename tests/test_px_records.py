@@ -1,0 +1,172 @@
+"""Signed-peer-record validation on the PX dial path.
+
+Mirrors the reference's envelope checks before dialing peers learned through
+PRUNE peer exchange (gossipsub.go:893-926: unmarshal envelope over the
+peer-record domain, payload must be a peer record, record id must match the
+announced id — else skip without dialing) and the certified-store flows
+around it (GetPeerRecord on the prune side, gossipsub.go:1885-1901;
+ConsumePeerRecord after a successful dial, gossipsub.go:954-958).
+"""
+
+import pytest
+
+from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub, generate_keypair
+from go_libp2p_pubsub_tpu.api.peer_record import (
+    PEER_RECORD_PAYLOAD_TYPE,
+    PeerRecord,
+    RecordError,
+    consume_peer_record,
+    encode_peer_record,
+    seal_record,
+)
+from go_libp2p_pubsub_tpu.core.types import PeerInfo
+from go_libp2p_pubsub_tpu.net import Network
+from go_libp2p_pubsub_tpu.pb.codec import _bytes_field
+from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+
+
+class TestEnvelope:
+    def test_seal_consume_roundtrip(self):
+        key, pid = generate_keypair(seed=b"alice")
+        rec = PeerRecord(peer_id=pid, seq=7, addrs=("10.0.0.1", "10.0.0.2"))
+        out = consume_peer_record(seal_record(rec, key))
+        assert out == rec
+
+    def test_tampered_payload_rejected(self):
+        key, pid = generate_keypair(seed=b"alice")
+        env = bytearray(seal_record(PeerRecord(peer_id=pid, seq=1), key))
+        env[-1] ^= 0x01          # flip a signature bit
+        with pytest.raises(RecordError, match="signature"):
+            consume_peer_record(bytes(env))
+
+    def test_wrong_payload_type_rejected(self):
+        key, pid = generate_keypair(seed=b"alice")
+        env = seal_record(PeerRecord(peer_id=pid, seq=1), key)
+        bogus = env.replace(
+            _bytes_field(2, PEER_RECORD_PAYLOAD_TYPE), _bytes_field(2, b"\x99\x99"))
+        with pytest.raises(RecordError, match="not a peer record"):
+            consume_peer_record(bogus)
+
+    def test_impersonation_rejected(self):
+        """A record claiming someone else's id, signed with the attacker's
+        own (valid) key, must not validate: the id is self-certifying."""
+        key_attacker, _ = generate_keypair(seed=b"mallory")
+        _, pid_victim = generate_keypair(seed=b"alice")
+        env = seal_record(PeerRecord(peer_id=pid_victim, seq=1), key_attacker)
+        with pytest.raises(RecordError, match="doesn't match signing key"):
+            consume_peer_record(env)
+
+    def test_garbage_rejected(self):
+        with pytest.raises(RecordError):
+            consume_peer_record(b"\xff\xfe not an envelope")
+
+    def test_varint_field_attack_rejected(self):
+        """Envelope fields encoded as huge varints (wire type 0) must raise
+        RecordError, not attempt a terabyte allocation."""
+        from go_libp2p_pubsub_tpu.pb.codec import _varint_field
+        # field 5 (signature) as varint 2**40
+        evil = _varint_field(5, 1 << 40)
+        with pytest.raises(RecordError):
+            consume_peer_record(evil)
+
+    def test_signed_garbage_payload_rejected(self):
+        """A validly SIGNED but malformed record payload (attacker signs
+        arbitrary bytes with their own key) must raise RecordError."""
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat)
+        from go_libp2p_pubsub_tpu.api.peer_record import (
+            PEER_RECORD_ENVELOPE_DOMAIN, _unsigned_bytes)
+        from go_libp2p_pubsub_tpu.pb.codec import _bytes_field
+        key, _ = generate_keypair(seed=b"mallory")
+        # seq (field 2) as length-delimited non-integer bytes
+        payload = _bytes_field(2, b"notanint")
+        sig = key.sign(_unsigned_bytes(
+            PEER_RECORD_ENVELOPE_DOMAIN, PEER_RECORD_PAYLOAD_TYPE, payload))
+        pub = key.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+        env = (_bytes_field(1, pub) + _bytes_field(2, PEER_RECORD_PAYLOAD_TYPE)
+               + _bytes_field(3, payload) + _bytes_field(5, sig))
+        with pytest.raises(RecordError):
+            consume_peer_record(env)
+
+    def test_peer_record_codec(self):
+        rec = PeerRecord(peer_id="ed25519:00ff", seq=3, addrs=("a", "b"))
+        assert consume_peer_record.__doc__  # silence lint on import use
+        from go_libp2p_pubsub_tpu.api.peer_record import decode_peer_record
+        assert decode_peer_record(encode_peer_record(rec)) == rec
+
+
+def _keyed_node(net, seed):
+    key, pid = generate_keypair(seed=seed)
+    h = net.add_host(peer_id=pid)
+    rt = GossipSubRouter(do_px=True)
+    ps = PubSub(h, rt, sign_policy=LAX_NO_SIGN, sign_key=key)
+    return ps, rt, h
+
+
+class TestPXDialGate:
+    def _net3(self):
+        """A (dialer), B (existing peer), C (PX target, never yet dialed)."""
+        net = Network()
+        a, rt_a, ha = _keyed_node(net, b"a")
+        b, _, hb = _keyed_node(net, b"b")
+        c, _, hc = _keyed_node(net, b"c")
+        ha.connect(hb)
+        net.scheduler.run_for(0.1)
+        return net, (a, rt_a, ha), (b, None, hb), (c, None, hc)
+
+    def test_forged_record_produces_zero_dials(self):
+        net, (a, rt_a, ha), _, (c, _, hc) = self._net3()
+        key_m, _ = generate_keypair(seed=b"mallory")
+        forged = seal_record(PeerRecord(peer_id=hc.peer_id, seq=1), key_m)
+        rt_a.px_connect([PeerInfo(peer_id=hc.peer_id,
+                                  signed_peer_record=forged)])
+        net.scheduler.run_for(1.0)
+        assert hc.peer_id not in ha.conns
+
+    def test_mismatched_announced_id_produces_zero_dials(self):
+        """Valid envelope, but certifying a different peer than announced."""
+        net, (a, rt_a, ha), (b, _, hb), (c, _, hc) = self._net3()
+        # C's genuine record announced under B's id -> reject
+        rt_a.px_connect([PeerInfo(peer_id=hb.peer_id,
+                                  signed_peer_record=hc.local_record)])
+        net.scheduler.run_for(1.0)
+        assert hc.peer_id not in ha.conns
+
+    def test_valid_record_dials_and_persists(self):
+        net, (a, rt_a, ha), _, (c, _, hc) = self._net3()
+        rt_a.px_connect([PeerInfo(peer_id=hc.peer_id,
+                                  signed_peer_record=hc.local_record)])
+        net.scheduler.run_for(1.0)
+        assert hc.peer_id in ha.conns
+        # ConsumePeerRecord analogue: the validated record is retained
+        assert ha.certified_records[hc.peer_id] == hc.local_record
+
+    def test_recordless_px_still_dials(self):
+        """No signed record attached: dial anyway (the reference trusts the
+        DHT for addresses, not PX; the id alone is allowed through)."""
+        net, (a, rt_a, ha), _, (c, _, hc) = self._net3()
+        rt_a.px_connect([PeerInfo(peer_id=hc.peer_id)])
+        net.scheduler.run_for(1.0)
+        assert hc.peer_id in ha.conns
+
+
+class TestPruneAttachesRecords:
+    def test_prune_px_carries_certified_records(self):
+        """make_prune attaches stored records for exchanged peers
+        (gossipsub.go:1885-1901)."""
+        net = Network()
+        a, rt_a, ha = _keyed_node(net, b"a")
+        b, _, hb = _keyed_node(net, b"b")
+        c, _, hc = _keyed_node(net, b"c")
+        ha.connect(hb)
+        ha.connect(hc)
+        net.scheduler.run_for(0.1)
+        for n in (a, b, c):
+            n.join("t").subscribe()
+        net.scheduler.run_until(3.0)
+        pr = rt_a.make_prune(hb.peer_id, "t", do_px=True, is_unsubscribe=False)
+        assert [pi.peer_id for pi in pr.peers] == [hc.peer_id]
+        assert pr.peers[0].signed_peer_record == hc.local_record
+        # and the attached record validates against the announced id
+        rec = consume_peer_record(pr.peers[0].signed_peer_record)
+        assert rec.peer_id == hc.peer_id

@@ -1,0 +1,159 @@
+"""Free-running statistical parity: functional runtime vs batched engine.
+
+SURVEY §7's missing gate (VERDICT r2 weak #4): the two halves of the
+framework deliberately differ in micro-decisions (random IWANT pick vs
+deterministic lowest-slot chooser, latency-scheduled wire vs hop-bounded
+substeps), so free-running equivalence is STATISTICAL, not bitwise. This
+harness runs the same network shape through both halves — same underlay
+graph (the functional net's own connection graph, ``topology.from_hosts``),
+same gossipsub degree bounds, same score params, same publish rate — and
+asserts the distributions that define router health match within bands:
+
+- mesh degree distribution (mean, dlo/dhi clamping, empirical-CDF distance)
+  — gossipsub_test.go:85 TestDenseGossipsub checks exactly this shape;
+- delivery fraction (both sides must saturate on a connected single topic);
+- delivery latency in ticks (mesh forwarding is same-tick in both halves).
+"""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+from go_libp2p_pubsub_tpu.core.params import (
+    PeerScoreParams,
+    PeerScoreThresholds,
+    TopicScoreParams,
+)
+from go_libp2p_pubsub_tpu.net import Network
+from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+from go_libp2p_pubsub_tpu.sim import SimConfig, init_state, topology
+from go_libp2p_pubsub_tpu.sim.config import TopicParams
+from go_libp2p_pubsub_tpu.trace import MemoryTracer
+
+TOPIC = "t"
+N = 512
+DEGREE = 12
+# dense_connect(degree=12) gives ~24 bidirectional conns per node (each
+# side dials 12); k_slots must hold the max or from_hosts truncates edges
+K_SLOTS = 40
+CONVERGE_T = 15.0          # virtual seconds of mesh convergence
+PUBS = 24                  # 2 publishes per tick for 12 ticks
+DRAIN_T = 3.0
+
+TSP = TopicScoreParams(
+    topic_weight=1.0, time_in_mesh_weight=0.05, time_in_mesh_quantum=1.0,
+    time_in_mesh_cap=100.0, first_message_deliveries_weight=1.0,
+    first_message_deliveries_decay=0.9, first_message_deliveries_cap=50.0,
+    mesh_message_deliveries_weight=0.0, mesh_message_deliveries_decay=0.9,
+    mesh_message_deliveries_cap=30.0, mesh_message_deliveries_threshold=3.0,
+    mesh_message_deliveries_window=0.05, mesh_message_deliveries_activation=4.0,
+    mesh_failure_penalty_weight=0.0, mesh_failure_penalty_decay=0.9,
+    invalid_message_deliveries_weight=-5.0,
+    invalid_message_deliveries_decay=0.9)
+
+
+def _run_functional():
+    net = Network()
+    mem = MemoryTracer()
+    nodes = []
+    for _ in range(N):
+        h = net.add_host()
+        sp = PeerScoreParams(app_specific_score=lambda p: 0.0,
+                             decay_interval=1.0, decay_to_zero=0.01,
+                             topics={TOPIC: TSP})
+        nodes.append(PubSub(h, GossipSubRouter(score_params=sp,
+                                               thresholds=PeerScoreThresholds()),
+                            sign_policy=LAX_NO_SIGN, event_tracer=mem))
+    hosts = [x.host for x in nodes]
+    net.dense_connect(hosts, degree=DEGREE)
+    net.scheduler.run_for(0.1)
+    for x in nodes:
+        x.join(TOPIC).subscribe()
+    net.scheduler.run_until(CONVERGE_T)
+    rng = np.random.default_rng(1)
+    t_pub = CONVERGE_T
+    for i in range(PUBS):
+        nodes[int(rng.integers(N))].my_topics[TOPIC].publish(b"m%d" % i)
+        t_pub += 0.5
+        net.scheduler.run_until(t_pub)
+    net.scheduler.run_until(t_pub + DRAIN_T)
+
+    degrees = np.array([len(x.rt.mesh.get(TOPIC, ())) for x in nodes])
+    pub_t: dict[str, float] = {}
+    delivered: dict[str, set] = {}
+    latencies = []
+    for e in mem.events:
+        if e["type"] == "PUBLISH_MESSAGE":
+            pub_t.setdefault(e["publishMessage"]["messageID"], e["timestamp"])
+        elif e["type"] == "DELIVER_MESSAGE":
+            mid = e["deliverMessage"]["messageID"]
+            frm = e["deliverMessage"].get("receivedFrom")
+            delivered.setdefault(mid, set()).add(e["peerID"])
+            if frm != e["peerID"] and mid in pub_t:
+                latencies.append(e["timestamp"] - pub_t[mid])
+    frac = np.mean([len(delivered.get(m, ())) / N for m in pub_t])
+    return hosts, degrees, float(frac), np.array(latencies)
+
+
+def _run_batched(hosts):
+    import jax
+    from go_libp2p_pubsub_tpu.sim.engine import (
+        delivery_fraction, delivery_latency_ticks, mesh_degrees, run)
+
+    topo, _ = topology.from_hosts(hosts, K_SLOTS)
+    cfg = SimConfig(n_peers=N, k_slots=K_SLOTS, n_topics=1, msg_window=64,
+                    publishers_per_tick=2, prop_substeps=8,
+                    scoring_enabled=True)
+    tp = TopicParams.from_topic_params([TSP])
+    st = init_state(cfg, topo,
+                    subscribed=np.ones((N, 1), bool))
+    st = run(st, cfg, tp, jax.random.PRNGKey(0), 30)
+    st.tick.block_until_ready()
+    degrees = np.asarray(mesh_degrees(st))
+    if degrees.ndim == 2:
+        degrees = degrees[:, 0]
+    return (degrees, float(delivery_fraction(st, cfg)),
+            float(delivery_latency_ticks(st, cfg)))
+
+
+@pytest.fixture(scope="module")
+def parity():
+    hosts, deg_f, frac_f, lat_f = _run_functional()
+    deg_b, frac_b, lat_b = _run_batched(hosts)
+    return deg_f, frac_f, lat_f, deg_b, frac_b, lat_b
+
+
+class TestStatisticalParity:
+    def test_mesh_degree_bounds(self, parity):
+        deg_f, _, _, deg_b, _, _ = parity
+        cfg_d, cfg_dlo, cfg_dhi = 6, 5, 12
+        for name, d in (("functional", deg_f), ("batched", deg_b)):
+            assert d.min() >= cfg_dlo, f"{name} min degree below DLO"
+            assert d.max() <= cfg_dhi, f"{name} max degree above DHI"
+            assert cfg_d - 1 <= d.mean() <= cfg_dhi, \
+                f"{name} mean degree {d.mean():.2f} outside healthy band"
+
+    def test_mesh_degree_distribution_close(self, parity):
+        deg_f, _, _, deg_b, _, _ = parity
+        assert abs(deg_f.mean() - deg_b.mean()) <= 2.0, \
+            f"mean degrees diverge: {deg_f.mean():.2f} vs {deg_b.mean():.2f}"
+        # empirical CDF distance over the shared support
+        grid = np.arange(0, 14)
+        cdf_f = np.searchsorted(np.sort(deg_f), grid, side="right") / N
+        cdf_b = np.searchsorted(np.sort(deg_b), grid, side="right") / N
+        ks = np.abs(cdf_f - cdf_b).max()
+        assert ks <= 0.35, f"mesh degree CDFs diverge: KS distance {ks:.3f}"
+
+    def test_delivery_fraction_saturates(self, parity):
+        _, frac_f, _, _, frac_b, _ = parity
+        assert frac_f >= 0.995, f"functional delivery {frac_f:.4f}"
+        assert frac_b >= 0.995, f"batched delivery {frac_b:.4f}"
+
+    def test_delivery_latency_close(self, parity):
+        _, _, lat_f, _, _, lat_b = parity
+        # heartbeat interval 1.0s == 1 tick: mesh forwarding completes
+        # within the tick in both halves
+        mean_f_ticks = float(lat_f.mean())  # virtual seconds == ticks
+        assert mean_f_ticks <= 0.25, f"functional latency {mean_f_ticks:.3f}"
+        assert lat_b <= 0.25, f"batched latency {lat_b:.3f}"
+        assert abs(mean_f_ticks - lat_b) <= 0.25

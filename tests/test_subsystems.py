@@ -331,6 +331,42 @@ class TestSinks:
         decoded = RemoteTracer.decode_batch(batches[0])
         assert len(decoded) == 20
 
+    def test_remote_tracer_reconnects_on_write_failure(self):
+        """tracer.go:268-276: a write failure resets the stream and reopens;
+        the batch is retried on the fresh stream."""
+        batches, opened = [], []
+
+        def open_stream():
+            opened.append(1)
+            calls = {"n": 0}
+
+            def write(payload):
+                calls["n"] += 1
+                if len(opened) == 1 and calls["n"] == 1:
+                    raise IOError("stream reset")
+                batches.append(payload)
+            return write
+
+        t = RemoteTracer(open_stream=open_stream)
+        for i in range(20):
+            t.trace({"type": "JOIN", "peerID": "p", "timestamp": float(i),
+                     "join": {"topic": "t"}})
+        t.flush()
+        assert len(opened) == 2 and len(batches) == 1 and t.dropped == 0
+        assert len(RemoteTracer.decode_batch(batches[0])) == 20
+
+    def test_remote_tracer_drops_when_collector_down(self):
+        """Lossy contract: unreachable collector drops the batch, counted."""
+        def open_stream():
+            raise IOError("dial failed")
+
+        t = RemoteTracer(open_stream=open_stream)
+        for i in range(20):
+            t.trace({"type": "JOIN", "peerID": "p", "timestamp": float(i),
+                     "join": {"topic": "t"}})
+        t.flush()
+        assert t.dropped == 20
+
     def test_event_tracer_wired_into_node(self, tmp_path):
         path = str(tmp_path / "node.ndjson")
         sink = JSONTracer(path)
