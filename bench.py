@@ -94,6 +94,17 @@ def run_scenario(name: str) -> None:
     }
     assert set(builders) == set(NAMES), "scenario registry drifted from NAMES"
     cfg, tp, st = builders[name]()
+    mode = os.environ.get("GRAFT_EDGE_GATHER")
+    if mode:
+        # formulation sweep knob for scripts/tpu_recheck.sh (ops/permgather)
+        import dataclasses
+        import jax.numpy as jnp
+        from go_libp2p_pubsub_tpu.ops.permgather import resolve_mode
+        cfg = dataclasses.replace(cfg, edge_gather_mode=mode)
+        print(json.dumps({
+            "info": "edge_gather sweep", "requested": mode,
+            "resolved": resolve_mode(mode, jnp.uint32, cfg.n_peers,
+                                     cfg.k_slots)}), flush=True)
     bench_one(_label(name), cfg, tp, st, ticks)
 
 

@@ -32,21 +32,24 @@ import jax.numpy as jnp
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
 from .bits import U32, pack_bool
+from .permgather import permutation_gather
 from .score_ops import apply_prune_penalty, compute_scores
 
 
-def _symmetric_value(state: SimState, x: jnp.ndarray) -> jnp.ndarray:
+def _symmetric_value(state: SimState, x: jnp.ndarray,
+                     mode: str = "auto") -> jnp.ndarray:
     """[N, K] per-edge values made equal on both directions of each edge: the
     lower-id endpoint's value wins, gathered through reverse_slot."""
     n, k = state.neighbors.shape
     nbr = jnp.clip(state.neighbors, 0, n - 1)
     rk = jnp.clip(state.reverse_slot, 0, k - 1)
-    x_rev = x[nbr, rk]
+    x_rev = permutation_gather(x, nbr, rk, mode)
     mine_wins = jnp.arange(n)[:, None] < nbr
     return jnp.where(mine_wins, x, x_rev)
 
 
-def _symmetric_bools(state: SimState, bits: list) -> list:
+def _symmetric_bools(state: SimState, bits: list,
+                     mode: str = "auto") -> list:
     """Symmetrize boolean per-edge decisions: both directions of an edge use
     the lower-id endpoint's bit. All planes (up to 32) share ONE packed u32
     permutation gather — each f32 `_symmetric_value` costs its own N*K
@@ -58,7 +61,7 @@ def _symmetric_bools(state: SimState, bits: list) -> list:
     payload = jnp.zeros((n, k), U32)
     for i, b in enumerate(bits):
         payload = payload | jnp.where(b, U32(1) << U32(i), U32(0))
-    g = payload[nbr, rk]
+    g = permutation_gather(payload, nbr, rk, mode)
     mine_wins = jnp.arange(n)[:, None] < nbr
     return [jnp.where(mine_wins, b, ((g >> U32(i)) & U32(1)).astype(bool))
             for i, b in enumerate(bits)]
@@ -87,7 +90,8 @@ def churn_subscriptions(state: SimState, cfg: SimConfig, tp: TopicParams,
 
     from .heartbeat import edge_gather  # local import: avoid cycle
     removed = state.mesh & leave[:, :, None]
-    inc_removed = edge_gather(removed, state) & state.mesh
+    inc_removed = edge_gather(removed, state,
+                              mode=cfg.edge_gather_mode) & state.mesh
     mesh_removed = removed | inc_removed
     state = apply_prune_penalty(state, mesh_removed, tp)
     backoff = jnp.where(mesh_removed,
@@ -99,10 +103,10 @@ def churn_subscriptions(state: SimState, cfg: SimConfig, tp: TopicParams,
     # joiner would drop the edge — a one-sided promote would otherwise
     # persist as an asymmetric mesh edge until the remote's backoff expires)
     backoff_ok = state.tick >= backoff
-    remote_ok = edge_gather(backoff_ok, state)
+    remote_ok = edge_gather(backoff_ok, state, mode=cfg.edge_gather_mode)
     promote = join[:, :, None] & state.fanout & \
         state.connected[:, None, :] & backoff_ok & remote_ok
-    promote_in = edge_gather(promote, state)
+    promote_in = edge_gather(promote, state, mode=cfg.edge_gather_mode)
     promoted = promote | promote_in
     new_mesh = (state.mesh & ~mesh_removed) | promoted
     subscribed = (state.subscribed | join) & ~leave
@@ -163,7 +167,7 @@ def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
     # permutation-gather cost
     d_up = jax.random.uniform(ku, (n_, k_)) < p_up
     d_down, d_up, direct_low = _symmetric_bools(
-        state, [d_down, d_up, state.direct])
+        state, [d_down, d_up, state.direct], cfg.edge_gather_mode)
     go_down = live & d_down
     come_up = down & d_up
     # direct peers are force-redialed on a fixed cadence regardless of churn

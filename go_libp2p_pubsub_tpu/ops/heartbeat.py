@@ -21,16 +21,22 @@ import jax.numpy as jnp
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
 from .bits import U32
+from .permgather import permutation_gather
 from .score_ops import apply_prune_penalty, compute_scores
 from .selection import masked_median, select_random, select_top
 
 
-def edge_gather(x: jnp.ndarray, state: SimState, fill=False) -> jnp.ndarray:
+def edge_gather(x: jnp.ndarray, state: SimState, fill=False,
+                mode: str = "auto") -> jnp.ndarray:
     """incoming[j, t, s] = x[neighbors[j,s], t, reverse_slot[j,s]].
 
     The receiver-side view of per-edge state: what the peer in my slot s has
-    recorded about me. Invalid slots read ``fill``.
+    recorded about me. Invalid slots read ``fill``. Boolean masks with
+    fill=False ride the packed permutation gather (one u32 gather for up to
+    32 topic planes); other dtypes use the generic advanced-index form.
     """
+    if x.dtype == jnp.bool_ and fill is False:
+        return edge_gather_packed([x], state, mode)[0]
     n, t, k = x.shape
     j = jnp.clip(state.neighbors, 0, n - 1)[:, None, :]
     rk = jnp.clip(state.reverse_slot, 0, k - 1)[:, None, :]
@@ -40,13 +46,14 @@ def edge_gather(x: jnp.ndarray, state: SimState, fill=False) -> jnp.ndarray:
     return jnp.where(valid, y, fill)
 
 
-def edge_gather_packed(masks: list, state: SimState) -> list:
+def edge_gather_packed(masks: list, state: SimState,
+                       mode: str = "auto") -> list:
     """Gather several [N, T, K] boolean edge masks through the reverse-edge
-    permutation in ceil(B/32) uint32 scalar gathers (B = total bit-planes),
-    instead of one [N,T,K] advanced-index gather per mask. The permutation
-    gather is the expensive op on TPU (serialized scalar loads); packing
-    divides its index count by T-per-mask and amortizes it across masks,
-    while the pack/unpack shifts are cheap VPU passes."""
+    permutation in ceil(B/32) uint32 gathers (B = total bit-planes), instead
+    of one [N,T,K] advanced-index gather per mask. The permutation gather is
+    the expensive op on TPU; packing divides its index count by T-per-mask
+    and amortizes it across masks, while the pack/unpack shifts are cheap
+    VPU passes. ``mode`` picks the gather formulation (ops/permgather.py)."""
     n, t, k = masks[0].shape
     planes = jnp.concatenate(masks, axis=1)                    # [N, B, K]
     b = planes.shape[1]
@@ -59,7 +66,7 @@ def edge_gather_packed(masks: list, state: SimState) -> list:
         nb = bits.shape[1]
         sh = (U32(1) << jnp.arange(nb, dtype=U32))[None, :, None]
         payload = jnp.sum(bits.astype(U32) * sh, axis=1, dtype=U32)  # [N, K]
-        g = payload[jn, rk]                                          # [N, K]
+        g = permutation_gather(payload, jn, rk, mode)                # [N, K]
         parts.append(((g[:, None, :] >> jnp.arange(nb, dtype=U32)[None, :, None])
                       & U32(1)).astype(bool))
     flat = jnp.concatenate(parts, axis=1) & valid
@@ -182,7 +189,8 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     prunes = prune_neg | prune_over
 
     # --- cross-peer exchange, all against pre-round state ---
-    inc_graft, inc_prune = edge_gather_packed([grafts, prunes], state)
+    inc_graft, inc_prune = edge_gather_packed([grafts, prunes], state,
+                                             cfg.edge_gather_mode)
 
     # receiver-side GRAFT vetting (gossipsub.go:741-837): refuse when not
     # joined, in backoff, sender score negative, mesh full (unless outbound),
@@ -202,7 +210,8 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
         + jnp.sum(inc_graft & flood, axis=1).astype(jnp.float32)
     behaviour_penalty = state.behaviour_penalty + bp_add
 
-    refused_back, = edge_gather_packed([refuse], state)
+    refused_back, = edge_gather_packed([refuse], state,
+                                       cfg.edge_gather_mode)
 
     new_mesh = ((mesh5 | accept) & ~inc_prune & ~refused_back) & joined
     pruned_any = prunes | inc_prune | refused_back
@@ -260,7 +269,8 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     # who gossips to me, and whose eager forwarding reaches me
     # (gossipsub.go:1020-1035 mesh forward, :1007 fanout publish)
     send = new_mesh | (new_fanout & ~state.subscribed[:, :, None])
-    inc_gossip, fwd_send = edge_gather_packed([gossip_sel, send], st)
+    inc_gossip, fwd_send = edge_gather_packed([gossip_sel, send], st,
+                                             cfg.edge_gather_mode)
 
     return HeartbeatOut(state=st, scores=scores, scores_all=scores_all,
                         inc_gossip=inc_gossip, fwd_send=fwd_send)

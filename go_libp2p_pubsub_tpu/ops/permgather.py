@@ -1,0 +1,124 @@
+"""The reverse-edge permutation gather — the engine's hottest index op.
+
+``out[n, k] = payload[jn[n, k], rk[n, k]]`` routes per-edge state through
+the (sender, slot) -> (receiver, reverse_slot) permutation of directed edge
+slots (ops/heartbeat.py edge_gather_packed, ops/churn.py symmetric
+exchanges, ops/propagate.py sender-score views). Round-2 TPU profiling
+showed XLA lowers the advanced-index form to serialized scalar HBM loads
+(~1GB/s effective on 480k indices) — the dominant cost of the heartbeat.
+
+Three formulations, selectable per SimConfig (``edge_gather_mode``) so the
+TPU recheck can measure them head-to-head (scripts/microbench_kernels.py):
+
+- ``scalar``: the direct advanced-index gather. Fastest on CPU backends
+  (single-threaded pointer chase beats extra passes).
+- ``rows``: gather whole neighbor ROWS (``payload[jn]`` -> [N, K, K]) — the
+  vector-DMA path XLA does tile — then pick the reverse slot per edge with
+  ``take_along_axis`` along the minor axis. Trades an [N, K, K] HBM
+  temporary for vectorized loads; the same trade that made the hop gather
+  2.5x+ faster on the chip (ops/bits.py gather_words_rows).
+- ``pallas``: a Pallas kernel that pins the whole payload in VMEM and
+  performs the row-take + lane-pick per receiver block ON-CHIP, so the
+  permutation never round-trips HBM at all. Only eligible while the payload
+  fits VMEM (N*K*4B <= ~8MB, i.e. <= ~60k peers at K=32); falls back to
+  ``rows`` above that.
+
+``auto`` resolves to ``scalar`` on CPU and ``rows`` on TPU (the
+measured-safe default until the chip recheck promotes ``pallas``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# VMEM budgets (v5e ~16MB/core): the kernel holds the whole [N,K] payload
+# plus one [BN,K,K] row-take scratch per block; both must fit with headroom
+# for the index/output blocks
+_PALLAS_VMEM_PAYLOAD_BYTES = 8 * 1024 * 1024
+_PALLAS_VMEM_SCRATCH_BYTES = 4 * 1024 * 1024
+
+
+def _gather_scalar(payload, jn, rk):
+    return payload[jn, rk]
+
+
+def _gather_rows(payload, jn, rk):
+    rows = payload[jn]                                     # [N, K, K] rows
+    return jnp.take_along_axis(rows, rk[:, :, None], axis=-1)[..., 0]
+
+
+def _block_rows(n: int, k: int, itemsize: int) -> int | None:
+    """Largest receiver-block size whose [BN, K, K] row-take scratch fits
+    the VMEM budget, among divisors of n; None when no feasible block
+    exists (caller falls back to the XLA rows formulation)."""
+    bn_max = _PALLAS_VMEM_SCRATCH_BYTES // max(1, k * k * itemsize)
+    for bn in (1024, 512, 256, 128, 64, 32, 16, 8):
+        if bn <= bn_max and n % bn == 0:
+            return bn
+    if n <= bn_max:
+        return n                      # single block, scratch still fits
+    return None
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gather_pallas(payload, jn, rk, interpret=False):
+    from jax.experimental import pallas as pl
+
+    n, k = payload.shape
+    bn = _block_rows(n, k, payload.dtype.itemsize)
+    assert bn is not None, "resolve_mode admitted an infeasible shape"
+
+    def kernel(payload_ref, jn_ref, rk_ref, out_ref):
+        pay = payload_ref[:]                               # [N, K] in VMEM
+        rows = jnp.take(pay, jn_ref[:], axis=0)            # [BN, K, K]
+        out_ref[:] = jnp.take_along_axis(
+            rows, rk_ref[:][:, :, None], axis=-1)[..., 0]
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((n, k), lambda i: (0, 0)),        # full payload
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, k), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, k), payload.dtype),
+        interpret=interpret,
+    )(payload, jn, rk)
+
+
+def resolve_mode(mode: str, payload_dtype, n: int, k: int) -> str:
+    """Resolve ``auto``/ineligible requests to a concrete formulation."""
+    backend = jax.default_backend()
+    if mode == "auto":
+        mode = "scalar" if backend == "cpu" else "rows"
+    if mode == "pallas":
+        itemsize = jnp.dtype(payload_dtype).itemsize
+        if (itemsize < 4 or n * k * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
+                or _block_rows(n, k, itemsize) is None):
+            return "rows"    # sub-word dtype, payload > VMEM budget, or no
+                             # block size whose row scratch fits
+    return mode
+
+
+def permutation_gather(payload: jnp.ndarray, jn: jnp.ndarray,
+                       rk: jnp.ndarray, mode: str = "auto") -> jnp.ndarray:
+    """out[n, k] = payload[jn[n, k], rk[n, k]].
+
+    ``payload`` is [N, K] of any dtype; ``jn``/``rk`` must be pre-clipped to
+    valid range (callers mask invalid slots on the result).
+    """
+    n, k = payload.shape
+    mode = resolve_mode(mode, payload.dtype, n, k)
+    if mode == "scalar":
+        return _gather_scalar(payload, jn, rk)
+    if mode == "rows":
+        return _gather_rows(payload, jn, rk)
+    if mode == "pallas":
+        return _gather_pallas(payload, jn, rk,
+                              interpret=jax.default_backend() != "tpu")
+    raise ValueError(f"unknown edge_gather_mode {mode!r}")

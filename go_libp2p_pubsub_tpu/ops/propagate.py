@@ -130,7 +130,7 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array,
         if fwd_send is not None:
             return fwd_send
         send = state.mesh | (state.fanout & ~state.subscribed[:, :, None])
-        return edge_gather(send, state)
+        return edge_gather(send, state, mode=cfg.edge_gather_mode)
     if cfg.router == "floodsub":
         # sender forwards to every subscribed neighbor (floodsub.go:76-100)
         return conn & my_sub
@@ -142,7 +142,8 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array,
         target = max(cfg.d, math.ceil(math.sqrt(cfg.n_peers)))
         cand = state.connected[:, None, :] & state.nbr_subscribed   # sender view
         sel = select_random(cand, jnp.full((n, t), target), key)
-        return edge_gather(sel, state) & conn & my_sub
+        return edge_gather(sel, state,
+                           mode=cfg.edge_gather_mode) & conn & my_sub
     raise ValueError(f"unknown router {cfg.router!r}")
 
 
@@ -338,9 +339,13 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         # path too). Only hop 0 carries origin messages. Sender-side values
         # (its score of me, its direct flag for me) arrive through the edge
         # permutation.
+        from .permgather import permutation_gather
         rk = jnp.clip(state.reverse_slot, 0, k - 1)
-        sender_scores_me = scores[nbr, rk]                              # [N,K]
-        sender_direct_me = state.direct[nbr, rk]                        # [N,K]
+        sender_scores_me = permutation_gather(
+            scores, nbr, rk, cfg.edge_gather_mode)                      # [N,K]
+        sender_direct_me = permutation_gather(
+            state.direct.astype(U32), nbr, rk,
+            cfg.edge_gather_mode).astype(bool)                          # [N,K]
         if cfg.scoring_enabled:
             score_gate = sender_direct_me | \
                 (sender_scores_me >= cfg.publish_threshold)

@@ -109,14 +109,33 @@ def main():
         return (g[:, None, :] >> jnp.arange(t, dtype=jnp.uint32)[None, :, None]
                 & 1).astype(bool)
 
+    from go_libp2p_pubsub_tpu.ops.permgather import (
+        permutation_gather, resolve_mode)
+    # what "pallas" actually resolves to at this shape (VMEM eligibility) —
+    # printed so a fallback to rows can't masquerade as a pallas datapoint
+    pallas_resolved = resolve_mode("pallas", jnp.uint32, n, k)
+
+    def eg_pallas(x):
+        # pack T -> u32 [N,K]; VMEM-resident pallas row-take + lane pick
+        tb = (jnp.uint32(1) << jnp.arange(t, dtype=jnp.uint32))
+        packed = jnp.sum(jnp.where(x, tb[None, :, None], jnp.uint32(0)),
+                         axis=1, dtype=jnp.uint32)          # [N, K]
+        g = permutation_gather(packed, nbr, rk, "pallas")
+        return (g[:, None, :] >> jnp.arange(t, dtype=jnp.uint32)[None, :, None]
+                & 1).astype(bool)
+
     x3 = mask
     a = eg_adv(x3)
     b = eg_packed(x3)
     c = eg_rows_pick(x3)
-    assert bool(jnp.all(a == b)) and bool(jnp.all(a == c))
+    d = eg_pallas(x3)
+    assert bool(jnp.all(a == b)) and bool(jnp.all(a == c)) \
+        and bool(jnp.all(a == d))
     scan_time(eg_adv, (a, x3), "edge_gather: advanced-index [N,T,K]")
     scan_time(eg_packed, (a, x3), "edge_gather: T-packed u32 [N,K]")
     scan_time(eg_rows_pick, (a, x3), "edge_gather: row-gather + lane pick")
+    scan_time(eg_pallas, (a, x3),
+              f"edge_gather: pallas (resolved: {pallas_resolved})")
 
     # ---------- neighbor message gather ----------
     nbr_t = nbr.T                                           # [K, N]

@@ -10,6 +10,9 @@ for step in "microbench_beacon:python scripts/microbench_kernels.py 10000 9 48 6
             "microbench_100k:python scripts/microbench_kernels.py 100000 1 32 64" \
             "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
             "ablate_100k:python scripts/ablate.py 100k_sweep 5" \
+            "modes_rows:env GRAFT_EDGE_GATHER=rows BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "modes_pallas:env GRAFT_EDGE_GATHER=pallas BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "modes_scalar:env GRAFT_EDGE_GATHER=scalar BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "bench:python bench.py"; do
   name="${step%%:*}"; cmd="${step#*:}"
   echo "== $name: $cmd =="

@@ -1,0 +1,101 @@
+"""Permutation-gather formulations must be bit-identical.
+
+The reverse-edge gather (ops/permgather.py) has three formulations chosen
+for TPU-vs-CPU memory-path reasons (scalar loads vs vector DMA rows vs an
+on-chip Pallas kernel). Semantics must not depend on the choice: the engine
+trajectory is the contract, so every mode is diffed against the scalar
+reference both at the op level and over full engine ticks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.ops.permgather import (
+    permutation_gather,
+    resolve_mode,
+)
+from go_libp2p_pubsub_tpu.sim import SimConfig, init_state, topology
+from go_libp2p_pubsub_tpu.sim.scenarios import default_topic_params
+
+MODES = ["scalar", "rows", "pallas"]
+
+
+def _random_edge_permutation(n, k, seed=0):
+    """neighbors/reverse_slot of a random symmetric topology (the real
+    shape of the permutation: an involution over directed edge slots)."""
+    topo = topology.sparse(n, k, degree=min(6, k - 1), seed=seed)
+    return np.asarray(topo.neighbors), np.asarray(topo.reverse_slot)
+
+
+class TestOpParity:
+    @pytest.mark.parametrize("dtype", [jnp.uint32, jnp.float32, jnp.int32])
+    def test_modes_bit_identical(self, dtype):
+        n, k = 256, 8
+        nbr, rks = _random_edge_permutation(n, k)
+        jn = jnp.clip(jnp.asarray(nbr), 0, n - 1)
+        rk = jnp.clip(jnp.asarray(rks), 0, k - 1)
+        key = jax.random.PRNGKey(3)
+        if dtype == jnp.float32:
+            payload = jax.random.normal(key, (n, k), dtype)
+        else:
+            payload = jax.random.randint(key, (n, k), 0, 2**31 - 1,
+                                         jnp.int32).astype(dtype)
+        ref = permutation_gather(payload, jn, rk, "scalar")
+        for mode in MODES[1:]:
+            out = permutation_gather(payload, jn, rk, mode)
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                          err_msg=mode)
+
+    def test_pallas_odd_shapes(self):
+        # n not divisible by the preferred block sizes
+        for n, k in [(24, 4), (8, 8), (72, 16)]:
+            nbr, rks = _random_edge_permutation(n, k, seed=n)
+            jn = jnp.clip(jnp.asarray(nbr), 0, n - 1)
+            rk = jnp.clip(jnp.asarray(rks), 0, k - 1)
+            payload = jax.random.randint(jax.random.PRNGKey(n), (n, k), 0,
+                                         2**31 - 1, jnp.int32)
+            a = permutation_gather(payload, jn, rk, "scalar")
+            b = permutation_gather(payload, jn, rk, "pallas")
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_resolve_mode_policy(self):
+        # auto: scalar on cpu; pallas ineligible when payload exceeds VMEM
+        assert resolve_mode("auto", jnp.uint32, 100, 8) in ("scalar", "rows")
+        assert resolve_mode("pallas", jnp.uint32, 1_000_000, 32) == "rows"
+        assert resolve_mode("pallas", jnp.uint32, 1000, 8) == "pallas"
+        # bool payloads can't ride the 32-bit kernel
+        assert resolve_mode("pallas", jnp.bool_, 1000, 8) == "rows"
+
+
+class TestEngineTrajectoryParity:
+    @pytest.mark.parametrize("scenario", ["default", "churn_flood"])
+    def test_full_ticks_identical(self, scenario):
+        from go_libp2p_pubsub_tpu.sim.engine import run
+
+        n, k = 192, 8
+        if scenario == "default":
+            cfg0 = SimConfig(n_peers=n, k_slots=k, n_topics=2, msg_window=16,
+                             publishers_per_tick=3, scoring_enabled=True)
+        else:
+            cfg0 = SimConfig(n_peers=n, k_slots=k, n_topics=2, msg_window=16,
+                             publishers_per_tick=3, scoring_enabled=True,
+                             flood_publish=True, churn_disconnect_prob=0.05,
+                             churn_reconnect_prob=0.3, retain_score_ticks=5,
+                             sub_leave_prob=0.02, sub_join_prob=0.05)
+        topo = topology.sparse(n, k, degree=5, seed=7)
+        tp = default_topic_params(2)
+        sub = np.ones((n, 2), bool)
+        outs = []
+        for mode in MODES:
+            cfg = type(cfg0)(**{**cfg0.__dict__, "edge_gather_mode": mode})
+            st = init_state(cfg, topo, subscribed=sub.copy())
+            st = run(st, cfg, tp, jax.random.PRNGKey(11), 5)
+            st.tick.block_until_ready()
+            outs.append(st)
+        for mode, st in zip(MODES[1:], outs[1:]):
+            for field, a, b in zip(outs[0]._fields, outs[0], st):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"{scenario}/{mode}: state.{field} diverged")

@@ -124,12 +124,15 @@ class TestPXDialGate:
         assert hc.peer_id not in ha.conns
 
     def test_mismatched_announced_id_produces_zero_dials(self):
-        """Valid envelope, but certifying a different peer than announced."""
-        net, (a, rt_a, ha), (b, _, hb), (c, _, hc) = self._net3()
-        # C's genuine record announced under B's id -> reject
-        rt_a.px_connect([PeerInfo(peer_id=hb.peer_id,
+        """Valid envelope, but certifying a different peer than announced —
+        the announced id must be a NON-peer so the check itself is hit."""
+        net, (a, rt_a, ha), _, (c, _, hc) = self._net3()
+        d, _, hd = _keyed_node(net, b"d")     # never connected to A
+        # C's genuine record announced under D's id -> reject, no dial
+        rt_a.px_connect([PeerInfo(peer_id=hd.peer_id,
                                   signed_peer_record=hc.local_record)])
         net.scheduler.run_for(1.0)
+        assert hd.peer_id not in ha.conns
         assert hc.peer_id not in ha.conns
 
     def test_valid_record_dials_and_persists(self):
