@@ -62,22 +62,19 @@ def unpack_words(p: jnp.ndarray, m: int) -> jnp.ndarray:
     return jnp.moveaxis(flat, 0, -1).astype(bool)
 
 
-def gather_words_rows(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int) -> jnp.ndarray:
+def gather_words_rows(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
+                      mode: str = "auto") -> jnp.ndarray:
     """out[w, k, n] = x_w[w, nbr[n, k]] — neighbor gather of packed words.
 
-    On TPU: unpack -> row gather -> repack, because XLA lowers the direct
-    per-word scalar-index gather (``x_w[i][nbr.T]``) to serialized scalar
-    loads (~5ms per 480k indices measured on v5e), while gathering [M]-lane
-    boolean rows hits the vector DMA path (~2.5x faster at 10k peers, wider
-    at 100k where the scalar form is ~3.2M loads per word). On CPU the
-    scalar-index gather is the fast path and the unpack/repack only adds
-    passes, so dispatch by backend.
+    Formulation per ``mode`` (ops/permgather.py gather_words): on TPU the
+    direct per-word scalar-index gather lowers to serialized scalar loads
+    (~5ms per 480k indices measured on v5e), so ``auto`` picks the
+    unpack/row-gather/repack form there (vector DMA path, ~2.5x faster at
+    10k peers) and the scalar form on CPU; ``pallas`` pins the packed table
+    in VMEM and skips the unpacked temporary entirely.
     """
-    if jax.default_backend() == "cpu":
-        return jnp.stack([x_w[i][nbr.T] for i in range(x_w.shape[0])])
-    planes = unpack_words(x_w, m)                    # [N, M] bool
-    rows = planes[nbr]                               # [N, K, M] row gather
-    return jnp.transpose(pack_bool(rows), (2, 1, 0))  # [W, K, N]
+    from .permgather import gather_words
+    return gather_words(x_w, nbr, m, mode)
 
 
 def reduce_or(x: jnp.ndarray, axis: int) -> jnp.ndarray:

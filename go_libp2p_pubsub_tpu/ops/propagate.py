@@ -284,7 +284,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # answer from their mcache, which rejected/ignored messages never enter
     # (deliver_tick stays NEVER on rejection — validation.go:293-370)
     answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)             # [W,N]
-    answers_k = gather_words_rows(answer_bits, nbr, m)                       # [W,K,N]
+    answers_k = gather_words_rows(answer_bits, nbr, m,
+                                  cfg.edge_gather_mode)             # [W,K,N]
     # pulled data is still data: graylist + gater admission apply, and pulls
     # are charged against the same per-edge and validation budgets as eager
     # traffic (an IHAVE-flooding adversary must not route unlimited data
@@ -359,7 +360,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         origin_bits = pack_words(
             (state.deliver_tick == state.tick)
             & (state.msg_publish_tick == state.tick)[None, :])
-        flood_offer = gather_words_rows(origin_bits, nbr, m) & flood_allowed
+        flood_offer = gather_words_rows(origin_bits, nbr, m,
+                                        cfg.edge_gather_mode) & flood_allowed
     else:
         flood_offer = None
 
@@ -387,7 +389,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         (i, frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
          dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
         is_first = i == 0
-        offered = gather_words_rows(frontier, nbr, m) & allowed              # [W,K,N]
+        offered = gather_words_rows(frontier, nbr, m,
+                                    cfg.edge_gather_mode) & allowed              # [W,K,N]
         if flood_offer is not None:
             offered = offered | jnp.where(is_first, flood_offer, U32(0))
         if cfg.edge_queue_cap > 0:
@@ -521,7 +524,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # malicious peers advertise everything alive (IHAVE flood)
     window_bits = jnp.where(mal[None, :], alive_bits[:, None], window_bits)
     gossip_allowed = _edge_topic_bits(inc_gossip, topic_bits, w)        # [W,K,N]
-    offer = gather_words_rows(window_bits, nbr, m) & gossip_allowed
+    offer = gather_words_rows(window_bits, nbr, m,
+                              cfg.edge_gather_mode) & gossip_allowed
     if cfg.max_iwant_per_tick >= m:
         # a sender can offer at most M ids per tick, so the iasked budget
         # cannot bind: pick the lowest offering slot per message

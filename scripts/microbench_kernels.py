@@ -151,12 +151,22 @@ def main():
         rows = wds.T[nbr]                                   # [N,K,W]
         return rows
 
+    from go_libp2p_pubsub_tpu.ops.permgather import (
+        gather_words, resolve_words_mode)
+    words_resolved = resolve_words_mode("pallas", w, n, k)
+
+    def gw_pallas(wds):
+        return gather_words(wds, nbr, m, "pallas")
+
+    assert bool(jnp.all(gw_pallas(words) == gw_words(words)))
     scan_time(gw_words, (gw_words(words), words),
               "msg gather: per-word scalar [W,K,N]")
     scan_time(gw_rows_i8, (gw_rows_i8(planes), planes),
               "msg gather: row-major i8 [N,K,M]")
     scan_time(gw_rows_u32, (gw_rows_u32(words), words),
               "msg gather: row-major u32 [N,K,W]")
+    scan_time(gw_pallas, (gw_pallas(words), words),
+              f"msg gather: pallas (resolved: {words_resolved})")
 
     # ---------- OR-reduce over K after row gather ----------
     rows_i8 = gw_rows_i8(planes)

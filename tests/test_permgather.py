@@ -69,6 +69,30 @@ class TestOpParity:
         assert resolve_mode("pallas", jnp.bool_, 1000, 8) == "rows"
 
 
+class TestWordsGatherParity:
+    def test_modes_bit_identical(self):
+        from go_libp2p_pubsub_tpu.ops.bits import (
+            gather_words_rows, pack_words)
+
+        n, k, m = 192, 8, 64
+        nbr, _ = _random_edge_permutation(n, k, seed=2)
+        nbr = jnp.clip(jnp.asarray(nbr), 0, n - 1)
+        planes = np.asarray(
+            jax.random.uniform(jax.random.PRNGKey(4), (n, m)) < 0.3)
+        x_w = pack_words(jnp.asarray(planes))              # [W, N]
+        ref = gather_words_rows(x_w, nbr, m, "scalar")
+        for mode in ("rows", "pallas"):
+            out = gather_words_rows(x_w, nbr, m, mode)
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                          err_msg=mode)
+
+    def test_resolve_words_policy(self):
+        from go_libp2p_pubsub_tpu.ops.permgather import resolve_words_mode
+        assert resolve_words_mode("pallas", 2, 1024, 8) == "pallas"
+        # table too big for VMEM -> rows
+        assert resolve_words_mode("pallas", 64, 1_000_000, 8) == "rows"
+
+
 class TestEngineTrajectoryParity:
     @pytest.mark.parametrize("scenario", ["default", "churn_flood"])
     def test_full_ticks_identical(self, scenario):
