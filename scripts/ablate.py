@@ -144,6 +144,48 @@ def main():
                           + 0.0 * fm.sum().astype(jnp.float32))
     scan_time(ph_fwd_mask, st, iters, label="edge_forward_mask")
 
+    # -- heartbeat internals: the selection kernels at real shapes (CPU
+    # profiling shows these dominate the steady-state heartbeat there;
+    # this tells us whether the chip agrees) --
+    from go_libp2p_pubsub_tpu.ops.selection import select_random, select_top
+
+    def fold(s, x):
+        return s._replace(behaviour_penalty=s.behaviour_penalty
+                          + 0.0 * x.sum().astype(jnp.float32))
+
+    # scores precomputed OUTSIDE the timed body (hb pattern above) so the
+    # phase measures ONLY the selection kernel, not compute_scores
+    sc_btk = jax.jit(lambda s: jnp.broadcast_to(
+        compute_scores(s, cfg, tp)[:, None, :], (n, t, k)))(st)
+    jax.block_until_ready(sc_btk)
+
+    def ph_sel_top(s, k_):
+        return fold(s, select_top(sc_btk, s.mesh,
+                                  jnp.full((n, t), cfg.dscore)))
+    scan_time(ph_sel_top, st, iters, label="1x select_top [N,T,K]")
+
+    def ph_sel_rand(s, k_):
+        return fold(s, select_random(s.mesh, jnp.full((n, t), cfg.d), k_))
+    scan_time(ph_sel_rand, st, iters, label="1x select_random [N,T,K]")
+
+    # -- permutation-gather formulation sweep at real shapes --
+    from go_libp2p_pubsub_tpu.ops.permgather import (
+        resolve_mode, resolve_words_mode)
+    for mode in ("scalar", "rows", "pallas"):
+        rw = resolve_words_mode(mode, w, n, k)
+        re_ = resolve_mode(mode, jnp.uint32, n, k)
+
+        def ph_g(s, k_, mode=mode):
+            hv = pack_words(s.have)
+            return fold(s, gather_words_rows(hv, nbr, m, mode))
+        scan_time(ph_g, st, iters,
+                  label=f"word-gather[{mode}->{rw}]")
+
+        def ph_e(s, k_, mode=mode):
+            return fold(s, edge_gather(s.mesh, s, mode=mode))
+        scan_time(ph_e, st, iters,
+                  label=f"edge-gather[{mode}->{re_}]")
+
 
 if __name__ == "__main__":
     main()

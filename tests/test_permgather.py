@@ -93,6 +93,43 @@ class TestWordsGatherParity:
         assert resolve_words_mode("pallas", 64, 1_000_000, 8) == "rows"
 
 
+class TestShardedStepParity:
+    def test_modes_compose_with_spmd(self):
+        """Every gather formulation must compile AND execute under the
+        peer-sharded step (the SPMD partitioner meets the pallas_call /
+        row-gather graphs when the TPU auto default flips) and produce the
+        same trajectory as the scalar form."""
+        import dataclasses
+
+        from go_libp2p_pubsub_tpu.parallel.sharding import (
+            make_mesh, make_sharded_step, shard_state)
+
+        devices = jax.devices()
+        if len(devices) < 8:
+            pytest.skip("needs the 8-device virtual mesh")
+        base = SimConfig(n_peers=128, k_slots=8, n_topics=1, msg_window=16,
+                         publishers_per_tick=2, scoring_enabled=True)
+        topo = topology.sparse(128, 8, degree=4, seed=2)
+        tp = default_topic_params(1)
+        ref = None
+        for mode in MODES:
+            cfg = dataclasses.replace(base, edge_gather_mode=mode)
+            st = init_state(cfg, topo,
+                            subscribed=np.ones((128, 1), bool))
+            mesh = make_mesh(devices[:8])
+            st = shard_state(st, mesh, cfg)
+            step = make_sharded_step(mesh, cfg, tp)
+            out = st
+            for i in range(3):
+                out = step(out, jax.random.PRNGKey(i))
+            out.tick.block_until_ready()
+            obs = (int(out.tick), int(np.asarray(out.have).sum()),
+                   float(np.asarray(out.first_message_deliveries).sum()))
+            if ref is None:
+                ref = obs
+            assert obs == ref, f"{mode} diverged under sharding"
+
+
 class TestEngineTrajectoryParity:
     @pytest.mark.parametrize("scenario", ["default", "churn_flood"])
     def test_full_ticks_identical(self, scenario):
