@@ -76,11 +76,39 @@ def main():
         thr = jnp.take_along_axis(srt, idx[..., None], axis=-1)
         return mask & (keys >= thr) & (count[..., None] > 0)
 
+    def sel_iter(score, mask, count, max_count=12):
+        # O(c*K) iterative argmax: c sequential first-occurrence maxima,
+        # exact tie parity with ranks_desc (lower index wins). Candidate
+        # for counts << K (heartbeat counts are <= Dhi=12 vs K=48).
+        keys = jnp.where(mask, score, -1e30)
+
+        def body(i, carry):
+            sel, rem = carry
+            idx = jnp.argmax(rem, axis=-1)
+            take = (i < count) & jnp.take_along_axis(
+                mask, idx[..., None], axis=-1)[..., 0]
+            onehot = (jnp.arange(k)[None, None, :] == idx[..., None]) \
+                & take[..., None]
+            return sel | onehot, jnp.where(onehot, -1e30, rem)
+
+        sel, _ = jax.lax.fori_loop(
+            0, max_count, body, (jnp.zeros_like(mask), keys))
+        return sel
+
     a = sel_ranks(score, mask, count)
     b = sel_sort(score, mask, count)
+    # the iterative form only applies when counts are bounded << K (true
+    # for every heartbeat selection: counts <= Dhi=12); bench it at the
+    # engine's real count regime
+    count_small = jnp.minimum(count, 12)
+    a_small = sel_ranks(score, mask, count_small)
+    c_ = sel_iter(score, mask, count_small)
     assert bool(jnp.all(a == b)), "sort-threshold != ranks selection"
+    assert bool(jnp.all(a_small == c_)), "iterative != ranks selection"
     scan_time(sel_ranks, (a, score, mask, count), "select: O(K^2) ranks")
     scan_time(sel_sort, (a, score, mask, count), "select: sort+threshold")
+    scan_time(sel_iter, (a_small, score, mask, count_small),
+              "select: O(c*K) iter c<=12")
 
     # ---------- edge gather [N,T,K] ----------
     def eg_adv(x):
