@@ -76,10 +76,12 @@ def main():
         thr = jnp.take_along_axis(srt, idx[..., None], axis=-1)
         return mask & (keys >= thr) & (count[..., None] > 0)
 
-    def sel_iter(score, mask, count, max_count=12):
+    from go_libp2p_pubsub_tpu.core.params import GOSSIPSUB_DHI
+
+    def sel_iter(score, mask, count, max_count=GOSSIPSUB_DHI):
         # O(c*K) iterative argmax: c sequential first-occurrence maxima,
         # exact tie parity with ranks_desc (lower index wins). Candidate
-        # for counts << K (heartbeat counts are <= Dhi=12 vs K=48).
+        # for counts << K (heartbeat counts are <= Dhi vs K=48).
         keys = jnp.where(mask, score, -1e30)
 
         def body(i, carry):
@@ -100,7 +102,7 @@ def main():
     # the iterative form only applies when counts are bounded << K (true
     # for every heartbeat selection: counts <= Dhi=12); bench it at the
     # engine's real count regime
-    count_small = jnp.minimum(count, 12)
+    count_small = jnp.minimum(count, GOSSIPSUB_DHI)
     a_small = sel_ranks(score, mask, count_small)
     c_ = sel_iter(score, mask, count_small)
     assert bool(jnp.all(a == b)), "sort-threshold != ranks selection"
@@ -108,7 +110,7 @@ def main():
     scan_time(sel_ranks, (a, score, mask, count), "select: O(K^2) ranks")
     scan_time(sel_sort, (a, score, mask, count), "select: sort+threshold")
     scan_time(sel_iter, (a_small, score, mask, count_small),
-              "select: O(c*K) iter c<=12")
+              f"select: O(c*K) iter c<={GOSSIPSUB_DHI}")
 
     # ---------- edge gather [N,T,K] ----------
     def eg_adv(x):

@@ -1,0 +1,75 @@
+"""Unit tests for the shared wedge-proofing helpers (utils/platform_probe)
+and the slow-heartbeat warning (gossipsub.go:1346-1354 parity)."""
+
+import logging
+
+from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+from go_libp2p_pubsub_tpu.core.params import GossipSubParams
+from go_libp2p_pubsub_tpu.net import Network
+from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+from go_libp2p_pubsub_tpu.utils.platform_probe import (
+    cpu_mesh_env,
+    forced_cpu_device_count,
+)
+
+
+class TestCpuMeshEnv:
+    def test_forces_cpu_and_disables_plugin(self):
+        env = cpu_mesh_env({"XLA_FLAGS": "--foo", "OTHER": "1"})
+        assert env["JAX_PLATFORMS"] == "cpu"
+        assert env["PALLAS_AXON_POOL_IPS"] == ""
+        assert env["OTHER"] == "1"
+        assert env["XLA_FLAGS"] == "--foo"      # no device count requested
+
+    def test_device_count_appended(self):
+        env = cpu_mesh_env({"XLA_FLAGS": "--foo"}, 8)
+        assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+        assert "--foo" in env["XLA_FLAGS"]
+
+    def test_does_not_mutate_input(self):
+        src = {"XLA_FLAGS": "--foo"}
+        cpu_mesh_env(src, 4)
+        assert src == {"XLA_FLAGS": "--foo"}
+
+
+class TestForcedCpuDeviceCount:
+    def test_default_is_one(self):
+        assert forced_cpu_device_count({}) == 1
+        assert forced_cpu_device_count({"XLA_FLAGS": "--other"}) == 1
+
+    def test_parses_flag(self):
+        env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=8"}
+        assert forced_cpu_device_count(env) == 8
+
+    def test_last_flag_wins(self):
+        env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=4 "
+                            "--xla_force_host_platform_device_count=16"}
+        assert forced_cpu_device_count(env) == 16
+
+
+class TestSlowHeartbeatWarning:
+    def _net(self, warning_ratio):
+        net = Network()
+        params = GossipSubParams(slow_heartbeat_warning=warning_ratio)
+        nodes = [PubSub(net.add_host(), GossipSubRouter(params=params),
+                        sign_policy=LAX_NO_SIGN) for _ in range(4)]
+        net.dense_connect([x.host for x in nodes], degree=3)
+        net.scheduler.run_for(0.1)
+        for x in nodes:
+            x.join("t").subscribe()
+        return net
+
+    def test_warns_when_heartbeat_slow(self, caplog):
+        # ratio so small that ANY wall-clock heartbeat exceeds it
+        net = self._net(1e-12)
+        with caplog.at_level(logging.WARNING,
+                             logger="go_libp2p_pubsub_tpu.routers.gossipsub"):
+            net.scheduler.run_until(2.5)
+        assert any("slow heartbeat" in r.message for r in caplog.records)
+
+    def test_silent_when_disabled(self, caplog):
+        net = self._net(0.0)
+        with caplog.at_level(logging.WARNING,
+                             logger="go_libp2p_pubsub_tpu.routers.gossipsub"):
+            net.scheduler.run_until(2.5)
+        assert not any("slow heartbeat" in r.message for r in caplog.records)
