@@ -105,6 +105,13 @@ def run_scenario(name: str) -> None:
             "info": "edge_gather sweep", "requested": mode,
             "resolved": resolve_mode(mode, jnp.uint32, cfg.n_peers,
                                      cfg.k_slots)}), flush=True)
+    sel = os.environ.get("GRAFT_SELECTION")
+    if sel:
+        # selection-kernel sweep knob (ops/selection.py)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, selection_mode=sel)
+        print(json.dumps({"info": "selection sweep", "requested": sel}),
+              flush=True)
     bench_one(_label(name), cfg, tp, st, ticks)
 
 

@@ -17,6 +17,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
@@ -132,7 +133,8 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     need = jnp.where(n_mesh < cfg.dlo, cfg.d - n_mesh, 0)
     graft1 = jax.lax.cond(
         jnp.any((need > 0) & jnp.any(candidate, -1)),
-        lambda: select_random(candidate, need, ks[0]),
+        lambda: select_random(candidate, need, ks[0],
+                              max_count=cfg.d, mode=cfg.selection_mode),
         lambda: jnp.zeros_like(candidate))
     mesh2 = mesh1 | graft1
 
@@ -142,16 +144,21 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     over = (n2 > cfg.dhi)[..., None]
 
     def _over_block():
-        protected = select_top(sb, mesh2, jnp.full((n, t), cfg.dscore))
+        protected = select_top(sb, mesh2, jnp.full((n, t), cfg.dscore),
+                               max_count=cfg.dscore, mode=cfg.selection_mode)
         rest = mesh2 & ~protected
         keep_rand = select_random(rest, jnp.full((n, t), cfg.d - cfg.dscore),
-                                  ks[1])
+                                  ks[1], max_count=cfg.d - cfg.dscore,
+                                  mode=cfg.selection_mode)
         kept = protected | keep_rand
         n_out_kept = jnp.sum(kept & out3, axis=-1)
         deficit_out = jnp.clip(cfg.dout - n_out_kept, 0)
-        add_out = select_random(mesh2 & ~kept & out3, deficit_out, ks[2])
+        add_out = select_random(mesh2 & ~kept & out3, deficit_out, ks[2],
+                                max_count=cfg.dout, mode=cfg.selection_mode)
         remove_nonout = select_random(keep_rand & ~out3,
-                                      jnp.sum(add_out, axis=-1), ks[3])
+                                      jnp.sum(add_out, axis=-1), ks[3],
+                                      max_count=cfg.dout,
+                                      mode=cfg.selection_mode)
         return (kept | add_out) & ~remove_nonout
 
     kept = jax.lax.cond(jnp.any(over), _over_block, lambda: mesh2)
@@ -166,7 +173,8 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     out_cand = candidate & out3 & ~mesh3
     graft_out = jax.lax.cond(
         jnp.any((need_out > 0) & jnp.any(out_cand, -1)),
-        lambda: select_random(out_cand, need_out, ks[4]),
+        lambda: select_random(out_cand, need_out, ks[4],
+                              max_count=cfg.dout, mode=cfg.selection_mode),
         lambda: jnp.zeros_like(mesh3))
     mesh4 = mesh3 | graft_out
 
@@ -180,7 +188,9 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
             (med < cfg.opportunistic_graft_threshold)
         og_need = jnp.where(og_cond, cfg.opportunistic_graft_peers, 0)
         return select_random(candidate & (sb > med[..., None]) & ~mesh4,
-                             og_need, ks[5])
+                             og_need, ks[5],
+                             max_count=cfg.opportunistic_graft_peers,
+                             mode=cfg.selection_mode)
 
     og_sel = jax.lax.cond(og_tick, _og_block, lambda: jnp.zeros_like(mesh4))
     mesh5 = mesh4 | og_sel
@@ -239,7 +249,7 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
         add_f = select_random(
             conn & nbr_sub & ~keep_f & ~direct3
             & (s >= cfg.publish_threshold) & fa3,
-            need_f, ks[7])
+            need_f, ks[7], max_count=cfg.d, mode=cfg.selection_mode)
         return keep_f | add_f
 
     new_fanout = jax.lax.cond(jnp.any(fanout_alive), _fanout_block,
@@ -263,7 +273,15 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     n_cand = jnp.sum(gossip_cand, axis=-1)
     target = jnp.maximum(cfg.dlazy,
                          jnp.floor(cfg.gossip_factor * n_cand).astype(jnp.int32))
-    gossip_sel = select_random(gossip_cand, target, ks[6])
+    # static bound: target = max(Dlazy, floor(factor * n_cand)), n_cand <= K.
+    # Derived in the SAME f32 arithmetic as the traced target so the bound
+    # can never round below it (f64 int(factor*k) can be one less than
+    # f32 floor(f32(factor)*k) when factor sits just under a binary tick)
+    gossip_bound = max(cfg.dlazy, int(np.floor(
+        np.float32(cfg.gossip_factor) * np.float32(k))))
+    gossip_sel = select_random(gossip_cand, target, ks[6],
+                               max_count=gossip_bound,
+                               mode=cfg.selection_mode)
 
     # one shared permutation gather hands forward_tick its receiver views:
     # who gossips to me, and whose eager forwarding reaches me

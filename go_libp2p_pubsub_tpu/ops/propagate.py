@@ -141,7 +141,9 @@ def _edge_forward_mask(state: SimState, cfg: SimConfig, key: jax.Array,
         # from the receiver through the edge permutation
         target = max(cfg.d, math.ceil(math.sqrt(cfg.n_peers)))
         cand = state.connected[:, None, :] & state.nbr_subscribed   # sender view
-        sel = select_random(cand, jnp.full((n, t), target), key)
+        sel = select_random(cand, jnp.full((n, t), target), key,
+                            max_count=min(target, cfg.k_slots),
+                            mode=cfg.selection_mode)
         return edge_gather(sel, state,
                            mode=cfg.edge_gather_mode) & conn & my_sub
     raise ValueError(f"unknown router {cfg.router!r}")

@@ -31,16 +31,62 @@ def ranks_desc(keys: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(beats, axis=-1)
 
 
-def _select_by_keys(keys: jnp.ndarray, mask: jnp.ndarray,
-                    count: jnp.ndarray) -> jnp.ndarray:
-    """Top-``count`` by key per row, masked. Two formulations with
-    identical results on distinct keys (ties occur only between masked
-    NEG_INF entries, which are excluded): the fused O(K^2) comparison rank
-    wins on TPU (no [..., K, K] materialization survives fusion), a sort +
-    per-row threshold wins on CPU where the comparison matrix is ~30%
-    slower at beacon shapes (scripts/microbench_kernels.py)."""
+def resolve_selection_mode(mode: str, k: int,
+                           max_count: int | None = None) -> str:
+    """Resolve ``auto``/ineligible selection-mode requests.
+
+    ``iter`` needs a static ``max_count`` bound and only pays off while the
+    bound is well under K (its cost is max_count sequential argmax passes).
+    """
+    backend = jax.default_backend()
+    if mode == "auto":
+        if backend == "cpu":
+            mode = "iter" if (max_count is not None and 2 * max_count <= k) \
+                else "sort"
+        else:
+            mode = "ranks"     # measured-safe TPU default until the chip
+                               # recheck promotes a formulation
+    if mode == "iter" and (max_count is None or max_count >= k):
+        return "ranks" if backend != "cpu" else "sort"
+    return mode
+
+
+def _select_iter(keys: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray,
+                 max_count: int) -> jnp.ndarray:
+    """O(max_count * K): sequential first-occurrence maxima. Bit-identical
+    to the rank form for keys where every unmasked entry is > NEG_INF
+    (true for both producers: uniform noise in [0, 1) and bounded scores)."""
     k = keys.shape[-1]
-    if jax.default_backend() == "cpu":
+
+    def body(i, carry):
+        sel, rem = carry
+        idx = jnp.argmax(rem, axis=-1)
+        take = (i < count) & jnp.take_along_axis(
+            mask, idx[..., None], axis=-1)[..., 0]
+        onehot = (jnp.arange(k) == idx[..., None]) & take[..., None]
+        return sel | onehot, jnp.where(onehot, NEG_INF, rem)
+
+    sel, _ = jax.lax.fori_loop(0, max_count, body,
+                               (jnp.zeros_like(mask), keys))
+    return sel
+
+
+def _select_by_keys(keys: jnp.ndarray, mask: jnp.ndarray,
+                    count: jnp.ndarray, *, max_count: int | None = None,
+                    mode: str = "auto") -> jnp.ndarray:
+    """Top-``count`` by key per row, masked. Three formulations with
+    identical results (ties break toward the lower slot in all of them):
+    the fused O(K^2) comparison rank wins on TPU (no [..., K, K]
+    materialization survives fusion); a sort + per-row threshold and an
+    O(c*K) iterative argmax (for statically count-bounded callers — every
+    heartbeat selection is bounded by a degree param <= Dhi) compete on
+    CPU, where iter measured 1.7x over sort at beacon shapes
+    (scripts/microbench_kernels.py)."""
+    k = keys.shape[-1]
+    mode = resolve_selection_mode(mode, k, max_count)
+    if mode == "iter":
+        return _select_iter(keys, mask, count, max_count)
+    if mode == "sort":
         # exact tie handling (float32 keys DO collide at 4M draws/call)
         # without x64: lexicographic two-key sort on (inverted sortable
         # bits, slot), so equal keys break toward the lower slot — the
@@ -56,21 +102,29 @@ def _select_by_keys(keys: jnp.ndarray, mask: jnp.ndarray,
         s_thr = jnp.take_along_axis(ss, idx, axis=-1)
         sel = (p < p_thr) | ((p == p_thr) & (slot <= s_thr))
         return mask & sel & (count[..., None] > 0)
-    r = ranks_desc(keys)
-    return (r < count[..., None]) & mask
+    if mode == "ranks":
+        r = ranks_desc(keys)
+        return (r < count[..., None]) & mask
+    raise ValueError(f"unknown selection mode {mode!r}")
 
 
-def select_random(mask: jnp.ndarray, count: jnp.ndarray, key: jax.Array) -> jnp.ndarray:
+def select_random(mask: jnp.ndarray, count: jnp.ndarray, key: jax.Array, *,
+                  max_count: int | None = None,
+                  mode: str = "auto") -> jnp.ndarray:
     """Uniformly choose up to ``count`` True positions per row of ``mask``.
 
     count broadcasts against mask.shape[:-1]. Ties impossible w.p. 1.
+    ``max_count`` is a static upper bound on count enabling the iterative
+    formulation; ``mode`` picks it explicitly (SimConfig.selection_mode).
     """
     noise = jax.random.uniform(key, mask.shape)
     keys = jnp.where(mask, noise, NEG_INF)
-    return _select_by_keys(keys, mask, count)
+    return _select_by_keys(keys, mask, count, max_count=max_count, mode=mode)
 
 
-def select_top(score: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray) -> jnp.ndarray:
+def select_top(score: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray, *,
+               max_count: int | None = None,
+               mode: str = "auto") -> jnp.ndarray:
     """Choose up to ``count`` highest-score True positions per row.
 
     Deterministic tie-break by slot index (lower slot wins), mirroring the
@@ -79,7 +133,7 @@ def select_top(score: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray) -> jnp
     k = mask.shape[-1]
     tiebreak = -jnp.arange(k, dtype=jnp.float32) * 1e-9
     keys = jnp.where(mask, score + tiebreak, NEG_INF)
-    return _select_by_keys(keys, mask, count)
+    return _select_by_keys(keys, mask, count, max_count=max_count, mode=mode)
 
 
 def masked_median(values: jnp.ndarray, mask: jnp.ndarray) -> jnp.ndarray:

@@ -94,6 +94,10 @@ class SimConfig:
     # "auto" (backend default) | "scalar" | "rows" | "pallas"
     edge_gather_mode: str = "auto"
 
+    # masked selection formulation (ops/selection.py):
+    # "auto" (backend default) | "ranks" | "sort" | "iter"
+    selection_mode: str = "auto"
+
     # record delivery provenance (msg_publisher / deliver_from) so a run can
     # be exported as a pb/trace event stream (sim/trace_export.py); when on
     # it costs a bit-plane decode + two scatters per tick, when off

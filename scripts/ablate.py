@@ -159,13 +159,19 @@ def main():
         compute_scores(s, cfg, tp)[:, None, :], (n, t, k)))(st)
     jax.block_until_ready(sc_btk)
 
+    # mode/bounds mirror the engine's own calls (heartbeat.py) so the
+    # phase times the formulation the engine actually runs
     def ph_sel_top(s, k_):
         return fold(s, select_top(sc_btk, s.mesh,
-                                  jnp.full((n, t), cfg.dscore)))
+                                  jnp.full((n, t), cfg.dscore),
+                                  max_count=cfg.dscore,
+                                  mode=cfg.selection_mode))
     scan_time(ph_sel_top, st, iters, label="1x select_top [N,T,K]")
 
     def ph_sel_rand(s, k_):
-        return fold(s, select_random(s.mesh, jnp.full((n, t), cfg.d), k_))
+        return fold(s, select_random(s.mesh, jnp.full((n, t), cfg.d), k_,
+                                     max_count=cfg.d,
+                                     mode=cfg.selection_mode))
     scan_time(ph_sel_rand, st, iters, label="1x select_random [N,T,K]")
 
     # -- permutation-gather formulation sweep at real shapes --

@@ -13,6 +13,8 @@ for step in "microbench_beacon:python scripts/microbench_kernels.py 10000 9 48 6
             "modes_rows:env GRAFT_EDGE_GATHER=rows BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_pallas:env GRAFT_EDGE_GATHER=pallas BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_scalar:env GRAFT_EDGE_GATHER=scalar BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "sel_iter:env GRAFT_SELECTION=iter BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "sel_ranks:env GRAFT_SELECTION=ranks BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "bench:python bench.py"; do
   name="${step%%:*}"; cmd="${step#*:}"
   echo "== $name: $cmd =="

@@ -1,0 +1,117 @@
+"""Selection formulations must be bit-identical (ops/selection.py).
+
+Like the permutation-gather modes, the masked-selection kernels have
+backend-tuned formulations (O(K^2) ranks, sort+threshold, O(c*K) iterative
+argmax); the engine trajectory is the contract, so every mode is diffed
+against the ranks reference at op level (including deliberate key ties) and
+over full engine ticks.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.ops.selection import (
+    _select_by_keys,
+    resolve_selection_mode,
+    select_random,
+    select_top,
+)
+from go_libp2p_pubsub_tpu.sim import SimConfig, init_state, topology
+from go_libp2p_pubsub_tpu.sim.scenarios import default_topic_params
+
+MODES = ["ranks", "sort", "iter"]
+
+
+class TestOpParity:
+    def test_random_keys(self):
+        n, t, k = 128, 3, 16
+        key = jax.random.PRNGKey(0)
+        mask = jax.random.uniform(jax.random.PRNGKey(1), (n, t, k)) < 0.5
+        score = jax.random.normal(key, (n, t, k))
+        count = jax.random.randint(jax.random.PRNGKey(2), (n, t), 0, 7)
+        ref = select_top(score, mask, count, mode="ranks")
+        for mode in ("sort", "iter"):
+            out = select_top(score, mask, count, max_count=6, mode=mode)
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                          err_msg=mode)
+
+    def test_tied_keys_break_to_lower_slot(self):
+        """Duplicate keys across slots: all modes must pick the lower slot."""
+        k = 8
+        keys = jnp.array([[1.0, 2.0, 2.0, 1.0, 2.0, 0.5, -1e30, 2.0]])
+        mask = jnp.array([[True] * 6 + [False, True]])
+        count = jnp.array([3])
+        ref = _select_by_keys(keys, mask, count, mode="ranks")
+        # ranks: the three lowest-index 2.0s -> slots 1, 2, 4
+        np.testing.assert_array_equal(
+            np.asarray(ref)[0],
+            [False, True, True, False, True, False, False, False])
+        for mode in ("sort", "iter"):
+            out = _select_by_keys(keys, mask, count, max_count=4, mode=mode)
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                          err_msg=mode)
+
+    def test_count_exceeds_candidates(self):
+        keys = jnp.array([[3.0, 1.0, 2.0, 0.0]])
+        mask = jnp.array([[True, False, True, False]])
+        count = jnp.array([4])
+        ref = _select_by_keys(keys, mask, count, mode="ranks")
+        np.testing.assert_array_equal(np.asarray(ref)[0],
+                                      [True, False, True, False])
+        for mode in ("sort", "iter"):
+            out = _select_by_keys(keys, mask, count, max_count=4, mode=mode)
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                          err_msg=mode)
+
+    def test_select_random_parity(self):
+        n, t, k = 256, 2, 16
+        mask = jax.random.uniform(jax.random.PRNGKey(3), (n, t, k)) < 0.6
+        count = jnp.full((n, t), 5)
+        key = jax.random.PRNGKey(7)
+        ref = select_random(mask, count, key, mode="ranks")
+        for mode in ("sort", "iter"):
+            out = select_random(mask, count, key, max_count=5, mode=mode)
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                          err_msg=mode)
+
+    def test_resolver_policy(self):
+        # iter requires a static bound well under K
+        assert resolve_selection_mode("iter", 16, None) in ("ranks", "sort")
+        assert resolve_selection_mode("iter", 16, 16) in ("ranks", "sort")
+        assert resolve_selection_mode("iter", 16, 6) == "iter"
+        if jax.default_backend() == "cpu":
+            # cpu auto prefers iter only when bounded
+            assert resolve_selection_mode("auto", 48, 12) == "iter"
+            assert resolve_selection_mode("auto", 48, None) == "sort"
+        else:
+            assert resolve_selection_mode("auto", 48, 12) == "ranks"
+
+
+class TestEngineTrajectoryParity:
+    @pytest.mark.parametrize("router", ["gossipsub", "randomsub"])
+    def test_full_ticks_identical(self, router):
+        from go_libp2p_pubsub_tpu.sim.engine import run
+
+        n, k = 192, 8
+        cfg0 = SimConfig(n_peers=n, k_slots=k, n_topics=2, msg_window=16,
+                         publishers_per_tick=3, scoring_enabled=True,
+                         router=router)
+        topo = topology.sparse(n, k, degree=5, seed=7)
+        tp = default_topic_params(2)
+        sub = np.ones((n, 2), bool)
+        outs = []
+        for mode in MODES:
+            cfg = dataclasses.replace(cfg0, selection_mode=mode)
+            st = init_state(cfg, topo, subscribed=sub.copy())
+            st = run(st, cfg, tp, jax.random.PRNGKey(11), 6)
+            st.tick.block_until_ready()
+            outs.append(st)
+        for mode, st in zip(MODES[1:], outs[1:]):
+            for field, a, b in zip(outs[0]._fields, outs[0], st):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"{router}/{mode}: state.{field} diverged")
