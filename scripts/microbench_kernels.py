@@ -23,6 +23,10 @@ def scan_time(fn, args, label):
     @jax.jit
     def many(a):
         def body(c, _):
+            # optimization_barrier ties the inputs to the loop carry:
+            # without it XLA hoists the (loop-invariant) computation out of
+            # the scan and the harness under-reports by ~ITERS x
+            c = jax.lax.optimization_barrier(c)
             out = fn(*c[1:]) if isinstance(c, tuple) else fn(c)
             # fold output back into carry position 0 to serialize iterations
             return (out, *c[1:]) if isinstance(c, tuple) else out, None
