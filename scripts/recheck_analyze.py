@@ -1,0 +1,125 @@
+"""Summarize a scripts/tpu_recheck.sh run into decisions.
+
+Reads the per-step logs (default /tmp/tpu_recheck) and prints:
+  - the bench table (scenario -> hb/s, platform, delivery), sweeps included;
+  - per-family sweep winners (edge-gather modes vs selection modes are
+    separate sweeps; a cross-family comparison would be meaningless);
+  - the microbench candidate rankings per shape;
+  - where to flip the `auto` defaults (ops/permgather.resolve_mode /
+    resolve_words_mode, ops/selection.resolve_selection_mode).
+
+Failed runs (bench error lines, value 0.0) are shown as FAILED and
+excluded from winner sets; scenarios keep their [platform] tag so a
+mid-run CPU fallback can never be compared against TPU numbers.
+
+Usage: python scripts/recheck_analyze.py [log_dir]
+"""
+
+import json
+import os
+import re
+import sys
+
+# sweep step -> (family, mode label)
+SWEEP_STEPS = {
+    "modes_rows": ("edge_gather", "rows"),
+    "modes_pallas": ("edge_gather", "pallas"),
+    "modes_scalar": ("edge_gather", "scalar"),
+    "sel_iter": ("selection", "iter"),
+    "sel_ranks": ("selection", "ranks"),
+    "bench": ("auto", "auto"),
+}
+
+
+def parse_bench_log(path: str):
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    for line in open(path):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+    return rows
+
+
+def parse_microbench(path: str):
+    rows = []
+    if not os.path.exists(path):
+        return rows
+    shape = None
+    for line in open(path):
+        m = re.match(r"== (N=\S+ T=\S+ K=\S+ M=\S+ W=\S+) on (\S+) ==", line)
+        if m:
+            shape = f"{m.group(1)} [{m.group(2)}]"
+            continue
+        m = re.match(r"(.+?)\s{2,}([\d.]+) ms$", line.rstrip())
+        if m and shape:
+            rows.append((shape, m.group(1).strip(), float(m.group(2))))
+    return rows
+
+
+def main():
+    log_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_recheck"
+
+    print("== bench lines ==")
+    # (family, scenario-with-platform) -> {mode: hb/s}
+    sweeps: dict[tuple[str, str], dict[str, float]] = {}
+    for step, (family, mode) in SWEEP_STEPS.items():
+        for d in parse_bench_log(os.path.join(log_dir, f"{step}.log")):
+            if d.get("info", "").endswith("sweep"):
+                print(f"  [{step}] requested={d.get('requested')} "
+                      f"resolved={d.get('resolved', '-')}")
+            elif "metric" in d:
+                failed = "error" in d
+                tag = f"  FAILED: {d['error']}" if failed else ""
+                print(f"  [{step}] {d['metric']:45s} {d['value']:>10} "
+                      f"{d.get('unit', '')}{tag}")
+                if not failed:
+                    # keep the [platform] suffix: a mid-run CPU fallback
+                    # must never be compared against TPU numbers
+                    scen = d["metric"].split("@")[-1]
+                    sweeps.setdefault((family, scen), {})[mode] = d["value"]
+
+    print("\n== sweep winners (per family, per scenario+platform) ==")
+    auto = {scen: v.get("auto") for (fam, scen), v in sweeps.items()
+            if fam == "auto"}
+    for (family, scen), by_mode in sorted(sweeps.items()):
+        if family == "auto" or not by_mode:
+            continue
+        ranked = sorted(by_mode.items(), key=lambda kv: -kv[1])
+        base = f"; current auto: {auto[scen]}" if auto.get(scen) else ""
+        print(f"  {family:12s} {scen:28s} -> {ranked[0][0]} "
+              f"({ranked[0][1]} hb/s) of "
+              f"{{{', '.join(f'{k}:{v}' for k, v in ranked)}}}{base}")
+
+    print("\n== microbench rankings ==")
+    groups: dict[tuple[str, str], list[tuple[str, float]]] = {}
+    for log in ("microbench_beacon", "microbench_100k"):
+        for shape, label, ms in parse_microbench(
+                os.path.join(log_dir, f"{log}.log")):
+            fam = ("select" if label.startswith("select") else
+                   "edge_gather" if label.startswith("edge_gather") else
+                   "msg_gather" if label.startswith("msg gather") else None)
+            if fam:
+                groups.setdefault((shape, fam), []).append((label, ms))
+    for (shape, fam), rows in sorted(groups.items()):
+        rows.sort(key=lambda r: r[1])
+        print(f"  {shape} {fam}:")
+        for i, (label, ms) in enumerate(rows):
+            print(f"      {label:44s} {ms:9.3f} ms"
+                  f"{' <- winner' if i == 0 else ''}")
+
+    print("\n== next actions ==")
+    print("  Flip each family's `auto` branch to its winner on the measured")
+    print("  platform: edge gather -> ops/permgather.py resolve_mode;")
+    print("  word gather -> resolve_words_mode; selection ->")
+    print("  ops/selection.py resolve_selection_mode. Then record the bench")
+    print("  table in BASELINE.md and re-run `python bench.py`.")
+
+
+if __name__ == "__main__":
+    main()
