@@ -47,6 +47,28 @@ class TestForcedCpuDeviceCount:
         assert forced_cpu_device_count(env) == 16
 
 
+class TestCollectiveInventory:
+    def test_parses_ops_and_tuple_payloads(self):
+        from __graft_entry__ import _collective_inventory
+        hlo = "\n".join([
+            "  %ag.1 = f32[16,8]{1,0} all-gather(%x), replica_groups={{0,1}}",
+            "  %ar = (f32[16]{0}, f32[1024]{0}) all-reduce(%a, %b), "
+            "replica_groups={{0,1,2,3}}, to_apply=%sum",
+            "  %cp = u32[64]{0} collective-permute(%y), "
+            "source_target_pairs={{0,1},{1,0}}",
+            "  %notacollective = f32[4]{0} add(%p, %q)",
+        ])
+        out = _collective_inventory(hlo)
+        assert "all-gather x1" in out and "all-reduce x1" in out
+        assert "collective-permute x1" in out
+        # 16*8*4 + (16+1024)*4 + 64*4 = 4928 bytes = 4.8 KiB
+        assert "4.8 KiB" in out
+
+    def test_empty(self):
+        from __graft_entry__ import _collective_inventory
+        assert "none" in _collective_inventory("%add = f32[2]{0} add(%a,%b)")
+
+
 class TestSlowHeartbeatWarning:
     def _net(self, warning_ratio):
         net = Network()
