@@ -1,4 +1,9 @@
-"""Pin the 10k-beacon delivery-loss mechanism (VERDICT r2 weak #5).
+"""Pin the benchmark configs' sub-1.0 delivery fractions to their causes.
+
+Two pinned mechanisms: the 10k-beacon loss is STRUCTURAL (isolated subnet
+subscribers, below), and the 100k-sybil loss is the DESIGNED security
+outcome (rejected invalid traffic + starved graylisted attackers,
+TestSybilDeliveryDecomposition). Both originally VERDICT r2 weak #5.
 
 The beacon scenario's sub-1.0 delivery fraction is STRUCTURAL: attestation
 subnets are joined by ~15% of peers, so the subscriber-induced subgraph has
@@ -38,6 +43,55 @@ def _reachable_from(publisher: int, subs_t: np.ndarray, nbr: np.ndarray,
                 seen[nb] = True
                 q.append(nb)
     return seen
+
+
+class TestSybilDeliveryDecomposition:
+    def test_loss_is_rejected_and_starved_attacker_traffic(self):
+        """Pin the sybil scenario's sub-1.0 delivery fraction the same way:
+        the shortfall is the DESIGNED security outcome, not transport loss.
+        Decomposed over (receiver class x message class):
+
+        - honest receivers get EVERY honest message (delivery 1.0);
+        - honest receivers deliver NO invalid sybil message (validation
+          rejects them, validation.go:293-370 -> P4);
+        - graylisted sybil receivers are starved of honest messages
+          (scoring cuts them out of mesh + gossip, gossipsub.go:598-645,
+          the gossipsub_spam_test.go end state).
+
+        The bench's headline delivery_fraction for config 4 is therefore
+        dominated by the honest x honest block over all pairs."""
+        cfg, tp, st = scenarios.sybil_100k(n_peers=2000, k_slots=16,
+                                           degree=10, sybil_fraction=0.2,
+                                           n_sybil_ips=8)
+        st = run(st, cfg, tp, jax.random.PRNGKey(0), 25)
+        st.tick.block_until_ready()
+
+        tick = int(st.tick)
+        mal = np.asarray(st.malicious)
+        mt = np.asarray(st.msg_topic)
+        mp = np.asarray(st.msg_publish_tick)
+        inv = np.asarray(st.msg_invalid)
+        have = np.asarray(st.have)
+        sub = np.asarray(st.subscribed)
+        alive = (tick - mp) < cfg.history_length
+        # like the beacon test: skip messages young enough to be
+        # legitimately in flight so only real drops can fail the 1.0 gate
+        settled = (tick - mp) >= 3
+        valid = (mt >= 0) & alive & settled
+        should = sub[:, np.clip(mt, 0, cfg.n_topics - 1)] & valid[None, :]
+        got = have & should
+
+        def frac(rmask, cmask):
+            s = should[rmask][:, cmask]
+            return got[rmask][:, cmask].sum() / max(s.sum(), 1), int(s.sum())
+
+        hh, n_hh = frac(~mal, valid & ~inv)
+        hi, n_hi = frac(~mal, valid & inv)
+        sh, n_sh = frac(mal, valid & ~inv)
+        assert min(n_hh, n_hi, n_sh) > 1000, "scenario too small to pin"
+        assert hh == 1.0, f"honest-to-honest delivery lost traffic: {hh}"
+        assert hi == 0.0, f"invalid sybil messages were delivered: {hi}"
+        assert sh < 0.05, f"graylisted sybils still receive: {sh}"
 
 
 class TestBeaconDeliveryIsStructural:
