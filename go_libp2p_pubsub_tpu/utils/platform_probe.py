@@ -21,9 +21,12 @@ def probe_default_platform(timeout: int | None = None) -> tuple[bool, int]:
     """(alive, n_devices) of the DEFAULT jax backend, measured in a
     bounded-timeout subprocess so a wedged platform plugin costs a timeout,
     not a hang."""
+    # default 120s: a healthy tunnel answers in ~10-20s (tiny compile +
+    # device list); a wedged one burns the whole budget before the CPU
+    # fallback, so the margin is wall-clock the driver pays on every entry
     timeout = timeout if timeout is not None else int(
         os.environ.get("GRAFT_PROBE_TIMEOUT",
-                       os.environ.get("BENCH_PROBE_TIMEOUT", 180)))
+                       os.environ.get("BENCH_PROBE_TIMEOUT", 120)))
     try:
         res = subprocess.run(
             [sys.executable, "-c",
