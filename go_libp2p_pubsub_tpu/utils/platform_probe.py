@@ -21,6 +21,16 @@ def probe_default_platform(timeout: int | None = None) -> tuple[bool, int]:
     """(alive, n_devices) of the DEFAULT jax backend, measured in a
     bounded-timeout subprocess so a wedged platform plugin costs a timeout,
     not a hang."""
+    alive, n, _ = probe_default_platform_info(timeout)
+    return alive, n
+
+
+def probe_default_platform_info(
+        timeout: int | None = None) -> tuple[bool, int, str]:
+    """Like :func:`probe_default_platform`, but also reports the platform
+    kind of device 0 ("tpu"/"cpu"/...), so a watcher can distinguish a live
+    tunnel from a healthy-but-CPU default backend. Returns
+    ``(alive, n_devices, platform)`` with platform "" when dead."""
     # default 120s: a healthy tunnel answers in ~10-20s (tiny compile +
     # device list); a wedged one burns the whole budget before the CPU
     # fallback, so the margin is wall-clock the driver pays on every entry
@@ -32,16 +42,18 @@ def probe_default_platform(timeout: int | None = None) -> tuple[bool, int]:
             [sys.executable, "-c",
              "import jax, jax.numpy as jnp; "
              "assert float(jnp.ones((8, 8)).sum()) == 64.0; "
-             "print('NDEV', len(jax.devices()))"],
+             "d = jax.devices(); "
+             "print('NDEV', len(d), d[0].platform)"],
             capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
-        return False, 0
+        return False, 0, ""
     if res.returncode != 0:
-        return False, 0
+        return False, 0, ""
     for line in res.stdout.splitlines():
         if line.startswith("NDEV "):
-            return True, int(line.split()[1])
-    return False, 0
+            parts = line.split()
+            return True, int(parts[1]), parts[2]
+    return False, 0, ""
 
 
 def cpu_mesh_env(env: dict, n_devices: int | None = None) -> dict:

@@ -1,0 +1,70 @@
+#!/bin/bash
+# Tunnel watcher: the axon TPU tunnel has been wedged for two full rounds of
+# ~30-min manual polling, so any live window must be captured WITHOUT a human
+# (or agent) in the loop. Loop a bounded-subprocess platform probe; on the
+# first (alive, n>0, platform=tpu) hit, fire the staged re-measurement
+# (scripts/tpu_recheck.sh: microbenches, per-phase ablations, gather/selection
+# mode sweeps, full bench) and then one more clean `python bench.py` for the
+# record. All output lands under a fixed log dir plus a repo-side results
+# directory so the evidence survives the session.
+#
+# Usage: nohup scripts/tpu_watch.sh >/dev/null 2>&1 &   (or run_in_background)
+# Env: TPU_WATCH_SLEEP (secs between probes, default 180),
+#      GRAFT_PROBE_TIMEOUT (per-probe budget, default 120),
+#      TPU_WATCH_DIR (log dir, default /tmp/tpu_watch),
+#      TPU_WATCH_MAX_HOURS (give up after this many hours, default 11).
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR="${TPU_WATCH_DIR:-/tmp/tpu_watch}"
+RESULTS="tpu_watch_results"
+mkdir -p "$LOGDIR" "$RESULTS"
+MAIN_LOG="$LOGDIR/watch.log"
+SLEEP_BETWEEN="${TPU_WATCH_SLEEP:-180}"
+MAX_HOURS="${TPU_WATCH_MAX_HOURS:-11}"
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+
+log() { echo "[$(date -u +%FT%TZ)] $*" | tee -a "$MAIN_LOG"; }
+
+log "watch start: sleep=${SLEEP_BETWEEN}s probe_timeout=${GRAFT_PROBE_TIMEOUT:-120}s max=${MAX_HOURS}h"
+
+probe_n=0
+probe_fail_streak=0
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  probe_n=$((probe_n + 1))
+  # keep probe stderr: a broken watcher (import error, bad PYTHONPATH) must
+  # be distinguishable from a dead tunnel, or 11h of window can burn silently
+  raw=$(python -c "
+from go_libp2p_pubsub_tpu.utils.platform_probe import probe_default_platform_info
+alive, n, plat = probe_default_platform_info()
+print(f'PROBE {int(alive)} {n} {plat or \"-\"}')" 2>"$LOGDIR/probe_stderr.log")
+  probe_rc=$?
+  out=$(echo "$raw" | grep '^PROBE' || echo "PROBE 0 0 -")
+  read -r _ alive ndev plat <<<"$out"
+  log "probe #$probe_n: alive=$alive ndev=$ndev platform=$plat rc=$probe_rc"
+  if [ "$probe_rc" -ne 0 ]; then
+    probe_fail_streak=$((probe_fail_streak + 1))
+    log "probe process FAILED (streak $probe_fail_streak): $(tail -2 "$LOGDIR/probe_stderr.log" | tr '\n' ' ')"
+    if [ "$probe_fail_streak" -ge 5 ]; then
+      log "ABORT: 5 consecutive probe-process failures — watcher itself is broken, not the tunnel"
+      exit 2
+    fi
+  else
+    probe_fail_streak=0
+  fi
+  if [ "$alive" = "1" ] && [ "$ndev" -ge 1 ] && [ "$plat" = "tpu" ]; then
+    log "TUNNEL LIVE ($ndev tpu device(s)) — firing recheck"
+    rm -rf /tmp/tpu_recheck   # stale CPU-fallback logs must not pass as TPU evidence
+    bash scripts/tpu_recheck.sh 2>&1 | tee -a "$LOGDIR/recheck.log"
+    cp -r /tmp/tpu_recheck/. "$RESULTS/" 2>/dev/null
+    log "recheck done — final clean bench for the record"
+    timeout 3600 python bench.py 2>&1 | grep -v WARNING | tee "$RESULTS/bench_tpu.log"
+    if grep -q '"platform": "tpu"' "$RESULTS/bench_tpu.log"; then
+      log "SUCCESS: on-TPU bench captured in $RESULTS/bench_tpu.log"
+      exit 0
+    fi
+    log "bench did not report platform=tpu (window closed mid-run?) — resuming watch"
+  fi
+  sleep "$SLEEP_BETWEEN"
+done
+log "watch deadline reached without a live window"
+exit 1
