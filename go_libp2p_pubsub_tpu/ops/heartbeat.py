@@ -54,20 +54,36 @@ def edge_gather_packed(masks: list, state: SimState,
     of one [N,T,K] advanced-index gather per mask. The permutation gather is
     the expensive op on TPU; packing divides its index count by T-per-mask
     and amortizes it across masks, while the pack/unpack shifts are cheap
-    VPU passes. ``mode`` picks the gather formulation (ops/permgather.py)."""
+    VPU passes. ``mode`` picks the formulation: ``pallas`` (TPU auto) packs
+    all B planes x K slots into a [N, ceil(B*K/32)] u32 bit-table pinned in
+    VMEM (PERF_MODEL.md S2 — no [N,K,K] temporary at any N); the others
+    build per-32-plane [N, K] u32 payloads routed through
+    ops/permgather.permutation_gather."""
+    from .permgather import _edge_table_pallas, resolve_edge_packed_mode
+
     n, t, k = masks[0].shape
     planes = jnp.concatenate(masks, axis=1)                    # [N, B, K]
     b = planes.shape[1]
     jn = jnp.clip(state.neighbors, 0, n - 1)
     rk = jnp.clip(state.reverse_slot, 0, k - 1)
     valid = ((state.neighbors >= 0) & (state.reverse_slot >= 0))[:, None, :]
+    mode = resolve_edge_packed_mode(mode, n, k, b)
+    if mode == "pallas":
+        from .bits import pack_bool
+        table = pack_bool(planes.reshape(n, b * k))        # [N, ceil(BK/32)]
+        groups = _edge_table_pallas(table, jn, rk, b_planes=b,
+                                    interpret=jax.default_backend() != "tpu")
+    else:
+        groups = []
+        for w0 in range(0, b, 32):
+            bits = planes[:, w0:w0 + 32, :]
+            nb = bits.shape[1]
+            sh = (U32(1) << jnp.arange(nb, dtype=U32))[None, :, None]
+            payload = jnp.sum(bits.astype(U32) * sh, axis=1, dtype=U32)
+            groups.append(permutation_gather(payload, jn, rk, mode))
     parts = []
-    for w0 in range(0, b, 32):
-        bits = planes[:, w0:w0 + 32, :]
-        nb = bits.shape[1]
-        sh = (U32(1) << jnp.arange(nb, dtype=U32))[None, :, None]
-        payload = jnp.sum(bits.astype(U32) * sh, axis=1, dtype=U32)  # [N, K]
-        g = permutation_gather(payload, jn, rk, mode)                # [N, K]
+    for w0, g in zip(range(0, b, 32), groups):
+        nb = min(32, b - w0)
         parts.append(((g[:, None, :] >> jnp.arange(nb, dtype=U32)[None, :, None])
                       & U32(1)).astype(bool))
     flat = jnp.concatenate(parts, axis=1) & valid

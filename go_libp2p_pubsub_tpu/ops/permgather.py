@@ -124,12 +124,93 @@ def _gather_words_pallas(x_w, nbr, interpret=False):
     )(x_w, nbr)
 
 
-def resolve_words_mode(mode: str, w: int, n: int, k: int,
-                       itemsize: int = 4) -> str:
-    """Resolve the message-table gather mode (bits.gather_words_rows)."""
+@functools.partial(jax.jit, static_argnames=("b_planes", "interpret"))
+def _edge_table_pallas(table, jn, rk, b_planes, interpret=False):
+    """Bit-table edge exchange: the B sender-side bool planes over K slots
+    pack into one [N, ceil(B*K/32)] u32 table (b-major, slot-minor bit
+    order); bit (b % 32) of output group b//32 at [n, k] is table bit
+    (b*K + rk[n,k]) of row jn[n,k].
+
+    The table is 16x smaller than the [N, K] u32 payload the per-group
+    formulation gathers (B bits vs 32 per slot at T=1), so it pins in VMEM
+    at 100k+ peers where the payload kernel had to fall back to the
+    [N,K,K]-temporary `rows` form (PERF_MODEL.md S2). Returns one [N, K]
+    u32 payload per 32-plane group, bit-compatible with the per-group path.
+    """
+    from jax.experimental import pallas as pl
+
+    n, wb = table.shape
+    k = jn.shape[1]
+    n_groups = (b_planes + 31) // 32
+    # scratch per receiver row: [K, WB] gathered rows + [K] work vectors
+    bn = _block_rows(n, 2 * k * wb * 4)
+    assert bn is not None, "resolve admitted an infeasible shape"
+
+    def kernel(tab_ref, jn_ref, rk_ref, *out_refs):
+        tab = tab_ref[:]                                   # [N, WB] in VMEM
+        jn_b = jn_ref[:]                                   # [BN, K]
+        rk_b = rk_ref[:]
+        rows = jnp.take(tab, jn_b.reshape(-1), axis=0)     # [BN*K, WB]
+        rows = rows.reshape(jn_b.shape[0], k, wb)
+        accs = [jnp.zeros(jn_b.shape, jnp.uint32) for _ in range(n_groups)]
+        for b in range(b_planes):
+            pos = rk_b + b * k                             # bit positions
+            word = jnp.take_along_axis(rows, (pos // 32)[..., None],
+                                       axis=-1)[..., 0]
+            bit = (word >> (pos % 32).astype(jnp.uint32)) & jnp.uint32(1)
+            accs[b // 32] = accs[b // 32] | (bit << jnp.uint32(b % 32))
+        for ref, acc in zip(out_refs, accs):
+            ref[:] = acc
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((n, wb), lambda i: (0, 0)),       # full table
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+        ],
+        out_specs=[pl.BlockSpec((bn, k), lambda i: (i, 0))
+                   for _ in range(n_groups)],
+        out_shape=[jax.ShapeDtypeStruct((n, k), jnp.uint32)
+                   for _ in range(n_groups)],
+        interpret=interpret,
+    )(table, jn, rk)
+
+
+def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
+    """Resolve the packed-edge-exchange formulation (heartbeat
+    edge_gather_packed). ``pallas`` is the bit-table kernel above; TPU
+    ``auto`` picks it (PERF_MODEL.md S2), CPU ``auto`` keeps the scalar
+    per-group gather. Ineligible shapes degrade pallas -> rows."""
     backend = jax.default_backend()
     if mode == "auto":
-        mode = "scalar" if backend == "cpu" else "rows"
+        # pallas only where it compiles natively; other accelerators would
+        # hit the interpret-mode emulator, far slower than compiled rows
+        mode = {"cpu": "scalar", "tpu": "pallas"}.get(backend, "rows")
+    if mode == "pallas":
+        wb = (b_planes * k + 31) // 32
+        if (n * wb * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
+                or _block_rows(n, 2 * k * wb * 4) is None):
+            return "rows"
+    return mode
+
+
+def resolve_words_mode(mode: str, w: int, n: int, k: int,
+                       itemsize: int = 4) -> str:
+    """Resolve the message-table gather mode (bits.gather_words_rows).
+
+    TPU ``auto`` is ``pallas``: the packed [W, N] table is 0.8 MB at 100k
+    peers — VMEM-resident at every shape this engine targets — while the
+    ``rows`` form materializes a [N, K, M] bool temporary (205 MB at 100k)
+    twice per call; PERF_MODEL.md prices the difference at ~3.6 GB/tick of
+    the headline config's 14 GB. Ineligible shapes still fall back to
+    ``rows``, and scripts/tpu_recheck.sh sweeps all three head-to-head.
+    """
+    backend = jax.default_backend()
+    if mode == "auto":
+        # pallas only where it compiles natively (see resolve_edge_packed_mode)
+        mode = {"cpu": "scalar", "tpu": "pallas"}.get(backend, "rows")
     if mode == "pallas":
         if (w * n * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(n, 2 * w * k * itemsize) is None):

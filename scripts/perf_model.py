@@ -1,0 +1,293 @@
+"""Roofline model of the batched engine's per-tick HBM traffic on TPU v5e.
+
+Every kernel in this engine is memory-bound (elementwise/bitwise passes,
+gathers, tiny reductions — no MXU work), so the per-tick cost model is
+bytes-touched / HBM bandwidth. This script enumerates, phase by phase, the
+HBM bytes each design variant touches per tick at a given shape, converts
+them to v5e time (819 GB/s), and prints the implied heartbeats/sec — the
+number BASELINE.md wants at >= 1000 for the 100k-peer headline config.
+
+Designs modeled:
+  current  — what ships today under TPU `auto` modes: `rows` gathers
+             (the [N,K,K] / [N,K,M] vector-DMA temporaries that round-2
+             measured 2.5x over scalar), associative-scan prefix-OR in the
+             hop loop, five [W,K,N] bit-set accumulators.
+  planned  — the surgery this model justifies: VMEM-resident Pallas gathers
+             (payload tables are <= a few MB packed), a fused Pallas hop
+             kernel (gather + K-prefix + per-slot event counts in one pass),
+             int8 per-slot count accumulators (events per (t,k,n) per tick
+             are bounded by the message window M < 128), and the decay pass
+             fused with the score pass.
+
+Cross-check: --cost-analysis compiles each phase on the CURRENT backend and
+prints XLA's own bytes-accessed estimate next to the analytic number. On CPU
+the lowering differs (scalar gathers, no rows temporaries), so the check
+validates the *inventory* (which arrays a phase touches), not the TPU total.
+
+Usage: python scripts/perf_model.py [scenario] [--cost-analysis]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_HBM_GBPS = 819.0          # v5e HBM bandwidth per chip
+V5E_MS_PER_GB = 1e3 / (V5E_HBM_GBPS)
+
+
+def fmt_mb(b):
+    return b / 1e6
+
+
+class Phase:
+    def __init__(self, name, items):
+        self.name = name
+        self.items = items                    # list[(label, bytes)]
+
+    @property
+    def total(self):
+        return sum(b for _, b in self.items)
+
+
+def model(n, k, t, m, w, hops, p, design, *, gated_selections=2):
+    """Per-tick phase inventory. All counts in bytes touched in HBM.
+
+    Conventions: an elementwise pass fused by XLA touches each distinct
+    input once (r) and each output once (w). Gathers touch their index
+    arrays, their materialized output, and — in `rows` mode — the
+    [rows, K]-shaped temporary twice (w+r). int8 counts in `planned`.
+    """
+    f = 4                                      # f32/i32/u32 itemsize
+    b_ntk = n * t * k                          # bool plane
+    b_ntk4 = f * n * t * k
+    b_nk4 = f * n * k
+    b_nk1 = n * k
+    b_wkn = f * w * k * n
+    b_wn = f * w * n
+    b_nm1 = n * m
+    b_nm4 = f * n * m
+    b_nkk4 = f * n * k * k                     # rows permgather temporary
+    b_nkm1 = n * k * m                         # rows words-gather temporary
+
+    def permgather_packed(calls):
+        """edge_gather_packed: pack + [N,K] u32 permutation gather + unpack."""
+        if design == "current":                # rows: [N,K,K] temp w+r
+            per = (b_ntk * 2          # read masks to pack (~2 planes avg)
+                   + b_nk4            # write packed payload
+                   + b_nk4 * 2        # read jn, rk
+                   + b_nkk4 * 2       # rows temporary write+read
+                   + b_nk4            # gathered output
+                   + b_ntk * 2)       # unpack to bool planes
+        else:                                  # pallas: table in VMEM
+            per = (b_ntk * 2 + b_nk4   # pack (table read once from HBM)
+                   + b_nk4 * 2         # indices
+                   + b_nk4             # output
+                   + b_ntk * 2)        # unpack
+        return calls * per
+
+    def words_gather(calls):
+        """gather_words: [W,N] table -> [W,K,N] per-edge windows."""
+        if design == "current":                # rows: unpack + [N,K,M] temp
+            per = (b_wn + b_nm1        # unpack table to [N,M] bool
+                   + b_nk4             # read nbr
+                   + b_nkm1 * 2        # [N,K,M] temporary write+read
+                   + b_wkn)            # packed output
+        else:                                  # pallas: table in VMEM
+            per = b_wn + b_nk4 + b_wkn
+        return calls * per
+
+    phases = []
+
+    # -- publish: column scatters into the message window --
+    phases.append(Phase("publish", [
+        ("col scatters (have/deliver/iwant x P cols)", p * n * (1 + 4 + 4)),
+        ("msg meta + fanout rows", 6 * 4 * p + 3 * 4 * p),
+    ]))
+
+    # -- decay_counters (in `planned` there is NO separate decay pass:
+    # scores read counter*decay inline, attribution writes
+    # min(counter*decay + arrivals, cap) — same post-tick values, zero
+    # extra passes; the mesh_active latch moves into the heartbeat --
+    if design == "current":
+        phases.append(Phase("decay_counters", [
+            ("read fmd/mmd/mfp/imd/bp", 5 * b_ntk4),
+            ("read graft_tick/mesh/mesh_active", b_ntk4 + 2 * b_ntk),
+            ("write 5 counters + active", 5 * b_ntk4 + b_ntk),
+        ]))
+    phases.append(Phase("compute_scores", [
+        ("read 4 counters + graft/bp", 6 * b_ntk4),
+        ("read mesh/active/connected/neighbors", 3 * b_ntk + b_nk4),
+        ("write scores + scores_all", 2 * b_nk4),
+    ]))
+
+    # -- heartbeat mesh maintenance --
+    hb_items = [
+        ("mesh-regime masks (~8 fused bool passes)", 8 * 2 * b_ntk),
+        ("ungated selections (gossip + graft gate): noise+ranks",
+         gated_selections * (b_ntk4 + b_ntk4 + b_ntk)),
+        ("backoff/graft_tick/penalty updates", 3 * 2 * b_ntk4),
+    ]
+    phases.append(Phase("heartbeat logic", hb_items))
+    phases.append(Phase("heartbeat edge exchange (3 packed gathers)",
+                        [("graft/prune + refuse + gossip/send",
+                          permgather_packed(3))]))
+
+    # -- forward_tick --
+    if design == "current":
+        phases.append(Phase("fwd: IWANT resolve", [
+            ("slot bit-planes -> asked_k [W,K,N]", b_wkn + 6 * b_wn),
+            ("answers gather", words_gather(1)),
+            ("got/broken chain (~4 [W,K,N] passes)", 4 * b_wkn),
+            ("budget popcounts", 2 * b_wkn),
+        ]))
+        phases.append(Phase("fwd: allowed/mesh_eb build", [
+            ("fwd_mask+mesh -> 2x [W,K,N]", 2 * (b_ntk + b_wkn)),
+        ]))
+    else:
+        # fused resolve kernel: answer table pinned in VMEM, asked/got/
+        # broken computed per peer block, outputs are counts + [W,N] sets;
+        # allowed/mesh_eb expand inside the hop kernel from bool planes
+        phases.append(Phase("fwd: IWANT resolve (fused)", [
+            ("iwant_pending r + answer table + outputs",
+             b_nm4 + b_wn * 4 + n * k),
+        ]))
+
+    # -- the hop loop --
+    if design == "current":
+        per_hop = [
+            ("frontier gather", words_gather(1) // 1),
+            ("& allowed (read+write)", b_wkn * 2),
+            ("prefix-OR assoc-scan (5 passes r+w)", 5 * 2 * b_wkn),
+            ("new_from_k/new_any", b_wkn * 2 + b_wn),
+            ("5 bit-set accumulators r+w", 5 * 2 * b_wkn),
+            ("dup/elig chain reads (mesh_eb, offered)", 2 * b_wkn),
+            ("have/dlv/frontier [W,N] updates", 6 * b_wn),
+        ]
+    else:
+        # fused Pallas hop kernel: frontier/have/vm tables pinned in VMEM,
+        # nbr + masks blocked in, K-prefix unrolled on-chip, outputs are
+        # int8 per-slot per-topic event counts (aliased accumulators).
+        # gater accs (ig/gdup) compile only when cfg.gater_enabled — the
+        # headline config runs without the gater
+        per_hop = [
+            ("nbr indices", b_nk4),
+            ("fwd_mask + mesh bool planes", 2 * b_ntk),
+            ("int8 count accs r+w (nv/ni/dup)", 2 * 3 * n * t * k),
+            ("frontier/have/vm tables + updates", 8 * b_wn),
+        ]
+    hop_total = sum(b for _, b in per_hop)
+    phases.append(Phase(f"fwd: hop loop x{hops}",
+                        [(lbl, b * hops) for lbl, b in per_hop]))
+
+    # -- attribution / state updates --
+    if design == "current":
+        phases.append(Phase("fwd: attribution", [
+            ("popcount 3 bit-set accs x T", 3 * t * b_wkn),
+            ("fmd/mmd/imd r+w", 3 * 2 * b_ntk4),
+            ("unpack have/newly_dlv, deliver_tick r+w", b_nm1 * 2 + 2 * b_nm4),
+        ]))
+    else:
+        phases.append(Phase("fwd: attribution", [
+            ("read int8 count accs", 3 * n * t * k),
+            ("fmd/mmd/imd r+w (decay folded in)", 3 * 2 * b_ntk4),
+            ("unpack have/newly_dlv, deliver_tick r+w", b_nm1 * 2 + 2 * b_nm4),
+        ]))
+
+    # -- gossip emit (IHAVE -> iwant_pending for next tick) --
+    if design == "current":
+        phases.append(Phase("fwd: gossip emit", [
+            ("window pack + offer gather", words_gather(1)),
+            ("prefix-OR over K (5 passes r+w)", 5 * 2 * b_wkn),
+            ("chosen_k + bits_to_slot (5 reduce_or passes)",
+             b_wkn * 2 + 5 * b_wkn),
+            ("iwant_pending write", b_nm4),
+        ]))
+    else:
+        phases.append(Phase("fwd: gossip emit", [
+            ("fused offer+choose kernel (tables in VMEM)",
+             b_wn + b_nk4 + b_wkn // k + b_nm4),
+            ("iwant_pending write", b_nm4),
+        ]))
+
+    return phases
+
+
+def report(name, n, k, t, m, w, hops, p, design):
+    phases = model(n, k, t, m, w, hops, p, design)
+    total = sum(ph.total for ph in phases)
+    ms = fmt_mb(total) / 1e3 * V5E_MS_PER_GB
+    print(f"\n== {name} [{design}] N={n} K={k} T={t} M={m} W={w} "
+          f"hops={hops} P={p} ==")
+    for ph in phases:
+        pms = fmt_mb(ph.total) / 1e3 * V5E_MS_PER_GB
+        print(f"  {ph.name:44s} {fmt_mb(ph.total):9.1f} MB  {pms:7.3f} ms")
+        if os.environ.get("PERF_MODEL_DETAIL"):
+            for lbl, b in ph.items:
+                print(f"      {lbl:52s} {fmt_mb(b):9.1f} MB")
+    print(f"  {'TOTAL':44s} {fmt_mb(total):9.1f} MB  {ms:7.3f} ms"
+          f"   -> {1e3 / ms:8.1f} hb/s")
+    return total, 1e3 / ms
+
+
+def cost_analysis_check(n=10_000, k=32, m=64, p=8):
+    """Compile each phase and print XLA's own bytes-accessed — an inventory
+    check. Forces the CPU backend BEFORE importing jax: in-process backend
+    init can hang forever on the wedged axon TPU plugin (the whole reason
+    utils/platform_probe.py probes in subprocesses), and the CPU lowering is
+    what this cross-check documents anyway."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    import jax
+    from __graft_entry__ import _build
+    from go_libp2p_pubsub_tpu.ops.heartbeat import heartbeat
+    from go_libp2p_pubsub_tpu.ops.propagate import forward_tick, publish
+    from go_libp2p_pubsub_tpu.ops.score_ops import compute_scores, decay_counters
+
+    cfg, tp, st = _build(n_peers=n, k_slots=k, degree=12, msg_window=m,
+                         publishers=p)
+    key = jax.random.PRNGKey(0)
+
+    def ca(label, fn, *args, **static):
+        j = jax.jit(fn, static_argnames=tuple(static))
+        c = j.lower(*args, **static).compile()
+        d = c.cost_analysis()
+        d = d[0] if isinstance(d, list) else d
+        print(f"  {label:24s} bytes={d.get('bytes accessed', float('nan')) / 1e6:10.1f} MB"
+              f"  flops={d.get('flops', 0) / 1e6:10.1f} M")
+
+    print(f"\n== XLA cost_analysis on {jax.default_backend()} @ N={n} ==")
+    ca("decay_counters", lambda s: decay_counters(s, cfg, tp), st)
+    ca("compute_scores", lambda s: compute_scores(s, cfg, tp), st)
+    ca("heartbeat", lambda s, k2: heartbeat(s, cfg, tp, k2), st, key)
+    # forward_tick's lower() needs shapes only — eval_shape skips the
+    # minutes an un-jitted op-by-op heartbeat dispatch would burn
+    hb = jax.eval_shape(lambda s, k2: heartbeat(s, cfg, tp, k2), st, key)
+    ca("forward_tick",
+       lambda s, g, sc, k2: forward_tick(s, cfg, tp, g, sc, k2),
+       hb.state, hb.inc_gossip, hb.scores, key)
+
+
+def main():
+    shapes = {
+        "headline_100k": dict(n=100_000, k=32, t=1, m=64, w=2, hops=8, p=8),
+        "10k_beacon": dict(n=10_000, k=48, t=9, m=64, w=2, hops=8, p=16),
+        "1k": dict(n=1024, k=32, t=1, m=64, w=2, hops=8, p=4),
+    }
+    which = sys.argv[1] if len(sys.argv) > 1 and not sys.argv[1].startswith("-") \
+        else "headline_100k"
+    if which not in shapes:
+        raise SystemExit(f"unknown scenario {which!r}; "
+                         f"choose from {', '.join(shapes)}")
+    sh = shapes[which]
+    for design in ("current", "planned"):
+        report(which, design=design, **sh)
+    if "--cost-analysis" in sys.argv:
+        # cross-check at the chosen shape, downscaled to 10k peers so the
+        # CPU compile stays sane (the inventory, not N, is what's checked)
+        cost_analysis_check(n=min(sh["n"], 10_000), k=sh["k"], m=sh["m"],
+                            p=sh["p"])
+
+
+if __name__ == "__main__":
+    main()

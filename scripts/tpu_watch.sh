@@ -55,13 +55,21 @@ print(f'PROBE {int(alive)} {n} {plat or \"-\"}')" 2>"$LOGDIR/probe_stderr.log")
     log "TUNNEL LIVE ($ndev tpu device(s)) — firing recheck"
     rm -rf /tmp/tpu_recheck   # stale CPU-fallback logs must not pass as TPU evidence
     bash scripts/tpu_recheck.sh 2>&1 | tee -a "$LOGDIR/recheck.log"
-    cp -r /tmp/tpu_recheck/. "$RESULTS/" 2>/dev/null
+    # per-attempt subdir: a mid-run re-wedge falls back to CPU silently, so
+    # attempt logs are only promotable to TPU evidence if the platform tag
+    # below confirms; until then they carry an UNVERIFIED marker
+    attempt="$RESULTS/attempt_$(date -u +%Y%m%dT%H%M%SZ)"
+    mkdir -p "$attempt"
+    cp -r /tmp/tpu_recheck/. "$attempt/" 2>/dev/null
     log "recheck done — final clean bench for the record"
-    timeout 3600 python bench.py 2>&1 | grep -v WARNING | tee "$RESULTS/bench_tpu.log"
-    if grep -q '"platform": "tpu"' "$RESULTS/bench_tpu.log"; then
-      log "SUCCESS: on-TPU bench captured in $RESULTS/bench_tpu.log"
+    timeout 3600 python bench.py 2>&1 | grep -v WARNING | tee "$attempt/bench.log"
+    if grep -q '"platform": "tpu"' "$attempt/bench.log"; then
+      cp "$attempt/bench.log" "$RESULTS/bench_tpu.log"
+      log "SUCCESS: on-TPU bench captured in $RESULTS/bench_tpu.log (full logs: $attempt)"
       exit 0
     fi
+    echo "final bench did not report platform=tpu; recheck step logs may be CPU fallback" \
+      > "$attempt/PLATFORM_UNVERIFIED"
     log "bench did not report platform=tpu (window closed mid-run?) — resuming watch"
   fi
   sleep "$SLEEP_BETWEEN"

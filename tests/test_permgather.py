@@ -91,6 +91,56 @@ class TestWordsGatherParity:
         assert resolve_words_mode("pallas", 2, 1024, 8) == "pallas"
         # table too big for VMEM -> rows
         assert resolve_words_mode("pallas", 64, 1_000_000, 8) == "rows"
+        # cpu auto stays on the scalar fast path
+        assert resolve_words_mode("auto", 2, 1024, 8) == "scalar"
+
+    def test_resolve_words_auto_is_pallas_on_tpu(self, monkeypatch):
+        """TPU auto resolves to the VMEM table kernel (PERF_MODEL.md S1),
+        still falling back to rows for VMEM-infeasible shapes."""
+        import go_libp2p_pubsub_tpu.ops.permgather as pg
+        monkeypatch.setattr(pg.jax, "default_backend", lambda: "tpu")
+        assert pg.resolve_words_mode("auto", 2, 100_000, 32) == "pallas"
+        assert pg.resolve_words_mode("auto", 64, 1_000_000, 8) == "rows"
+
+
+class TestEdgeTableKernel:
+    """The bit-table packed edge exchange (PERF_MODEL.md S2): all B sender
+    planes x K slots in one [N, ceil(BK/32)] u32 VMEM table."""
+
+    def _state(self, n, k, seed=0):
+        from types import SimpleNamespace
+
+        from go_libp2p_pubsub_tpu.sim import topology
+        topo = topology.sparse(n, k, degree=min(5, k - 1))
+        return SimpleNamespace(neighbors=jnp.asarray(topo.neighbors),
+                               reverse_slot=jnp.asarray(topo.reverse_slot))
+
+    def test_parity_across_modes_and_group_boundary(self):
+        from go_libp2p_pubsub_tpu.ops.heartbeat import edge_gather_packed
+
+        rng = np.random.default_rng(7)
+        n, k = 192, 8
+        st = self._state(n, k)
+        for t, n_masks in ((3, 2), (12, 3)):   # 6 planes; 36 planes (2 groups)
+            masks = [jnp.asarray(rng.random((n, t, k)) < 0.35)
+                     for _ in range(n_masks)]
+            ref = edge_gather_packed(masks, st, "scalar")
+            for mode in ("rows", "pallas"):
+                got = edge_gather_packed(masks, st, mode)
+                for r, g in zip(ref, got):
+                    np.testing.assert_array_equal(
+                        np.asarray(r), np.asarray(g), err_msg=f"{mode} t={t}")
+
+    def test_resolve_edge_auto_policy(self, monkeypatch):
+        import go_libp2p_pubsub_tpu.ops.permgather as pg
+        assert pg.resolve_edge_packed_mode("auto", 1024, 8, 2) == "scalar"
+        monkeypatch.setattr(pg.jax, "default_backend", lambda: "tpu")
+        # 100k x (2 planes * 32 slots) table = 0.8MB -> pallas-eligible
+        assert pg.resolve_edge_packed_mode("auto", 100_000, 32, 2) == "pallas"
+        # beacon shape: 18 planes x 48 slots at 10k peers -> still eligible
+        assert pg.resolve_edge_packed_mode("auto", 10_000, 48, 18) == "pallas"
+        # table over the VMEM budget degrades to rows
+        assert pg.resolve_edge_packed_mode("auto", 2_000_000, 32, 64) == "rows"
 
 
 class TestShardedStepParity:
