@@ -330,8 +330,13 @@ class PubSub:
             self._peer_disconnected(peer)
 
     def register_topic_validator(self, topic: str, validate, *, throttle: int = 0,
-                                 inline: bool = False) -> None:
-        self.val.add_validator(topic, validate, throttle=throttle, inline=inline)
+                                 inline: bool = False,
+                                 timeout: float = 0.0) -> None:
+        """RegisterTopicValidator (pubsub.go:1379) with the ValidatorOpt
+        knobs: WithValidatorConcurrency, WithValidatorInline, and
+        WithValidatorTimeout (validation.go:540-570)."""
+        self.val.add_validator(topic, validate, throttle=throttle,
+                               inline=inline, timeout=timeout)
 
     def unregister_topic_validator(self, topic: str) -> None:
         self.val.remove_validator(topic)

@@ -46,12 +46,15 @@ class ValidationError(ValueError):
 
 class ValidatorImpl:
     def __init__(self, topic: str, validate: ValidatorEx, throttle: int,
-                 inline: bool):
+                 inline: bool, timeout: float = 0.0):
         self.topic = topic
         self.validate = validate
         self.throttle = throttle
         self.inflight = 0
         self.inline = inline
+        # WithValidatorTimeout (validation.go:564-570): deadline for the
+        # async leg, in virtual seconds; 0 = none
+        self.timeout = timeout
 
 
 def as_validator_ex(fn) -> ValidatorEx:
@@ -61,6 +64,9 @@ def as_validator_ex(fn) -> ValidatorEx:
         if isinstance(r, bool):
             return VALIDATION_ACCEPT if r else VALIDATION_REJECT
         return int(r)
+    # a validator may model its execution time on the virtual clock; the
+    # async leg uses it for deadline (timeout) semantics
+    wrapped.virtual_duration = getattr(fn, "virtual_duration", 0.0)
     return wrapped
 
 
@@ -83,16 +89,32 @@ class Validation:
     # -- registration (validation.go:140-226) --
 
     def add_validator(self, topic: str, validate, throttle: int = 0,
-                      inline: bool = False) -> None:
+                      inline: bool = False, timeout: float = 0.0) -> None:
         if topic in self.topic_vals:
             raise ValueError(f"duplicate validator for topic {topic}")
         self.topic_vals[topic] = ValidatorImpl(
             topic, as_validator_ex(validate),
-            throttle or DEFAULT_VALIDATE_CONCURRENCY, inline)
+            throttle or DEFAULT_VALIDATE_CONCURRENCY, inline, timeout)
 
-    def add_default_validator(self, validate, inline: bool = False) -> None:
+    def add_default_validator(self, validate, inline: bool = False,
+                              timeout: float = 0.0) -> None:
         self.default_vals.append(ValidatorImpl(
-            "", as_validator_ex(validate), DEFAULT_VALIDATE_CONCURRENCY, inline))
+            "", as_validator_ex(validate), DEFAULT_VALIDATE_CONCURRENCY,
+            inline, timeout))
+
+    @staticmethod
+    def _run_validator(v: ValidatorImpl, src: PeerID | None,
+                       msg: Message) -> tuple[int, float]:
+        """validateMsg (validation.go:473-497): run one validator under its
+        deadline. A validator models its execution time on the virtual clock
+        via a ``virtual_duration`` attribute; exceeding ``timeout`` means
+        the context expires and the verdict is IGNORE (the reference's
+        ctx-respecting validators return ignore on deadline). Returns
+        (result, virtual seconds consumed)."""
+        dur = getattr(v.validate, "virtual_duration", 0.0)
+        if v.timeout > 0 and dur > v.timeout:
+            return VALIDATION_IGNORE, v.timeout
+        return v.validate(src, msg), dur
 
     def remove_validator(self, topic: str) -> None:
         if topic not in self.topic_vals:
@@ -167,7 +189,10 @@ class Validation:
 
         result = VALIDATION_ACCEPT
         for v in inline:
-            r = v.validate(src, msg)
+            # deadline applies to the inline leg too: the reference's
+            # inline loop also calls validateMsg (validation.go:326-327);
+            # the caller stays synchronous, only the verdict reflects it
+            r, _ = self._run_validator(v, src, msg)
             if r == VALIDATION_REJECT:
                 p.tracer.reject_message(msg, ev.REJECT_VALIDATION_FAILED)
                 raise ValidationError(ev.REJECT_VALIDATION_FAILED)
@@ -178,9 +203,11 @@ class Validation:
             if self.throttled >= self.throttle_cap:
                 p.tracer.reject_message(msg, ev.REJECT_VALIDATION_THROTTLED)
                 return
+            # the global throttle slot is held until the async leg's verdict
+            # lands (the reference's validation goroutine lifetime); with
+            # slow validators that is `elapsed` virtual seconds later
             self.throttled += 1
             self._do_validate_topic(async_vals, src, msg, result)
-            self.throttled -= 1
             return
 
         if result == VALIDATION_IGNORE:
@@ -191,29 +218,59 @@ class Validation:
 
     def _do_validate_topic(self, vals: list[ValidatorImpl], src: PeerID | None,
                            msg: Message, prior: int) -> None:
-        """Async leg (validation.go:410-500) with per-validator throttles."""
+        """Async leg (validation.go:410-500) with per-validator throttles
+        and deadlines. Validators with a nonzero virtual duration hold their
+        throttle slot and defer the verdict until that much virtual time
+        elapses (the reference's validator goroutine blocking on a slow
+        validate call); a validator over its timeout contributes only the
+        timeout and yields IGNORE (validateMsg ctx deadline,
+        validation.go:479-483)."""
         p = self.p
         assert p is not None
         result = prior
-        for v in vals:
-            if v.inflight >= v.throttle:
-                p.tracer.reject_message(msg, ev.REJECT_VALIDATION_THROTTLED)
-                p.tracer.throttle_peer(src)
+        elapsed = 0.0
+        acquired: list[ValidatorImpl] = []
+        try:
+            for v in vals:
+                if v.inflight >= v.throttle:
+                    for a in acquired:
+                        a.inflight -= 1
+                    self.throttled -= 1
+                    p.tracer.reject_message(msg, ev.REJECT_VALIDATION_THROTTLED)
+                    p.tracer.throttle_peer(src)
+                    return
+                v.inflight += 1
+                acquired.append(v)
+                r, dur = self._run_validator(v, src, msg)
+                # validators run CONCURRENTLY in the reference (one
+                # goroutine each, validation.go:428-456): latency is the
+                # max of their durations, not the sum
+                elapsed = max(elapsed, dur)
+                if r == VALIDATION_REJECT:
+                    result = VALIDATION_REJECT
+                    break
+                if r == VALIDATION_IGNORE:
+                    result = VALIDATION_IGNORE
+        except BaseException:
+            # a raising user validator must not leak throttle slots
+            for a in acquired:
+                a.inflight -= 1
+            self.throttled -= 1
+            raise
+
+        def finish():
+            for a in acquired:
+                a.inflight -= 1
+            self.throttled -= 1
+            if result == VALIDATION_REJECT:
+                p.tracer.reject_message(msg, ev.REJECT_VALIDATION_FAILED)
                 return
-            v.inflight += 1
-            try:
-                r = v.validate(src, msg)
-            finally:
-                v.inflight -= 1
-            if r == VALIDATION_REJECT:
-                result = VALIDATION_REJECT
-                break
-            if r == VALIDATION_IGNORE:
-                result = VALIDATION_IGNORE
-        if result == VALIDATION_REJECT:
-            p.tracer.reject_message(msg, ev.REJECT_VALIDATION_FAILED)
-            return
-        if result == VALIDATION_IGNORE:
-            p.tracer.reject_message(msg, ev.REJECT_VALIDATION_IGNORED)
-            return
-        p.deliver_validated(msg)
+            if result == VALIDATION_IGNORE:
+                p.tracer.reject_message(msg, ev.REJECT_VALIDATION_IGNORED)
+                return
+            p.deliver_validated(msg)
+
+        if elapsed > 0:
+            p.scheduler.call_later(elapsed, finish)
+        else:
+            finish()

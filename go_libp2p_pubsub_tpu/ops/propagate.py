@@ -273,9 +273,25 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     else:
         data_ok = accept_ok
 
-    fmd_add = jnp.zeros((n, t, k), jnp.float32)
-    mmd_add = jnp.zeros((n, t, k), jnp.float32)
-    imd_add = jnp.zeros((n, t, k), jnp.float32)
+    # Delivery-event accumulators are per-topic uint8 COUNTS, not [W,K,N]
+    # bit sets (PERF_MODEL.md S3): frontier semantics make each
+    # (receiver, sender-slot, message) event occur in at most one hop, so
+    # per-hop popcounts summed across hops equal the popcount of the OR'd
+    # sets — at 1/8th the accumulator width. uint8 is safe because events
+    # per (topic, slot, receiver) per tick are bounded by the message
+    # window (every event consumes a distinct message bit).
+    if m > 255:       # not assert: -O must not strip the overflow guard
+        raise ValueError(
+            f"msg_window={m} > 255 would wrap the uint8 hop-count "
+            "accumulators; shrink the window or widen the counts")
+
+    def topic_counts(events_wkn):
+        """[W,K,N] packed event bits -> [T,K,N] per-topic uint8 counts.
+        (jnp.sum promotes uint8 accumulation to uint32, so cast back.)"""
+        return jnp.stack([
+            popcount_sum(events_wkn & topic_bits[ti][:, None, None],
+                         axis=0, dtype=jnp.uint8)
+            for ti in range(t)]).astype(jnp.uint8)
 
     # -- step 1: resolve pending IWANTs from last tick (gossipsub.go:698-739:
     # the sender answers from its mcache; delivery counts as a first delivery
@@ -377,19 +393,39 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # frontier: messages that entered this peer THIS tick (fresh publishes and
     # IWANT pulls above); peers forward a message exactly one hop after they
     # first receive it, so the per-tick event sets below are disjoint across
-    # hops and OR-accumulation counts each event exactly once. Accumulators
+    # hops and per-hop counting counts each event exactly once. Accumulators
     # are seeded with the pull events so pulls share the attribution path.
     frontier = pack_words(state.deliver_tick == state.tick) | got_valid_any
-    dlv_new = got_valid_any                # deliveries accumulated this tick
-    nv_acc = got_valid                     # first-delivery events, per slot
-    ni_acc = got_k & inv_n[:, None, :]     # reject (P4) events, per slot
-    ig_acc = got_k & ign_n[:, None, :]     # ignore events, per slot
-    dup_acc = jnp.zeros((w, k, n), U32)    # mesh-duplicate events, per slot
-    gdup_acc = jnp.zeros((w, k, n), U32)   # any-duplicate events (gater)
+    carry0 = {
+        "i": jnp.int32(0),
+        "frontier": frontier,
+        "have": have_bits,
+        "dlv": dlv_bits,
+        "dlv_new": got_valid_any,          # deliveries accumulated this tick
+        "nv": topic_counts(got_valid),     # first-delivery counts [T,K,N]
+        "ni": topic_counts(got_k & inv_n[:, None, :]),   # reject (P4) counts
+        "dup": jnp.zeros((t, k, n), jnp.uint8),  # mesh-duplicate counts
+        "edge_used": edge_used,
+        "arrivals": arrivals,
+        "throttled": throttled,
+        "validated": validated,
+    }
+    if cfg.gater_enabled:
+        # gater-only stats compile only when the gater can consume them
+        carry0["ig"] = popcount_sum(got_k & ign_n[:, None, :], axis=0,
+                                    dtype=jnp.uint8
+                                    ).astype(jnp.uint8)  # ignore counts [K,N]
+        carry0["gdup"] = jnp.zeros((k, n), jnp.uint8)    # any-duplicate [K,N]
+    if cfg.record_provenance:
+        # trace export needs the winning sender slot per first delivery —
+        # the one consumer that still wants per-slot bit sets
+        carry0["nv_acc"] = got_valid
 
-    def hop(carry):
-        (i, frontier, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
-         dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
+    def hop(c):
+        i, frontier, have_bits, dlv_bits, dlv_new = \
+            c["i"], c["frontier"], c["have"], c["dlv"], c["dlv_new"]
+        edge_used, arrivals, throttled, validated = \
+            c["edge_used"], c["arrivals"], c["throttled"], c["validated"]
         is_first = i == 0
         offered = gather_words_rows(frontier, nbr, m,
                                     cfg.edge_gather_mode) & allowed              # [W,K,N]
@@ -421,9 +457,10 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             # (peer_gater.go:404-407 ValidateMessage fires per admitted msg)
             validated = validated + popcount_sum(new_any, axis=0)
         new_valid = new_any & vm
-        nv_acc = nv_acc | (new_from_k & vm[:, None, :])
-        ni_acc = ni_acc | (new_from_k & inv_n[:, None, :])
-        ig_acc = ig_acc | (new_from_k & ign_n[:, None, :])
+        nv_ev = new_from_k & vm[:, None, :]
+        out = dict(c)
+        out["nv"] = c["nv"] + topic_counts(nv_ev)
+        out["ni"] = c["ni"] + topic_counts(new_from_k & inv_n[:, None, :])
         # mesh-delivery credit: any mesh sender of a message I hold valid
         # within the credit window — covers first-in-mesh (score.go:938-947)
         # and windowed duplicates (score.go:949-981). Invalid messages never
@@ -432,20 +469,33 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         # by honest-peer defenses, and the reference's spam actors run no
         # scoring at all (gossipsub_spam_test.go drives raw streams)
         elig = (window_old | dlv_new | new_valid) & valid_msg_bits[:, None]
-        dup_acc = dup_acc | (offered & mesh_eb & elig[:, None, :])
-        # gater duplicate stat: any offer of a message already seen OR won by
-        # another slot this same hop (pubsub.go:1145-1148 seen-cache hit ->
-        # DuplicateMessage; same-hop losers hit the cache the moment the
-        # winner marks it). Throttle-dropped arrivals were never marked seen,
-        # so their re-offers are not duplicates — new_any is post-throttle.
-        gdup_acc = gdup_acc | (offered & ~new_from_k
-                               & (have_bits | new_any)[:, None, :])
-        have_bits = have_bits | new_any
-        dlv_bits = dlv_bits | new_valid
-        dlv_new = dlv_new | new_valid
-        return (i + 1, new_valid, have_bits, dlv_bits, dlv_new, nv_acc,
-                ni_acc, ig_acc, dup_acc, gdup_acc, edge_used, arrivals,
-                throttled, validated)
+        out["dup"] = c["dup"] + topic_counts(offered & mesh_eb
+                                             & elig[:, None, :])
+        if cfg.gater_enabled:
+            out["ig"] = c["ig"] + popcount_sum(
+                new_from_k & ign_n[:, None, :], axis=0,
+                dtype=jnp.uint8).astype(jnp.uint8)
+            # gater duplicate stat: any offer of a message already seen OR
+            # won by another slot this same hop (pubsub.go:1145-1148
+            # seen-cache hit -> DuplicateMessage; same-hop losers hit the
+            # cache the moment the winner marks it). Throttle-dropped
+            # arrivals were never marked seen, so their re-offers are not
+            # duplicates — new_any is post-throttle.
+            out["gdup"] = c["gdup"] + popcount_sum(
+                offered & ~new_from_k & (have_bits | new_any)[:, None, :],
+                axis=0, dtype=jnp.uint8).astype(jnp.uint8)
+        if cfg.record_provenance:
+            out["nv_acc"] = c["nv_acc"] | nv_ev
+        out["i"] = i + 1
+        out["frontier"] = new_valid
+        out["have"] = have_bits | new_any
+        out["dlv"] = dlv_bits | new_valid
+        out["dlv_new"] = dlv_new | new_valid
+        out["edge_used"] = edge_used
+        out["arrivals"] = arrivals
+        out["throttled"] = throttled
+        out["validated"] = validated
+        return out
 
     # the hop loop is a lax.while_loop (not unrolled): one hop's code
     # compiles once, temporaries are reused across hops, the executable
@@ -453,19 +503,17 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # code) — and the loop exits as soon as the frontier empties (message
     # transit takes ~graph-diameter hops, typically < prop_substeps), a
     # hop with an empty frontier being a no-op
-    carry = (jnp.int32(0), frontier, have_bits, dlv_bits, dlv_new, nv_acc,
-             ni_acc, ig_acc, dup_acc, gdup_acc, edge_used, arrivals,
-             throttled, validated)
     carry = jax.lax.while_loop(
-        lambda c: (c[0] < cfg.prop_substeps) & jnp.any(c[1] != 0), hop, carry)
-    (_, _, have_bits, dlv_bits, dlv_new, nv_acc, ni_acc, ig_acc,
-     dup_acc, gdup_acc, edge_used, arrivals, throttled, validated) = carry
+        lambda c: (c["i"] < cfg.prop_substeps) & jnp.any(c["frontier"] != 0),
+        hop, carry0)
+    have_bits, dlv_bits = carry["have"], carry["dlv"]
+    arrivals, throttled, validated = \
+        carry["arrivals"], carry["throttled"], carry["validated"]
 
-    for ti in range(t):
-        tb = topic_bits[ti][:, None, None]
-        fmd_add = fmd_add.at[:, ti, :].add(popcount_sum(nv_acc & tb, axis=0).T)
-        imd_add = imd_add.at[:, ti, :].add(popcount_sum(ni_acc & tb, axis=0).T)
-        mmd_add = mmd_add.at[:, ti, :].add(popcount_sum(dup_acc & tb, axis=0).T)
+    # [T,K,N] uint8 counts -> [N,T,K] f32 counter increments
+    fmd_add = jnp.transpose(carry["nv"], (2, 0, 1)).astype(jnp.float32)
+    imd_add = jnp.transpose(carry["ni"], (2, 0, 1)).astype(jnp.float32)
+    mmd_add = jnp.transpose(carry["dup"], (2, 0, 1)).astype(jnp.float32)
 
     caps = tp.first_message_deliveries_cap[None, :, None], \
         tp.mesh_message_deliveries_cap[None, :, None]
@@ -483,7 +531,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         # winning sender slot per first delivery this tick (nv_acc holds the
         # per-slot first-delivery bit sets, pulls included) — trace export
         state = state._replace(deliver_from=jnp.where(
-            new_dlv_mask, _bits_to_slot(nv_acc, m), state.deliver_from))
+            new_dlv_mask, _bits_to_slot(carry["nv_acc"], m),
+            state.deliver_from))
 
     state = state._replace(
         have=have, deliver_tick=deliver_tick,
@@ -498,12 +547,16 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         # (peer_gater.go:366-453): deliver on first delivery (pulls included
         # via the seeded accumulators), duplicate on seen-cache hits,
         # ignore/reject on validation outcomes, throttle from the admission
-        # budget above
+        # budget above. Per-topic counts sum over T: the gater stats are
+        # topic-blind (peer_gater.go keys them by source only).
+        sum_t = lambda c: jnp.sum(c.astype(jnp.float32), axis=0).T  # noqa: E731
         state = state._replace(
-            gater_deliver=state.gater_deliver + popcount_sum(nv_acc, axis=0).T,
-            gater_duplicate=state.gater_duplicate + popcount_sum(gdup_acc, axis=0).T,
-            gater_ignore=state.gater_ignore + popcount_sum(ig_acc, axis=0).T,
-            gater_reject=state.gater_reject + popcount_sum(ni_acc, axis=0).T,
+            gater_deliver=state.gater_deliver + sum_t(carry["nv"]),
+            gater_duplicate=state.gater_duplicate
+            + carry["gdup"].astype(jnp.float32).T,
+            gater_ignore=state.gater_ignore
+            + carry["ig"].astype(jnp.float32).T,
+            gater_reject=state.gater_reject + sum_t(carry["ni"]),
             gater_validate=state.gater_validate + validated,
             gater_throttle=state.gater_throttle + throttled.astype(jnp.float32),
             gater_last_throttle=jnp.where(throttled > 0, state.tick,

@@ -83,6 +83,9 @@ class GossipSubRouter:
         self.opportunistic_graft_threshold = th.opportunistic_graft_threshold
 
         self._score_params = score_params
+        self._inspect_fn = None
+        self._inspect_ex_fn = None
+        self._inspect_period = 0.0
         self.score: PeerScore | None = None
         self.gossip_tracer: GossipPromiseTracker | None = None
         self.gate = gater
@@ -96,6 +99,35 @@ class GossipSubRouter:
 
     def _score_of(self, peer: PeerID) -> float:
         return self.score.score(peer) if self.score is not None else 0.0
+
+    def with_peer_score_inspect(self, inspect, period: float, *,
+                                extended: bool = False) -> None:
+        """WithPeerScoreInspect (score.go:143-180): register a periodic
+        score-debugging callback — ``{peer: score}`` by default, or
+        ``{peer: PeerScoreSnapshot}`` with ``extended=True`` (the
+        ExtendedPeerScoreInspectFn variant). Must be configured with
+        scoring enabled and at most once, as the reference enforces."""
+        if self._score_params is None:
+            raise ValueError("peer scoring is not enabled")
+        if self._inspect_fn is not None or self._inspect_ex_fn is not None:
+            raise ValueError("duplicate peer score inspector")
+        if period <= 0:
+            # a zero-period ticker would wedge the virtual clock (Go's
+            # time.NewTicker panics on non-positive periods)
+            raise ValueError("inspect period must be positive")
+        if extended:
+            self._inspect_ex_fn = inspect
+        else:
+            self._inspect_fn = inspect
+        self._inspect_period = period
+        if self.score is not None:          # post-attach registration
+            self._wire_inspect(self.p.scheduler)
+
+    def _wire_inspect(self, sched) -> None:
+        self.score.inspect = self._inspect_fn
+        self.score.inspect_ex = self._inspect_ex_fn
+        self.score.inspect_period = self._inspect_period
+        sched.call_every(self._inspect_period, self.score.inspect_scores)
 
     # -- Router interface --
 
@@ -120,6 +152,8 @@ class GossipSubRouter:
             sched.call_every(decay, self.score.refresh_scores)
             sched.call_every(60.0, self.score.refresh_ips)
             sched.call_every(60.0, self.score.gc_delivery_records)
+            if self._inspect_fn is not None or self._inspect_ex_fn is not None:
+                self._wire_inspect(sched)
         if self.gate is not None:
             self.gate.attach(p)
             p.tracer.add_raw(self.gate)

@@ -32,6 +32,35 @@ DELIVERY_IGNORED = 3
 DELIVERY_THROTTLED = 4
 
 
+class TopicScoreSnapshot:
+    """Per-topic counter dump for extended score inspection
+    (score.go:136-141 TopicScoreSnapshot)."""
+    __slots__ = ("time_in_mesh", "first_message_deliveries",
+                 "mesh_message_deliveries", "invalid_message_deliveries")
+
+    def __init__(self, time_in_mesh=0.0, first_message_deliveries=0.0,
+                 mesh_message_deliveries=0.0, invalid_message_deliveries=0.0):
+        self.time_in_mesh = time_in_mesh
+        self.first_message_deliveries = first_message_deliveries
+        self.mesh_message_deliveries = mesh_message_deliveries
+        self.invalid_message_deliveries = invalid_message_deliveries
+
+
+class PeerScoreSnapshot:
+    """Full per-peer score decomposition for extended inspection
+    (score.go:127-134 PeerScoreSnapshot)."""
+    __slots__ = ("score", "topics", "app_specific_score",
+                 "ip_colocation_factor", "behaviour_penalty")
+
+    def __init__(self, score=0.0, topics=None, app_specific_score=0.0,
+                 ip_colocation_factor=0.0, behaviour_penalty=0.0):
+        self.score = score
+        self.topics: dict[str, TopicScoreSnapshot] = topics or {}
+        self.app_specific_score = app_specific_score
+        self.ip_colocation_factor = ip_colocation_factor
+        self.behaviour_penalty = behaviour_penalty
+
+
 class _TopicStats:
     __slots__ = ("in_mesh", "graft_time", "mesh_time", "first_message_deliveries",
                  "mesh_message_deliveries", "mesh_message_deliveries_active",
@@ -128,8 +157,11 @@ class PeerScore(ev.RawTracerBase):
         self.deliveries = _MessageDeliveries(seen_ttl, now)
         self._whitelist_nets = [ipaddress.ip_network(c, strict=False)
                                 for c in params.ip_colocation_factor_whitelist]
-        # debugging inspection (score.go:127-180); called by the node's scheduler
+        # debugging inspection (score.go:127-180); called by the node's
+        # scheduler. `inspect` receives {peer: score}; `inspect_ex` receives
+        # {peer: PeerScoreSnapshot} (ExtendedPeerScoreInspectFn)
         self.inspect: Callable[[dict[str, float]], None] | None = None
+        self.inspect_ex: Callable[[dict[str, PeerScoreSnapshot]], None] | None = None
         self.inspect_period: float = 0.0
 
     # -- scoring (score.go:265-342) --
@@ -257,8 +289,37 @@ class PeerScore(ev.RawTracerBase):
         self.deliveries.gc()
 
     def inspect_scores(self) -> None:
+        """Dump tracked scores into the inspector(s) (score.go:446-460)."""
         if self.inspect is not None:
             self.inspect({p: self.score(p) for p in self.peer_stats})
+        if self.inspect_ex is not None:
+            self.inspect_ex(self.dump_snapshots())
+
+    def dump_snapshots(self) -> dict[str, PeerScoreSnapshot]:
+        """Extended per-peer decomposition (score.go:462-500
+        inspectScoresExtended): raw per-topic counters, raw app-specific
+        score and IP-colocation factor (unweighted, as the reference dumps
+        them), and the behaviour-penalty counter. TimeInMesh reports the
+        stored mesh_time, refreshed each decay pass, and only for peers
+        currently in the mesh — exactly the reference's `if ts.inMesh`."""
+        out: dict[str, PeerScoreSnapshot] = {}
+        for p, pstats in self.peer_stats.items():
+            topics: dict[str, TopicScoreSnapshot] = {}
+            for topic, ts in pstats.topics.items():
+                tss = TopicScoreSnapshot(
+                    first_message_deliveries=ts.first_message_deliveries,
+                    mesh_message_deliveries=ts.mesh_message_deliveries,
+                    invalid_message_deliveries=ts.invalid_message_deliveries)
+                if ts.in_mesh:
+                    tss.time_in_mesh = ts.mesh_time
+                topics[topic] = tss
+            out[p] = PeerScoreSnapshot(
+                score=self.score(p),
+                topics=topics,
+                app_specific_score=self.params.app_specific_score(p),
+                ip_colocation_factor=self.ip_colocation_factor(p),
+                behaviour_penalty=pstats.behaviour_penalty)
+        return out
 
     # -- RawTracer hooks (score.go:594-838) --
 

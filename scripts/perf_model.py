@@ -28,6 +28,7 @@ Usage: python scripts/perf_model.py [scenario] [--cost-analysis]
 """
 
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -232,12 +233,13 @@ def report(name, n, k, t, m, w, hops, p, design):
 
 def cost_analysis_check(n=10_000, k=32, m=64, p=8):
     """Compile each phase and print XLA's own bytes-accessed — an inventory
-    check. Forces the CPU backend BEFORE importing jax: in-process backend
-    init can hang forever on the wedged axon TPU plugin (the whole reason
-    utils/platform_probe.py probes in subprocesses), and the CPU lowering is
-    what this cross-check documents anyway."""
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    check. MUST run in a process whose environment was scrubbed BEFORE
+    python started (see main): the axon site hook monkeypatches
+    jax get_backend and initializes its client regardless of an in-process
+    JAX_PLATFORMS=cpu assignment, wedging forever when the tunnel is down
+    (verified by faulthandler: make_c_api_client inside
+    _axon_get_backend_uncached). The CPU lowering is what this cross-check
+    documents anyway."""
     import jax
     from __graft_entry__ import _build
     from go_libp2p_pubsub_tpu.ops.heartbeat import heartbeat
@@ -280,11 +282,21 @@ def main():
         raise SystemExit(f"unknown scenario {which!r}; "
                          f"choose from {', '.join(shapes)}")
     sh = shapes[which]
-    for design in ("current", "planned"):
-        report(which, design=design, **sh)
+    if os.environ.get("_PERF_MODEL_CHILD") != "1":    # parent prints these
+        for design in ("current", "planned"):
+            report(which, design=design, **sh)
     if "--cost-analysis" in sys.argv:
         # cross-check at the chosen shape, downscaled to 10k peers so the
-        # CPU compile stays sane (the inventory, not N, is what's checked)
+        # CPU compile stays sane (the inventory, not N, is what's checked).
+        # Re-exec in a scrubbed-env child: only an env set before process
+        # start dodges the axon plugin wedge (see cost_analysis_check).
+        if os.environ.get("_PERF_MODEL_CHILD") != "1":
+            from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
+            env = cpu_mesh_env(dict(os.environ))
+            env["_PERF_MODEL_CHILD"] = "1"
+            res = subprocess.run([sys.executable, "-u", __file__, which,
+                                  "--cost-analysis"], env=env)
+            raise SystemExit(res.returncode)
         cost_analysis_check(n=min(sh["n"], 10_000), k=sh["k"], m=sh["m"],
                             p=sh["p"])
 

@@ -500,6 +500,185 @@ class TestValidationThrottled:
         assert len(throttled) == 5
 
 
+class TestValidatorTimeout:
+    def _pair(self, **val_kw):
+        from go_libp2p_pubsub_tpu.api.validation import Validation
+        from go_libp2p_pubsub_tpu.trace import MemoryTracer
+
+        net = Network()
+        tracer = MemoryTracer()
+        ha, hb = net.add_host(), net.add_host()
+        a = PubSub(ha, GossipSubRouter(), sign_policy=LAX_NO_SIGN)
+        b = PubSub(hb, GossipSubRouter(), sign_policy=LAX_NO_SIGN,
+                   validation=Validation(**val_kw), event_tracer=tracer)
+        net.connect(ha, hb)
+        net.scheduler.run_for(0.2)
+        a.join("t").subscribe()
+        sub = b.join("t").subscribe()
+        net.scheduler.run_for(1.5)
+        return net, a, b, sub, tracer
+
+    def test_deadline_exceeded_is_ignored(self):
+        """WithValidatorTimeout (validation.go:564-570): an async validator
+        slower than its deadline yields IGNORE — the message is dropped and
+        traced as ignored, never delivered."""
+        from go_libp2p_pubsub_tpu.trace import events as ev
+
+        net, a, b, sub, tracer = self._pair()
+
+        def slow_accept(src, msg):
+            return 0                                  # would accept
+        slow_accept.virtual_duration = 2.0            # ... in 2 virtual secs
+
+        b.register_topic_validator("t", slow_accept, timeout=0.5)
+        a.my_topics["t"].publish(b"late")
+        net.scheduler.run_for(5.0)
+        assert drain(sub) == []
+        ignored = [e for e in tracer.events if e.get("type") == "REJECT_MESSAGE"
+                   and e["rejectMessage"]["reason"] == ev.REJECT_VALIDATION_IGNORED]
+        assert ignored, "deadline-exceeded validation must trace as ignored"
+
+    def test_slow_but_within_deadline_delivers_late(self):
+        """A validator inside its deadline delivers — after its virtual
+        duration elapses, not before (the throttle slot is held meanwhile)."""
+        net, a, b, sub, tracer = self._pair()
+
+        def slow_accept(src, msg):
+            return 0
+        slow_accept.virtual_duration = 1.0
+
+        b.register_topic_validator("t", slow_accept, timeout=5.0)
+        a.my_topics["t"].publish(b"ok")
+        net.scheduler.run_for(0.5)                    # mid-validation
+        assert drain(sub) == []
+        net.scheduler.run_for(2.0)                    # past the duration
+        got = drain(sub)
+        assert [m.data for m in got] == [b"ok"]
+
+    def test_no_timeout_unaffected(self):
+        """timeout=0 (the default) leaves slow validators un-deadlined."""
+        net, a, b, sub, tracer = self._pair()
+
+        def slow_accept(src, msg):
+            return 0
+        slow_accept.virtual_duration = 3.0
+
+        b.register_topic_validator("t", slow_accept)
+        a.my_topics["t"].publish(b"eventually")
+        net.scheduler.run_for(5.0)
+        assert [m.data for m in drain(sub)] == [b"eventually"]
+
+    def test_concurrent_validators_latency_is_max(self):
+        """validation.go:410-456 runs async validators in parallel
+        goroutines: total latency is max(durations), not the sum."""
+        net, a, b, sub, tracer = self._pair()
+
+        def v1(src, msg):
+            return 0
+        v1.virtual_duration = 1.0
+
+        def v2(src, msg):
+            return 0
+        v2.virtual_duration = 2.0
+
+        b.val.add_default_validator(v1)
+        b.register_topic_validator("t", v2)
+        a.my_topics["t"].publish(b"x")
+        net.scheduler.run_for(2.5)                   # > max(1,2), < 1+2
+        assert [m.data for m in drain(sub)] == [b"x"]
+
+    def test_raising_validator_releases_throttle_slots(self):
+        """A validator that raises must not leak its throttle slots — the
+        old finally-based accounting guaranteed this and so must the
+        deferred-verdict path."""
+        from go_libp2p_pubsub_tpu.api.validation import Validation
+        from go_libp2p_pubsub_tpu.core.types import Message
+
+        val = Validation()
+
+        class P:                                     # minimal PubSub stand-in
+            class tracer:
+                reject_message = staticmethod(lambda *a: None)
+                throttle_peer = staticmethod(lambda *a: None)
+        val.p = P()
+
+        def boom(src, msg):
+            raise RuntimeError("validator bug")
+
+        val.add_validator("t", boom)
+        v = val.topic_vals["t"]
+        val.throttled += 1                           # caller-side acquire
+        with pytest.raises(RuntimeError):
+            val._do_validate_topic([v], "peer", Message(topic="t"), 0)
+        assert v.inflight == 0
+        assert val.throttled == 0
+
+
+class TestPeerScoreInspect:
+    def test_simple_and_extended_snapshots(self):
+        """WithPeerScoreInspect both variants (score.go:127-180): the simple
+        fn sees {peer: score}; the extended fn sees PeerScoreSnapshots with
+        per-topic counters — mirroring TestPeerScoreInspect-style checks."""
+        from go_libp2p_pubsub_tpu.core.params import (
+            PeerScoreParams, PeerScoreThresholds, TopicScoreParams)
+
+        net = Network()
+        nodes = []
+        for i in range(4):
+            h = net.add_host()
+            sp = PeerScoreParams(
+                app_specific_score=lambda p: 7.0,
+                app_specific_weight=1.0,
+                decay_interval=1.0, decay_to_zero=0.01,
+                topics={"t": TopicScoreParams(
+                    topic_weight=1.0, time_in_mesh_quantum=1.0,
+                    first_message_deliveries_weight=1.0,
+                    first_message_deliveries_decay=0.9,
+                    first_message_deliveries_cap=100.0)})
+            rt = GossipSubRouter(score_params=sp,
+                                 thresholds=PeerScoreThresholds())
+            nodes.append(PubSub(h, rt, sign_policy=LAX_NO_SIGN))
+        simple_dumps, ex_dumps = [], []
+        nodes[0].rt.with_peer_score_inspect(simple_dumps.append, 1.0)
+        nodes[1].rt.with_peer_score_inspect(ex_dumps.append, 1.0,
+                                            extended=True)
+        net.connect_all([x.host for x in nodes])
+        net.scheduler.run_for(0.2)
+        subs = [x.join("t").subscribe() for x in nodes]
+        net.scheduler.run_for(2.0)
+        for i in range(5):
+            nodes[2].my_topics["t"].publish(b"m%d" % i)
+            net.scheduler.run_for(0.5)
+        net.scheduler.run_for(2.0)
+
+        assert simple_dumps and ex_dumps
+        scores = simple_dumps[-1]
+        assert set(scores) == {x.pid for x in nodes[1:]}
+        snaps = ex_dumps[-1]
+        assert set(snaps) == {x.pid for x in nodes if x is not nodes[1]}
+        snap = snaps[nodes[2].pid]                    # the publisher
+        # raw components are dumped unweighted (score.go:480-494)
+        assert snap.app_specific_score == 7.0
+        assert snap.behaviour_penalty == 0.0
+        ts = snap.topics["t"]
+        assert ts.first_message_deliveries > 0        # it delivered firsts
+        assert ts.time_in_mesh > 0                    # and sits in the mesh
+        # the reported total equals the live score fn
+        assert snap.score == pytest.approx(
+            nodes[1].rt.score.score(nodes[2].pid))
+
+    def test_inspect_requires_scoring_and_uniqueness(self):
+        rt = GossipSubRouter()
+        with pytest.raises(ValueError, match="not enabled"):
+            rt.with_peer_score_inspect(lambda d: None, 1.0)
+        from go_libp2p_pubsub_tpu.core.params import PeerScoreParams
+        rt2 = GossipSubRouter(score_params=PeerScoreParams(
+            app_specific_score=lambda p: 0.0, decay_interval=1.0))
+        rt2.with_peer_score_inspect(lambda d: None, 1.0)
+        with pytest.raises(ValueError, match="duplicate"):
+            rt2.with_peer_score_inspect(lambda d: None, 1.0, extended=True)
+
+
 class TestRpcInspector:
     def test_inspector_gates_all_rpcs(self):
         """WithAppSpecificRpcInspector (pubsub.go:1031-1037): a False verdict
