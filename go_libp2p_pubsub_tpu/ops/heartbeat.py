@@ -287,8 +287,13 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     gossip_cand = conn & nbr_sub & ~new_mesh & ~new_fanout & ~direct3 & \
         (s >= cfg.gossip_threshold) & (joined | fa3)
     n_cand = jnp.sum(gossip_cand, axis=-1)
-    target = jnp.maximum(cfg.dlazy,
-                         jnp.floor(cfg.gossip_factor * n_cand).astype(jnp.int32))
+    # the product is PINNED to f32 (explicit casts) so the traced dtype
+    # cannot drift to f64 under jax_enable_x64 — the static bound below is
+    # derived in the same f32 arithmetic and floor(f64) could otherwise
+    # exceed it by one, silently under-selecting gossip peers
+    target = jnp.maximum(cfg.dlazy, jnp.floor(
+        jnp.float32(cfg.gossip_factor) * n_cand.astype(jnp.float32)
+    ).astype(jnp.int32))
     # static bound: target = max(Dlazy, floor(factor * n_cand)), n_cand <= K.
     # Derived in the SAME f32 arithmetic as the traced target so the bound
     # can never round below it (f64 int(factor*k) can be one less than

@@ -14,6 +14,26 @@ import jax.numpy as jnp
 
 NEG_INF = jnp.float32(-1e30)
 
+# Test-time guard for the count <= max_count precondition of the iterative
+# formulation (see _select_iter): flip on in tests/debug runs to turn a
+# silent truncation into a loud failure. Off by default — the check inserts
+# a host callback into the jitted program. The flag is read at TRACE time:
+# callables jitted before flipping it keep their cached guard-free traces,
+# so set it before any engine call (or call jax.clear_caches() after).
+CHECK_COUNT_BOUND = False
+
+
+def _check_count_bound(count: jnp.ndarray, max_count: int) -> None:
+    if not CHECK_COUNT_BOUND:
+        return
+
+    def _raise(over):
+        if over:
+            raise AssertionError(
+                f"selection count exceeds the static max_count={max_count} "
+                "bound; the iterative formulation would silently truncate")
+    jax.debug.callback(_raise, jnp.any(count > max_count))
+
 
 def ranks_desc(keys: jnp.ndarray) -> jnp.ndarray:
     """Rank (0 = largest) of each element along the last axis; ties break
@@ -85,6 +105,7 @@ def _select_by_keys(keys: jnp.ndarray, mask: jnp.ndarray,
     k = keys.shape[-1]
     mode = resolve_selection_mode(mode, k, max_count)
     if mode == "iter":
+        _check_count_bound(count, max_count)
         return _select_iter(keys, mask, count, max_count)
     if mode == "sort":
         # exact tie handling (float32 keys DO collide at 4M draws/call)
@@ -116,6 +137,12 @@ def select_random(mask: jnp.ndarray, count: jnp.ndarray, key: jax.Array, *,
     count broadcasts against mask.shape[:-1]. Ties impossible w.p. 1.
     ``max_count`` is a static upper bound on count enabling the iterative
     formulation; ``mode`` picks it explicitly (SimConfig.selection_mode).
+
+    PRECONDITION: every element of ``count`` must be <= ``max_count`` when
+    one is given — the iterative formulation runs exactly max_count argmax
+    passes and SILENTLY truncates larger requests. All engine callers derive
+    count by clipping against the same degree parameter they pass as the
+    bound; enable selection.CHECK_COUNT_BOUND in tests to enforce it.
     """
     noise = jax.random.uniform(key, mask.shape)
     keys = jnp.where(mask, noise, NEG_INF)
@@ -129,6 +156,9 @@ def select_top(score: jnp.ndarray, mask: jnp.ndarray, count: jnp.ndarray, *,
 
     Deterministic tie-break by slot index (lower slot wins), mirroring the
     sorted-iteration determinism the batched engine guarantees.
+
+    PRECONDITION: count <= max_count elementwise when a bound is given —
+    see select_random.
     """
     k = mask.shape[-1]
     tiebreak = -jnp.arange(k, dtype=jnp.float32) * 1e-9
