@@ -23,7 +23,12 @@ from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
 from .bits import U32
 from .permgather import permutation_gather
-from .score_ops import apply_prune_penalty, compute_scores
+from .score_ops import (
+    advance_active_latch,
+    apply_prune_penalty,
+    compute_scores,
+    decayed,
+)
 from .selection import masked_median, select_random, select_top
 
 
@@ -111,7 +116,13 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     tick = state.tick
     ks = jax.random.split(key, 8)
 
-    scores_all = compute_scores(state, cfg, tp, mask_disconnected=False)
+    # P3 activation latch advances where the decay pass used to run —
+    # before scores are computed (PERF_MODEL.md S5 inline-decay layout)
+    state = advance_active_latch(state, tp)
+    # apply_decay: engine counters are stored pre-decay; this read applies
+    # the tick's decay inline (score_ops docstring, PERF_MODEL.md S5)
+    scores_all = compute_scores(state, cfg, tp, mask_disconnected=False,
+                                apply_decay=True)
     scores = jnp.where(state.connected, scores_all, 0.0)         # [N, K]
     s = scores[:, None, :]                           # broadcast over T
     sb = jnp.broadcast_to(s, (n, t, k))
@@ -234,7 +245,12 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     flood = backoff_active & (tick < prune_tick + cfg.graft_flood_ticks)
     bp_add = jnp.sum(inc_graft & backoff_active, axis=1).astype(jnp.float32) \
         + jnp.sum(inc_graft & flood, axis=1).astype(jnp.float32)
-    behaviour_penalty = state.behaviour_penalty + bp_add
+    # behaviour_penalty's per-tick decay folds into this write site
+    # (forward_tick's broken-promise points add to the already-decayed
+    # value afterward, as the old decay-at-tick-start ordering did)
+    behaviour_penalty = decayed(state.behaviour_penalty,
+                                cfg.behaviour_penalty_decay,
+                                cfg.decay_to_zero) + bp_add
 
     refused_back, = edge_gather_packed([refuse], state,
                                        cfg.edge_gather_mode)
@@ -275,7 +291,11 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     st = state._replace(mesh=new_mesh, backoff=new_backoff,
                         behaviour_penalty=behaviour_penalty,
                         fanout=new_fanout, fanout_lastpub=fanout_lastpub)
-    st = apply_prune_penalty(st, removed, tp)
+    # the heartbeat call is mfp's once-per-tick decay site; churn's later
+    # RemovePeer-time calls add verbatim (apply_decay stays False there)
+    st = apply_prune_penalty(st, removed, tp,
+                             decay_to_zero=cfg.decay_to_zero,
+                             apply_decay=True)
     st = st._replace(
         graft_tick=jnp.where(newly, tick, st.graft_tick),
         mesh_active=jnp.where(newly, False, st.mesh_active))

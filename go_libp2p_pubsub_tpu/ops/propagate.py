@@ -62,6 +62,7 @@ from .bits import (
 )
 from . import gater
 from .heartbeat import edge_gather
+from .score_ops import decayed
 from .selection import select_random
 
 
@@ -515,11 +516,23 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     imd_add = jnp.transpose(carry["ni"], (2, 0, 1)).astype(jnp.float32)
     mmd_add = jnp.transpose(carry["dup"], (2, 0, 1)).astype(jnp.float32)
 
+    # the delivery counters' once-per-tick write site: fold this tick's
+    # decay into the update (score_ops module docstring) — stored value is
+    # min(zclamp(counter * decay) + arrivals, cap), the old
+    # decay-pass-then-add ordering exactly
+    def t2(x):
+        return x[None, :, None]
+    z = cfg.decay_to_zero
     caps = tp.first_message_deliveries_cap[None, :, None], \
         tp.mesh_message_deliveries_cap[None, :, None]
-    fmd = jnp.minimum(state.first_message_deliveries + fmd_add, caps[0])
-    mmd = jnp.minimum(state.mesh_message_deliveries + mmd_add, caps[1])
-    imd = state.invalid_message_deliveries + imd_add
+    fmd = jnp.minimum(
+        decayed(state.first_message_deliveries,
+                t2(tp.first_message_deliveries_decay), z) + fmd_add, caps[0])
+    mmd = jnp.minimum(
+        decayed(state.mesh_message_deliveries,
+                t2(tp.mesh_message_deliveries_decay), z) + mmd_add, caps[1])
+    imd = decayed(state.invalid_message_deliveries,
+                  t2(tp.invalid_message_deliveries_decay), z) + imd_add
 
     newly_dlv = dlv_bits & ~dlv_start
     have = unpack_words(have_bits, m)

@@ -4,6 +4,18 @@ Vectorized twin of routers/score.py (itself mirroring score.go:265-342
 ``score()`` and score.go:504-565 ``refreshScores``). The observer axis is N,
 the observed neighbor lives in slot k; topic axis T carries the [T]-shaped
 TopicParams. One fused elementwise pass; XLA fuses the reductions.
+
+Decay placement (PERF_MODEL.md S5): the engine runs NO standalone decay
+pass. The stored counters are "pre-decay" values; every reader applies
+``zclamp(counter * decay)`` inline (compute_scores, the prune-penalty
+deficit) and every per-tick writer folds the same decay into its write
+(forward_tick attribution for fmd/mmd/imd, the heartbeat for
+behaviour_penalty and mesh_failure_penalty, advance_active_latch for the
+P3 activation). Stored values at tick boundaries are bit-identical to the
+old decay-pass ordering — decay-then-add with cap-at-add, exactly
+score.go:504-565 + 899-981 — while the dedicated 150 MB/tick pass
+disappears. ``decay_counters`` remains as the reference formulation for
+ablations and tests.
 """
 
 from __future__ import annotations
@@ -14,13 +26,28 @@ from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
 
 
+def decayed(v: jnp.ndarray, factor, z: float) -> jnp.ndarray:
+    """One refreshScores decay step applied inline at a read/write site:
+    multiply by the decay factor, zero below decay_to_zero (score.go:504-565)."""
+    v = v * factor
+    return jnp.where(v < z, 0.0, v)
+
+
 def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams,
-                   mask_disconnected: bool = True) -> jnp.ndarray:
+                   mask_disconnected: bool = True,
+                   apply_decay: bool = False) -> jnp.ndarray:
     """Score of the peer in slot k as seen by observer n -> [N, K] f32.
 
     Mirrors score.go:265-342; disconnected/empty slots score 0 unless
     ``mask_disconnected=False``, which exposes the retained counters of down
     edges (score.go:611-644 RetainScore — used by the PX reconnect gate).
+
+    The DEFAULT contract scores the stored counter values verbatim — what
+    golden tests, trace replay, and any decay_counters composition expect.
+    The engine's heartbeat passes ``apply_decay=True``: its counters are
+    stored pre-decay (module docstring) and this tick's decay applies
+    inline at the read, reproducing the old decay-pass-then-score ordering
+    exactly.
     """
     if not cfg.scoring_enabled:
         return jnp.zeros(state.behaviour_penalty.shape, jnp.float32)
@@ -29,6 +56,9 @@ def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams,
     def t_(x):
         return x[None, :, None]
 
+    z = cfg.decay_to_zero
+    # identity "decay" when scoring stored values verbatim (unit tests)
+    dec = decayed if apply_decay else (lambda v, factor, z: v)
     in_mesh = state.mesh
     mesh_time = jnp.where(in_mesh, (state.tick - state.graft_tick).astype(jnp.float32), 0.0)
     # P1: floor(mesh_time/quantum), capped (score.go:285-291)
@@ -36,15 +66,21 @@ def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams,
                      t_(tp.time_in_mesh_cap))
     topic_score = jnp.where(in_mesh, p1 * t_(tp.time_in_mesh_weight), 0.0)
     # P2
-    topic_score += state.first_message_deliveries * t_(tp.first_message_deliveries_weight)
+    topic_score += dec(state.first_message_deliveries,
+                           t_(tp.first_message_deliveries_decay), z) \
+        * t_(tp.first_message_deliveries_weight)
     # P3: squared deficit once activated (score.go:297-303)
-    deficit = t_(tp.mesh_message_deliveries_threshold) - state.mesh_message_deliveries
+    deficit = t_(tp.mesh_message_deliveries_threshold) - dec(
+        state.mesh_message_deliveries, t_(tp.mesh_message_deliveries_decay), z)
     p3 = jnp.where(state.mesh_active & (deficit > 0), deficit * deficit, 0.0)
     topic_score += p3 * t_(tp.mesh_message_deliveries_weight)
     # P3b
-    topic_score += state.mesh_failure_penalty * t_(tp.mesh_failure_penalty_weight)
+    topic_score += dec(state.mesh_failure_penalty,
+                           t_(tp.mesh_failure_penalty_decay), z) \
+        * t_(tp.mesh_failure_penalty_weight)
     # P4: squared counter
-    topic_score += (state.invalid_message_deliveries ** 2) * \
+    topic_score += (dec(state.invalid_message_deliveries,
+                            t_(tp.invalid_message_deliveries_decay), z) ** 2) * \
         t_(tp.invalid_message_deliveries_weight)
 
     score = jnp.sum(topic_score * t_(tp.topic_weight), axis=1)  # [N, K]
@@ -65,7 +101,8 @@ def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams,
         score += cfg.ip_colocation_factor_weight * p6[nbr]
     # P7: behaviour penalty excess^2 (score.go:334-339)
     if cfg.behaviour_penalty_weight != 0.0:
-        excess = state.behaviour_penalty - cfg.behaviour_penalty_threshold
+        bp = dec(state.behaviour_penalty, cfg.behaviour_penalty_decay, z)
+        excess = bp - cfg.behaviour_penalty_threshold
         score += jnp.where(excess > 0, excess * excess, 0.0) * cfg.behaviour_penalty_weight
 
     if mask_disconnected:
@@ -73,10 +110,29 @@ def compute_scores(state: SimState, cfg: SimConfig, tp: TopicParams,
     return jnp.where(state.neighbors >= 0, score, 0.0)
 
 
+def advance_active_latch(state: SimState, tp: TopicParams) -> SimState:
+    """Advance the P3 activation latch (score.go:550-556: refreshScores sets
+    mesh_message_deliveries_active once mesh_time exceeds the activation
+    window). Under the no-decay-pass layout this runs at the top of the
+    heartbeat, before compute_scores — the same point in the tick the decay
+    pass used to run."""
+    def t_(x):
+        return x[None, :, None]
+
+    mesh_time = (state.tick - state.graft_tick).astype(jnp.float32)
+    active = state.mesh_active | (
+        state.mesh & (mesh_time > t_(tp.mesh_message_deliveries_activation_ticks)))
+    return state._replace(mesh_active=active)
+
+
 def decay_counters(state: SimState, cfg: SimConfig, tp: TopicParams) -> SimState:
     """refreshScores' decay pass (score.go:504-565), one tick == DecayInterval.
 
     Also advances the P3 activation latch (mesh_time > activation).
+
+    NOT called by the engine anymore (module docstring): kept as the
+    reference formulation for ablations and equivalence tests against the
+    inline-decay layout.
     """
     def t_(x):
         return x[None, :, None]
@@ -100,17 +156,34 @@ def decay_counters(state: SimState, cfg: SimConfig, tp: TopicParams) -> SimState
         behaviour_penalty=bp, mesh_active=active)
 
 
-def apply_prune_penalty(state: SimState, pruned: jnp.ndarray,
-                        tp: TopicParams) -> SimState:
+def apply_prune_penalty(state: SimState, pruned: jnp.ndarray, tp: TopicParams,
+                        decay_to_zero: float = 0.0,
+                        apply_decay: bool = False) -> SimState:
     """P3b sticky failure penalty on prune (score.go:672-694): where an edge
     is pruned while the P3 penalty is active and under threshold, add the
-    squared deficit; then clear the activation latch for the slot."""
+    squared deficit; then clear the activation latch for the slot.
+
+    The DEFAULT adds to the stored values verbatim (churn's RemovePeer-time
+    calls and standalone tests — their counters already carry this tick's
+    decay). The heartbeat passes ``apply_decay=True``: its call is
+    mesh_failure_penalty's once-per-tick decay site (module docstring), so
+    the deficit reads this tick's decayed mmd view and the stored mfp
+    becomes zclamp(mfp * decay) + add — the old decay-then-add ordering.
+    Decay must fold in EXACTLY ONE call per tick."""
     def t_(x):
         return x[None, :, None]
 
-    deficit = t_(tp.mesh_message_deliveries_threshold) - state.mesh_message_deliveries
+    if apply_decay:
+        mmd = decayed(state.mesh_message_deliveries,
+                      t_(tp.mesh_message_deliveries_decay), decay_to_zero)
+        mfp = decayed(state.mesh_failure_penalty,
+                      t_(tp.mesh_failure_penalty_decay), decay_to_zero)
+    else:
+        mmd = state.mesh_message_deliveries
+        mfp = state.mesh_failure_penalty
+    deficit = t_(tp.mesh_message_deliveries_threshold) - mmd
     add = jnp.where(pruned & state.mesh_active & (deficit > 0), deficit * deficit, 0.0)
     return state._replace(
-        mesh_failure_penalty=state.mesh_failure_penalty + add,
+        mesh_failure_penalty=mfp + add,
         mesh_active=jnp.where(pruned, False, state.mesh_active),
         graft_tick=jnp.where(pruned, NEVER, state.graft_tick))

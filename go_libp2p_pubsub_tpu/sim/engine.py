@@ -7,10 +7,14 @@ decay ticker, plus the continuous data plane):
     step: (state, key) -> state
       0. churn              (optional) edge down/up round, RemovePeer semantics
       1. publish            P scenario-chosen messages enter the network
-      2. decay_counters     refreshScores' decay pass (DecayInterval == tick)
-      3. heartbeat          mesh maintenance + GRAFT/PRUNE exchange + gossip
-                            peer selection
-      4. forward_tick       IWANT resolution, mesh forwarding hops, IHAVE emit
+      2. heartbeat          mesh maintenance + GRAFT/PRUNE exchange + gossip
+                            peer selection (score decay applies INLINE at
+                            every counter read/write site — there is no
+                            standalone decay pass; see ops/score_ops
+                            docstring, PERF_MODEL.md S5. Stored counters at
+                            tick boundaries are bit-identical to the old
+                            decay-pass ordering.)
+      3. forward_tick       IWANT resolution, mesh forwarding hops, IHAVE emit
 
 The Go router interleaves these nondeterministically across goroutines; the
 engine fixes the canonical order above (SURVEY.md §7 "Order-sensitivity").
@@ -29,7 +33,6 @@ from ..ops.churn import churn_edges, churn_subscriptions
 from ..ops.gater import gater_decay
 from ..ops.heartbeat import HeartbeatOut, heartbeat
 from ..ops.propagate import forward_tick, publish
-from ..ops.score_ops import decay_counters
 from .config import SimConfig, TopicParams
 from .state import NEVER, SimState
 
@@ -55,7 +58,6 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
         state = churn_subscriptions(state, cfg, tp, k_sub)
     peers, topics = choose_publishers(state, cfg, k_pub)
     state = publish(state, cfg, peers, topics, k_ign)
-    state = decay_counters(state, cfg, tp)
     if cfg.gater_enabled:
         state = gater_decay(state, cfg)
     if cfg.router == "gossipsub":

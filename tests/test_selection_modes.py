@@ -85,16 +85,21 @@ class TestOpParity:
         import go_libp2p_pubsub_tpu.ops.selection as sel
         monkeypatch.setattr(sel, "CHECK_COUNT_BOUND", True)
         jax.clear_caches()   # the flag is read at trace time (see its doc)
-        keys = jnp.array([[4.0, 3.0, 2.0, 1.0]])
-        mask = jnp.ones((1, 4), bool)
-        with pytest.raises(Exception, match="max_count"):
-            out = _select_by_keys(keys, mask, jnp.array([3]), max_count=2,
-                                  mode="iter")
-            jax.block_until_ready(out)
-        # in-bound counts pass through the guard untouched
-        ok = _select_by_keys(keys, mask, jnp.array([2]), max_count=2,
-                             mode="iter")
-        assert int(jnp.sum(ok)) == 2
+        try:
+            keys = jnp.array([[4.0, 3.0, 2.0, 1.0]])
+            mask = jnp.ones((1, 4), bool)
+            with pytest.raises(Exception, match="max_count"):
+                out = _select_by_keys(keys, mask, jnp.array([3]), max_count=2,
+                                      mode="iter")
+                jax.block_until_ready(out)
+            # in-bound counts pass through the guard untouched
+            ok = _select_by_keys(keys, mask, jnp.array([2]), max_count=2,
+                                 mode="iter")
+            assert int(jnp.sum(ok)) == 2
+        finally:
+            # purge guard-instrumented traces so the rest of the session
+            # dispatches guard-free code again
+            jax.clear_caches()
 
     def test_resolver_policy(self):
         # iter requires a static bound well under K

@@ -240,7 +240,10 @@ class TestBatchedScoring:
             graft_tick=st.graft_tick.at[0, 0, 0].set(3),
             first_message_deliveries=st.first_message_deliveries.at[0, 0, 0].set(4.0),
             invalid_message_deliveries=st.invalid_message_deliveries.at[0, 0, 0].set(3.0))
-        s = compute_scores(st, cfg, tp)
+        # apply_decay=False: this spot-checks the P-term arithmetic on the
+        # stored values verbatim (counters are stored pre-decay and scored
+        # through an inline decay in the engine — score_ops docstring)
+        s = compute_scores(st, cfg, tp, apply_decay=False)
         # 7 (P1) + 4 (P2) - 9 (P4) = 2
         assert float(s[0, 0]) == pytest.approx(2.0)
         # empty slot scores 0
