@@ -7,8 +7,13 @@ same round via edge gathers (the (n,k)->(j,reverse_slot) mapping is a
 permutation of directed edge slots, so receiver-side views are gathers, not
 scatters).
 
-Round semantics: every decision reads the pre-round state (SURVEY.md §7
-"Order-sensitivity vs batching" — canonical order with stable tie-breaks).
+Round semantics: decisions read the pre-round state (SURVEY.md §7
+"Order-sensitivity vs batching" — canonical order with stable tie-breaks),
+with ONE deliberate exception: receiver-side GRAFT vetting serializes
+acceptance WITHIN the round (lowest-slot-first against the growing mesh,
+including the receiver's own round grafts) to mirror the reference's
+serial handleGraft Dhi check — see the capacity-budget block in
+heartbeat() and ROUND4_NOTES.md "Parity offset".
 """
 
 from __future__ import annotations
@@ -229,13 +234,39 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     inc_graft, inc_prune = edge_gather_packed([grafts, prunes], state,
                                              cfg.edge_gather_mode)
 
-    # receiver-side GRAFT vetting (gossipsub.go:741-837): refuse when not
-    # joined, in backoff, sender score negative, mesh full (unless outbound),
-    # or a direct peer
-    mesh_count_pre = jnp.sum(state.mesh, axis=-1, keepdims=True)
-    refuse = inc_graft & (~joined | backoff_active | (s < 0)
-                          | ((mesh_count_pre >= cfg.dhi) & ~out3) | direct3)
-    accept = inc_graft & ~refuse
+    # receiver-side GRAFT vetting (gossipsub.go:741-837). A GRAFT from a
+    # peer already in my (post-own-grafts) mesh is a no-op accept
+    # (gossipsub.go:758-767) — without this, a capacity refusal of one
+    # side of a MUTUAL same-round graft would leave a half-edge and break
+    # mesh symmetry. Hard refusals for not-joined, backoff, negative
+    # sender score, or direct peers...
+    already = inc_graft & mesh5
+    hard_refuse = inc_graft & ~already & \
+        (~joined | backoff_active | (s < 0) | direct3)
+    cand_graft = inc_graft & ~already & ~hard_refuse
+    # ...and a CAPACITY-BUDGETED Dhi check: the serial reference vets each
+    # GRAFT against its mesh as it GROWS within the heartbeat
+    # (gossipsub.go:804-812), so a receiver never overshoots Dhi from a
+    # burst of same-round grafts. A pre-round-mesh check accepted them all,
+    # overshot, and the next tick's over-subscription pass slashed to D
+    # with 60-tick backoffs — depressing the equilibrium degree a full
+    # point below the functional runtime (ROUND4_NOTES.md "Parity
+    # offset"). Non-outbound grafts are accepted lowest-slot-first up to
+    # the headroom left by the receiver's own round grafts; outbound
+    # grafts bypass the check, as in the reference.
+    n_mine = jnp.sum(mesh5, axis=-1, keepdims=True)
+    acc_out = cand_graft & out3                  # outbound: always accepted
+    nonout = cand_graft & ~out3
+    # serial arrival in slot order: a non-outbound graft is accepted iff
+    # the mesh at its arrival (own grafts + everything accepted in lower
+    # slots, outbound included — accepted outbound grafts grow the mesh
+    # and consume Dhi headroom for later arrivals) is still below Dhi
+    c_out_excl = jnp.cumsum(acc_out.astype(jnp.int32), axis=-1) \
+        - acc_out.astype(jnp.int32)
+    rank = jnp.cumsum(nonout.astype(jnp.int32), axis=-1)    # 1-based
+    accept = already | acc_out | \
+        (nonout & (n_mine + c_out_excl + rank <= cfg.dhi))
+    refuse = inc_graft & ~accept
     # graft-during-backoff behaviour penalty (gossipsub.go:781-795): one
     # point always, a second point when the GRAFT lands within the flood
     # window right after the PRUNE that set the backoff (the reference

@@ -1,0 +1,136 @@
+"""Is the native C++ trace tensorizer worth its 700 LoC? (VERDICT r3 #7)
+
+The C++ scanner exists for 100k-peer traces at hundreds of MB (SURVEY.md §7
+"Host/device boundary in trace replay"). This benchmark builds a synthetic
+>= 100 MB encoded TraceEvent stream with a realistic event mix (deliveries,
+duplicates, graft/prune, decay boundaries) and measures bytes -> ReplayFeed
+throughput both ways:
+
+  python: pb.codec.decode_trace_bytes + trace.replay.tensorize_trace
+  native: trace.native.tensorize_bytes (single C++ pass over the bytes)
+
+Prints MB/s for each and the ratio. ROUND4_NOTES.md records the verdict:
+the C++ stays only if it is >= 5x at scale.
+
+Usage: python scripts/bench_native_codec.py [target_mb]
+(re-execs into a scrubbed-env child: the axon site hook wedges any
+in-process jax import while the tunnel is down).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_stream(target_mb: int, n_peers: int = 256, n_topics: int = 4):
+    """Synthesize an encoded delimited TraceEvent stream of ~target_mb MB.
+
+    Mix per round (one publisher): 1 PUBLISH + D DELIVERs + 2D DUPLICATEs +
+    occasional GRAFT/PRUNE churn; timestamps advance so decay boundaries
+    interleave the way a real 1s-heartbeat trace has them.
+    """
+    from go_libp2p_pubsub_tpu.pb import codec
+
+    peers = [f"peer-{i}" for i in range(n_peers)]
+    topics = [f"topic-{i}" for i in range(n_topics)]
+    out = bytearray()
+    target = target_mb * 1_000_000
+    t = 0.0
+    rounds = 0
+    n_events = 0
+
+    def emit(e):
+        nonlocal n_events
+        blob = codec.encode_trace_event(e)
+        out.extend(codec.write_uvarint(len(blob)))
+        out.extend(blob)
+        n_events += 1
+
+    while len(out) < target:
+        pub = peers[rounds % n_peers]
+        topic = topics[rounds % n_topics]
+        mid = f"{pub}-m{rounds}"
+        t += 0.13
+        emit({"type": "PUBLISH_MESSAGE", "peerID": pub, "timestamp": t,
+              "publishMessage": {"messageID": mid, "topic": topic}})
+        for d in range(12):
+            obs = peers[(rounds * 7 + d) % n_peers]
+            frm = peers[(rounds * 11 + d) % n_peers]
+            emit({"type": "DELIVER_MESSAGE", "peerID": obs, "timestamp": t,
+                  "deliverMessage": {"messageID": mid, "topic": topic,
+                                     "receivedFrom": frm}})
+        for d in range(24):
+            obs = peers[(rounds * 5 + d) % n_peers]
+            frm = peers[(rounds * 13 + d) % n_peers]
+            emit({"type": "DUPLICATE_MESSAGE", "peerID": obs, "timestamp": t,
+                  "duplicateMessage": {"messageID": mid, "topic": topic,
+                                       "receivedFrom": frm}})
+        if rounds % 8 == 0:
+            a = peers[(rounds * 3) % n_peers]
+            b = peers[(rounds * 3 + 1) % n_peers]
+            emit({"type": "GRAFT", "peerID": a, "timestamp": t,
+                  "graft": {"peerID": b, "topic": topic}})
+            emit({"type": "PRUNE", "peerID": b, "timestamp": t,
+                  "prune": {"peerID": a, "topic": topic}})
+        rounds += 1
+    return bytes(out), rounds, n_events, peers, topics
+
+
+def child_main(target_mb: int) -> None:
+    from go_libp2p_pubsub_tpu.pb import codec
+    from go_libp2p_pubsub_tpu.trace import native, tensorize_trace
+
+    t0 = time.perf_counter()
+    data, rounds, n_events, peers, topics = build_stream(target_mb)
+    mb = len(data) / 1e6
+    print(f"stream: {mb:.1f} MB, {n_events} events, {rounds} message ids "
+          f"(built in {time.perf_counter() - t0:.1f}s)", flush=True)
+    peer_index = {p: i for i, p in enumerate(peers)}
+    topic_index = {tp: i for i, tp in enumerate(topics)}
+    kw = dict(msg_window=rounds + 1, decay_interval=1.0,
+              dup_window=[0.05] * len(topics))
+
+    if not native.available():
+        print("native codec NOT available (no toolchain?)", flush=True)
+        return
+
+    t0 = time.perf_counter()
+    feed_n = native.tensorize_bytes(data, peer_index, topic_index, **kw)
+    dt_native = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    evs = codec.decode_trace_bytes(data)
+    dt_decode = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    feed_p = tensorize_trace(evs, peer_index, topic_index, **kw)
+    dt_tensor = time.perf_counter() - t0
+    dt_python = dt_decode + dt_tensor
+
+    assert feed_n.op.shape == feed_p.op.shape, "paths disagree on op count"
+    import numpy as np
+    np.testing.assert_array_equal(feed_n.op, feed_p.op)
+    np.testing.assert_array_equal(feed_n.a, feed_p.a)
+
+    print(f"python: {dt_python:7.2f}s  ({mb / dt_python:7.1f} MB/s)  "
+          f"[decode {dt_decode:.2f}s + tensorize {dt_tensor:.2f}s]")
+    print(f"native: {dt_native:7.2f}s  ({mb / dt_native:7.1f} MB/s)")
+    print(f"ratio:  {dt_python / dt_native:.1f}x")
+
+
+def main() -> None:
+    target_mb = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    if os.environ.get("_BENCH_CODEC_CHILD") == "1":
+        child_main(target_mb)
+        return
+    from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
+    env = cpu_mesh_env(dict(os.environ))
+    env["_BENCH_CODEC_CHILD"] = "1"
+    raise SystemExit(subprocess.run(
+        [sys.executable, "-u", __file__, str(target_mb)], env=env).returncode)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+"""Diagnose the functional-vs-batched mesh-degree offset (VERDICT r3 #4).
+
+Round-3 measured functional mean degree 9.11 vs batched 8.11 on the shared
+512-peer underlay (KS 0.227) and the band was pinned, not explained. This
+script runs BOTH halves of tests/test_statistical_parity.py's setup and
+prints per-tick trajectories:
+
+  batched:    mean degree, grafted-edge count, pruned-edge count (from
+              mesh diffs across single ticks), under/over row counts
+  functional: GRAFT/PRUNE trace events bucketed per virtual second, plus
+              the same mean-degree trajectory sampled per second
+
+The differing decision shows up as the tick where the trajectories part.
+
+Usage: python scripts/parity_diag.py [n_peers] [ticks]   (re-execs scrubbed)
+"""
+
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+
+def child_main(n: int, ticks: int) -> None:
+    import numpy as np
+
+    import test_statistical_parity as tsp
+
+    # ---- functional half, instrumented per virtual second ----
+    from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+    from go_libp2p_pubsub_tpu.core.params import (
+        PeerScoreParams, PeerScoreThresholds)
+    from go_libp2p_pubsub_tpu.net import Network
+    from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+    from go_libp2p_pubsub_tpu.trace import MemoryTracer
+
+    net = Network()
+    mem = MemoryTracer()
+    nodes = []
+    for _ in range(n):
+        h = net.add_host()
+        sp = PeerScoreParams(app_specific_score=lambda p: 0.0,
+                             decay_interval=1.0, decay_to_zero=0.01,
+                             topics={tsp.TOPIC: tsp.TSP})
+        nodes.append(PubSub(h, GossipSubRouter(
+            score_params=sp, thresholds=PeerScoreThresholds()),
+            sign_policy=LAX_NO_SIGN, event_tracer=mem))
+    hosts = [x.host for x in nodes]
+    net.dense_connect(hosts, degree=tsp.DEGREE)
+    net.scheduler.run_for(0.1)
+    for x in nodes:
+        x.join(tsp.TOPIC).subscribe()
+
+    f_deg = []
+    for t in range(ticks):
+        net.scheduler.run_until(0.1 + t + 1.0)
+        f_deg.append(np.mean([len(x.rt.mesh.get(tsp.TOPIC, ()))
+                              for x in nodes]))
+    grafts = {}
+    prunes = {}
+    for e in mem.events:
+        b = int(e.get("timestamp", 0.0))
+        if e["type"] == "GRAFT":
+            grafts[b] = grafts.get(b, 0) + 1
+        elif e["type"] == "PRUNE":
+            prunes[b] = prunes.get(b, 0) + 1
+
+    print("== functional (per virtual second) ==")
+    for t in range(ticks):
+        print(f"  t={t:3d}  mean_deg={f_deg[t]:6.2f}  "
+              f"grafts={grafts.get(t, 0):5d}  prunes={prunes.get(t, 0):5d}",
+              flush=True)
+
+    # ---- batched half on the SAME underlay, stepped tick by tick ----
+    import jax
+
+    from go_libp2p_pubsub_tpu.sim import SimConfig, init_state, topology
+    from go_libp2p_pubsub_tpu.sim.config import TopicParams
+    from go_libp2p_pubsub_tpu.sim.engine import step_jit
+
+    topo, _ = topology.from_hosts(hosts, tsp.K_SLOTS)
+    cfg = SimConfig(n_peers=n, k_slots=tsp.K_SLOTS, n_topics=1,
+                    msg_window=64, publishers_per_tick=2, prop_substeps=8,
+                    scoring_enabled=True)
+    tp = TopicParams.from_topic_params([tsp.TSP])
+    st = init_state(cfg, topo, subscribed=np.ones((n, 1), bool))
+    key = jax.random.PRNGKey(0)
+    print("== batched (per tick) ==")
+    for t in range(ticks):
+        before = np.asarray(st.mesh)
+        st = step_jit(st, cfg, tp, jax.random.fold_in(key, t))
+        after = np.asarray(st.mesh)
+        deg = after.sum(axis=(1, 2)).mean()
+        newly = int((after & ~before).sum())
+        removed = int((before & ~after).sum())
+        n_deg = after.sum(axis=2)[:, 0]
+        under = int((n_deg < cfg.dlo).sum())
+        over = int((n_deg > cfg.dhi).sum())
+        backoffs = int((np.asarray(st.backoff) > t + 1).sum())
+        print(f"  t={t:3d}  mean_deg={deg:6.2f}  grafts={newly:5d}  "
+              f"prunes={removed:5d}  under={under:4d}  over={over:4d}  "
+              f"backoff_edges={backoffs:6d}", flush=True)
+
+
+def main() -> None:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 512
+    ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 30
+    if os.environ.get("_PARITY_DIAG_CHILD") == "1":
+        child_main(n, ticks)
+        return
+    from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
+    env = cpu_mesh_env(dict(os.environ), 8)
+    env["_PARITY_DIAG_CHILD"] = "1"
+    raise SystemExit(subprocess.run(
+        [sys.executable, "-u", __file__, str(n), str(ticks)],
+        env=env).returncode)
+
+
+if __name__ == "__main__":
+    main()

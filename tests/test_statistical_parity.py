@@ -134,15 +134,24 @@ class TestStatisticalParity:
                 f"{name} mean degree {d.mean():.2f} outside healthy band"
 
     def test_mesh_degree_distribution_close(self, parity):
+        """Bands tightened in round 4 after the offset was EXPLAINED and
+        fixed (ROUND4_NOTES.md "Parity offset"): the batched engine's
+        pre-round-mesh Dhi check accepted every same-round graft, overshot
+        during the join wave, and the over-subscription slash + 60-tick
+        backoffs depressed equilibrium degree ~1.0 below the functional
+        runtime. The serial-arrival capacity budget in
+        ops/heartbeat.py (lowest-slot-first acceptance against the growing
+        mesh, outbound bypass consuming headroom) brought the measured
+        offset to ~0.2 and KS to ~0.1."""
         deg_f, _, _, deg_b, _, _ = parity
-        assert abs(deg_f.mean() - deg_b.mean()) <= 2.0, \
+        assert abs(deg_f.mean() - deg_b.mean()) <= 1.0, \
             f"mean degrees diverge: {deg_f.mean():.2f} vs {deg_b.mean():.2f}"
         # empirical CDF distance over the shared support
         grid = np.arange(0, 14)
         cdf_f = np.searchsorted(np.sort(deg_f), grid, side="right") / N
         cdf_b = np.searchsorted(np.sort(deg_b), grid, side="right") / N
         ks = np.abs(cdf_f - cdf_b).max()
-        assert ks <= 0.35, f"mesh degree CDFs diverge: KS distance {ks:.3f}"
+        assert ks <= 0.15, f"mesh degree CDFs diverge: KS distance {ks:.3f}"
 
     def test_delivery_fraction_saturates(self, parity):
         _, frac_f, _, _, frac_b, _ = parity
