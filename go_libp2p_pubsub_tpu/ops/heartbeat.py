@@ -287,7 +287,12 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
                                        cfg.edge_gather_mode)
 
     new_mesh = ((mesh5 | accept) & ~inc_prune & ~refused_back) & joined
-    pruned_any = prunes | inc_prune | refused_back
+    # the REFUSING receiver also backs the edge off (handleGraft calls
+    # addBackoff before queueing the refusal PRUNE, gossipsub.go:795-818 —
+    # for every refusal reason except an unjoined topic), so it cannot
+    # re-graft the refused peer next tick and charge it graft-during-
+    # backoff penalties for a sequence the reference makes impossible
+    pruned_any = prunes | inc_prune | refused_back | (refuse & joined)
     new_backoff = jnp.where(pruned_any,
                             tick + cfg.prune_backoff_ticks, state.backoff)
 

@@ -1,0 +1,203 @@
+"""Fused Pallas forwarding-hop kernel (PERF_MODEL.md S4).
+
+One hop of frontier propagation currently costs ~1.1 GB of HBM traffic at
+100k peers under the XLA lowering: the neighbor gather materializes
+[W,K,N], the lowest-slot winner attribution runs a 5-pass associative-scan
+prefix-OR over K, and the event accumulators are read+written as separate
+passes. This kernel fuses the whole hop per receiver block with the packed
+frontier table pinned in VMEM:
+
+    gather (in-VMEM table lookups) -> allowed/mesh expansion from bool
+    planes -> K-unrolled prefix-OR in registers -> uint8 per-(topic, slot)
+    event counts accumulated into aliased outputs
+
+HBM per hop drops to: nbr indices + two bool planes + the uint8 count
+accumulators + a handful of [W, N] tables — ~55 MB at the headline shape
+(PERF_MODEL.md "planned" hop row).
+
+Eligibility (resolve_hop_mode): TPU backend (CPU auto keeps the XLA path;
+interpret mode is for tests), no per-edge/validation budgets, no gater, no
+provenance, no flood-publish — those configs keep the XLA formulation.
+Bit-identical to the XLA hop: tests/test_hopkernel.py checks op-level
+(forward_tick, T=1 and T=3) and full-8-tick-run state equality in
+interpret mode, plus the resolution policy.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .bits import U32
+from .permgather import _PALLAS_VMEM_PAYLOAD_BYTES, _block_rows
+
+
+class HopOut(NamedTuple):
+    new_valid: jnp.ndarray    # [W, N] next frontier (validated new arrivals)
+    have: jnp.ndarray         # [W, N] updated seen set
+    dlv: jnp.ndarray          # [W, N] updated delivered set
+    dlv_new: jnp.ndarray      # [W, N] deliveries accumulated this tick
+    nv: jnp.ndarray           # [T, K, N] uint8 first-delivery counts
+    ni: jnp.ndarray           # [T, K, N] uint8 invalid (P4) counts
+    dup: jnp.ndarray          # [T, K, N] uint8 mesh-duplicate counts
+
+
+def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
+    """'pallas' on TPU for cap-free/gater-free/provenance-free gossipsub
+    configs with a VMEM-resident frontier table; 'xla' otherwise."""
+    if mode not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown hop_mode {mode!r}")
+    backend = jax.default_backend()
+    if mode == "auto":
+        mode = "pallas" if backend == "tpu" else "xla"
+    if mode == "pallas":
+        if (cfg.gater_enabled or cfg.record_provenance
+                or cfg.edge_queue_cap > 0 or cfg.validation_queue_cap > 0
+                or (cfg.flood_publish and cfg.router == "gossipsub")):
+            return "xla"
+        if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
+                or _block_rows(n, 4 * w * k * 4) is None):
+            return "xla"
+    return mode
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
+               valid_msg, nbr, fwd_mask_u8, mesh_u8, topic_bits,
+               nv, ni, dup, interpret=False) -> HopOut:
+    """One fused forwarding hop.
+
+    frontier/have/dlv/dlv_new/vm/inv_n/window_old: [W, N] u32 packed tables
+    (receiver-indexed except frontier, which is sender-indexed and pinned
+    whole in VMEM). valid_msg: [W, 1] u32. nbr: [N, K] pre-clipped.
+    fwd_mask_u8/mesh_u8: [N, T, K] uint8 bool planes. topic_bits: [T, W]
+    u32 per-topic live-message sets. nv/ni/dup: [T, K, N] uint8 event-count
+    accumulators, updated in place via aliasing.
+    """
+    from jax.experimental import pallas as pl
+
+    w, n = frontier.shape
+    k = nbr.shape[1]
+    t = topic_bits.shape[0]
+    bn = _block_rows(n, 4 * w * k * 4)
+    assert bn is not None, "resolve_hop_mode admitted an infeasible shape"
+
+    def kernel(fro_ref, have_ref, dlv_ref, dlvnew_ref, vm_ref, inv_ref,
+               wold_ref, vmsg_ref, nbr_ref, fwd_ref, mesh_ref, tb_ref,
+               nv_ref, ni_ref, dup_ref,
+               out_newv, out_have, out_dlv, out_dlvnew,
+               out_nv, out_ni, out_dup):
+        tab = fro_ref[:]                                  # [W, N] in VMEM
+        nbrb = nbr_ref[:]                                 # [BN, K]
+        g = jnp.take(tab, nbrb.reshape(-1), axis=1)
+        g = g.reshape(w, nbrb.shape[0], k)                # [W, BN, K] offered
+        tb = tb_ref[:]                                    # [T, W]
+        fwd = fwd_ref[:]                                  # [BN, T, K] u8
+        msh = mesh_ref[:]
+        # allowed[w, bn, k] = OR_t (fwd[bn,t,k] & topic_bits[t,w]);
+        # topic message sets are disjoint so OR == sum
+        allowed = jnp.zeros_like(g)
+        mesh_eb = jnp.zeros_like(g)
+        for ti in range(t):
+            tw = tb[ti][:, None, None]                    # [W, 1, 1]
+            allowed = allowed | jnp.where(
+                (fwd[:, ti, :] != 0)[None, :, :], tw, U32(0))
+            mesh_eb = mesh_eb | jnp.where(
+                (msh[:, ti, :] != 0)[None, :, :], tw, U32(0))
+        off = g & allowed                                 # [W, BN, K]
+
+        have_b = have_ref[:]                              # [W, BN]
+        vm_b = vm_ref[:]
+        inv_b = inv_ref[:]
+        nv_acc = nv_ref[:]                                # [T, K, BN] u8
+        ni_acc = ni_ref[:]
+        # K-unrolled lowest-slot prefix: excl carries OR of lower slots
+        excl = jnp.zeros_like(have_b)
+        new_from = []
+        for ki in range(k):
+            off_k = off[:, :, ki]
+            nf_k = off_k & ~excl & ~have_b                # winner bits
+            excl = excl | off_k
+            new_from.append(nf_k)
+            for ti in range(t):
+                tw = tb[ti][:, None]
+                ev_nv = nf_k & vm_b & tw
+                ev_ni = nf_k & inv_b & tw
+                cnt_nv = jnp.sum(jax.lax.population_count(ev_nv),
+                                 axis=0).astype(jnp.uint8)
+                cnt_ni = jnp.sum(jax.lax.population_count(ev_ni),
+                                 axis=0).astype(jnp.uint8)
+                nv_acc = nv_acc.at[ti, ki, :].add(cnt_nv)
+                ni_acc = ni_acc.at[ti, ki, :].add(cnt_ni)
+
+        new_any = excl & ~have_b
+        new_valid = new_any & vm_b
+        # mesh-duplicate eligibility uses the WHOLE hop's new deliveries
+        # (order-independent within the hop, as the XLA formulation)
+        elig = (wold_ref[:] | dlvnew_ref[:] | new_valid) & vmsg_ref[:]
+        dup_acc = dup_ref[:]
+        for ki in range(k):
+            dup_k = off[:, :, ki] & mesh_eb[:, :, ki] & elig
+            for ti in range(t):
+                ev = dup_k & tb[ti][:, None]
+                cnt = jnp.sum(jax.lax.population_count(ev),
+                              axis=0).astype(jnp.uint8)
+                dup_acc = dup_acc.at[ti, ki, :].add(cnt)
+
+        out_newv[:] = new_valid
+        out_have[:] = have_b | new_any
+        out_dlv[:] = dlv_ref[:] | new_valid
+        out_dlvnew[:] = dlvnew_ref[:] | new_valid
+        out_nv[:] = nv_acc
+        out_ni[:] = ni_acc
+        out_dup[:] = dup_acc
+
+    wn = lambda i: (0, i)       # [W, BN] blocks          # noqa: E731
+    tkn = lambda i: (0, 0, i)   # [T, K, BN] blocks       # noqa: E731
+    grid = n // bn
+    outs = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((w, n), lambda i: (0, 0)),       # frontier table
+            pl.BlockSpec((w, bn), wn),                    # have
+            pl.BlockSpec((w, bn), wn),                    # dlv
+            pl.BlockSpec((w, bn), wn),                    # dlv_new
+            pl.BlockSpec((w, bn), wn),                    # vm
+            pl.BlockSpec((w, bn), wn),                    # inv_n
+            pl.BlockSpec((w, bn), wn),                    # window_old
+            pl.BlockSpec((w, 1), lambda i: (0, 0)),       # valid_msg
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),      # nbr
+            pl.BlockSpec((bn, t, k), lambda i: (i, 0, 0)),  # fwd planes
+            pl.BlockSpec((bn, t, k), lambda i: (i, 0, 0)),  # mesh planes
+            pl.BlockSpec((t, w), lambda i: (0, 0)),       # topic bits
+            pl.BlockSpec((t, k, bn), tkn),                # nv acc
+            pl.BlockSpec((t, k, bn), tkn),                # ni acc
+            pl.BlockSpec((t, k, bn), tkn),                # dup acc
+        ],
+        out_specs=[
+            pl.BlockSpec((w, bn), wn),
+            pl.BlockSpec((w, bn), wn),
+            pl.BlockSpec((w, bn), wn),
+            pl.BlockSpec((w, bn), wn),
+            pl.BlockSpec((t, k, bn), tkn),
+            pl.BlockSpec((t, k, bn), tkn),
+            pl.BlockSpec((t, k, bn), tkn),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((w, n), U32),
+            jax.ShapeDtypeStruct((w, n), U32),
+            jax.ShapeDtypeStruct((w, n), U32),
+            jax.ShapeDtypeStruct((w, n), U32),
+            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
+            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
+            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
+        ],
+        input_output_aliases={1: 1, 2: 2, 3: 3, 12: 4, 13: 5, 14: 6},
+        interpret=interpret,
+    )(frontier, have, dlv, dlv_new, vm, inv_n, window_old, valid_msg,
+      nbr, fwd_mask_u8, mesh_u8, topic_bits, nv, ni, dup)
+    return HopOut(*outs)

@@ -346,10 +346,19 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     validated = arrivals.astype(jnp.float32)
 
     # -- step 2: eager forwarding, prop_substeps hops, fully bit-packed --
+    from .hopkernel import hop_pallas, resolve_hop_mode
+    hop_mode = resolve_hop_mode(cfg.hop_mode, cfg, w, n, k)
     fwd_mask = _edge_forward_mask(state, cfg, k_fwd, fwd_send)
     fwd_mask = fwd_mask & data_ok[:, None, :]
-    allowed = _edge_topic_bits(fwd_mask, topic_bits, w)                 # [W,K,N]
-    mesh_eb = _edge_topic_bits(state.mesh, topic_bits, w)               # [W,K,N]
+    if hop_mode == "pallas":
+        # the fused kernel expands allowed/mesh planes in VMEM from the
+        # uint8 bool planes — no [W,K,N] materialization at all
+        fwd_u8 = fwd_mask.astype(jnp.uint8)
+        mesh_u8 = state.mesh.astype(jnp.uint8)
+        allowed = mesh_eb = None
+    else:
+        allowed = _edge_topic_bits(fwd_mask, topic_bits, w)             # [W,K,N]
+        mesh_eb = _edge_topic_bits(state.mesh, topic_bits, w)           # [W,K,N]
 
     if cfg.flood_publish and cfg.router == "gossipsub":
         # WithFloodPublish (gossipsub.go:989-1004): the ORIGIN sends its own
@@ -423,6 +432,21 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         carry0["nv_acc"] = got_valid
 
     def hop(c):
+        if hop_mode == "pallas":
+            # fused kernel (PERF_MODEL.md S4): gather + allowed/mesh
+            # expansion + K-prefix winner attribution + uint8 event counts
+            # in one VMEM pass; eligibility (resolve_hop_mode) guarantees
+            # the cap/gater/provenance/flood paths below are dead here
+            h = hop_pallas(c["frontier"], c["have"], c["dlv"], c["dlv_new"],
+                           vm, inv_n, window_old, valid_msg_bits[:, None],
+                           nbr, fwd_u8, mesh_u8, topic_bits,
+                           c["nv"], c["ni"], c["dup"],
+                           interpret=jax.default_backend() != "tpu")
+            out = dict(c)
+            out.update(i=c["i"] + 1, frontier=h.new_valid, have=h.have,
+                       dlv=h.dlv, dlv_new=h.dlv_new, nv=h.nv, ni=h.ni,
+                       dup=h.dup)
+            return out
         i, frontier, have_bits, dlv_bits, dlv_new = \
             c["i"], c["frontier"], c["have"], c["dlv"], c["dlv_new"]
         edge_used, arrivals, throttled, validated = \

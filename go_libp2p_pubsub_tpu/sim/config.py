@@ -98,6 +98,11 @@ class SimConfig:
     # "auto" (backend default) | "ranks" | "sort" | "iter"
     selection_mode: str = "auto"
 
+    # forwarding-hop formulation (ops/hopkernel.py): "auto" | "xla" |
+    # "pallas" — the fused Pallas hop (TPU auto) needs cap-free/gater-free/
+    # provenance-free configs and falls back to the XLA hop otherwise
+    hop_mode: str = "auto"
+
     # record delivery provenance (msg_publisher / deliver_from) so a run can
     # be exported as a pb/trace event stream (sim/trace_export.py); when on
     # it costs a bit-plane decode + two scatters per tick, when off
