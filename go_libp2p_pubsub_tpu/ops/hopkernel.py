@@ -116,12 +116,10 @@ def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
         ni_acc = ni_ref[:]
         # K-unrolled lowest-slot prefix: excl carries OR of lower slots
         excl = jnp.zeros_like(have_b)
-        new_from = []
         for ki in range(k):
             off_k = off[:, :, ki]
             nf_k = off_k & ~excl & ~have_b                # winner bits
             excl = excl | off_k
-            new_from.append(nf_k)
             for ti in range(t):
                 tw = tb[ti][:, None]
                 ev_nv = nf_k & vm_b & tw
