@@ -31,8 +31,25 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from .bits import U32
+from .bits import U32, pack_words, unpack_words
 from .permgather import _PALLAS_VMEM_PAYLOAD_BYTES, _block_rows
+
+
+def _take_rows(tab, nbrb, w, k):
+    """In-kernel neighbor gather of a VMEM-pinned [W, N] table -> [W, BN, K]."""
+    g = jnp.take(tab, nbrb.reshape(-1), axis=1)
+    return g.reshape(w, nbrb.shape[0], k)
+
+
+def _expand_topic(planes_u8, tb, like):
+    """In-kernel per-topic expansion: [BN, T, K] uint8 bool planes + [T, W]
+    topic message sets -> [W, BN, K] packed words (topic sets are disjoint,
+    so OR == sum)."""
+    out = jnp.zeros_like(like)
+    for ti in range(tb.shape[0]):
+        out = out | jnp.where((planes_u8[:, ti, :] != 0)[None, :, :],
+                              tb[ti][:, None, None], U32(0))
+    return out
 
 
 class HopOut(NamedTuple):
@@ -64,6 +81,193 @@ def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
     return mode
 
 
+def resolve_emit_mode(mode: str, w: int, n: int, k: int) -> str:
+    """Gossip-emit formulation: the fused kernel has no config
+    restrictions (the emit step has no cap/gater/provenance interaction) —
+    only backend and VMEM-feasibility gates."""
+    if mode not in ("auto", "xla", "pallas"):
+        raise ValueError(f"unknown hop_mode {mode!r}")
+    backend = jax.default_backend()
+    if mode == "auto":
+        mode = "pallas" if backend == "tpu" else "xla"
+    if mode == "pallas":
+        if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
+                or _block_rows(n, 4 * w * k * 4) is None):
+            return "xla"
+    return mode
+
+
+@functools.partial(jax.jit, static_argnames=("m", "budget", "interpret"))
+def emit_pallas(window, have, gossip_u8, topic_bits, nbr, m, budget,
+                interpret=False) -> jnp.ndarray:
+    """Fused IHAVE->IWANT chooser (PERF_MODEL.md S7): gossipsub.go:654-676.
+
+    window: [W, N] u32 sender gossip-window table (VMEM-pinned);
+    have: [W, N] u32 receiver seen sets; gossip_u8: [N, T, K] uint8
+    receiver-view gossip-edge planes (valid-slot and gossip-threshold
+    masking already applied); topic_bits: [T, W]; nbr pre-clipped [N, K];
+    budget: the per-sender iasked cap (MaxIHaveLength) — a budget >= M
+    reduces exactly to the lowest-offering-slot choice. Returns
+    iwant_pending [N, M] int32 (chosen slot per message, -1 none).
+
+    Replaces: the [W,K,N] offer materialization, the 5-pass prefix-OR,
+    the bit-plane slot decode, and the K-step budget scan of the XLA
+    formulation — everything happens per receiver block in VMEM.
+    """
+    from jax.experimental import pallas as pl
+
+    w, n = window.shape
+    k = nbr.shape[1]
+    t = topic_bits.shape[0]
+    bn = _block_rows(n, 4 * w * k * 4)
+    assert bn is not None, "resolve_emit_mode admitted an infeasible shape"
+
+    def kernel(win_ref, have_ref, gos_ref, tb_ref, nbr_ref, out_ref):
+        tab = win_ref[:]                                  # [W, N] in VMEM
+        nbrb = nbr_ref[:]                                 # [BN, K]
+        g = _take_rows(tab, nbrb, w, k)                   # [W, BN, K]
+        tb = tb_ref[:]
+        off = g & _expand_topic(gos_ref[:], tb, g)
+
+        def unpack(words):                                # [W, BN] -> [BN, M]
+            return unpack_words(words, m)                 # ops/bits layout
+
+        assigned = unpack(have_ref[:])                    # seen = never asked
+        pend = jnp.full((nbrb.shape[0], m), -1, jnp.int32)
+        # slot-order serial assignment with per-slot budget (the iasked
+        # counter): an id a budget-exhausted slot passes over is still
+        # pulled from a later slot with headroom (gossipsub.go:654-676)
+        for ki in range(k):
+            off_u = unpack(off[:, :, ki]) & ~assigned
+            rank = jnp.cumsum(off_u.astype(jnp.int32), axis=1)
+            take = off_u & (rank <= budget)
+            pend = jnp.where(take, ki, pend)
+            assigned = assigned | take
+        out_ref[:] = pend
+
+    return pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((w, n), lambda i: (0, 0)),       # window table
+            pl.BlockSpec((w, bn), lambda i: (0, i)),      # have
+            pl.BlockSpec((bn, t, k), lambda i: (i, 0, 0)),  # gossip planes
+            pl.BlockSpec((t, w), lambda i: (0, 0)),
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((bn, m), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, m), jnp.int32),
+        interpret=interpret,
+    )(window, have, gossip_u8, topic_bits, nbr)
+
+
+class ResolveOut(NamedTuple):
+    got_any: jnp.ndarray      # [W, N] pulled (seen) this tick
+    got_valid_any: jnp.ndarray  # [W, N] pulled AND delivered
+    nv: jnp.ndarray           # [T, K, N] uint8 first-delivery seed counts
+    ni: jnp.ndarray           # [T, K, N] uint8 invalid seed counts
+    broken: jnp.ndarray       # [K, N] uint8 broken-promise counts (P7)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "interpret"))
+def iwant_resolve_pallas(pend, answers, have, vm, inv_n, alive, data_ok_u8,
+                         topic_bits, nbr, m, interpret=False) -> ResolveOut:
+    """Fused IWANT resolution (PERF_MODEL.md S6): gossipsub.go:698-739 +
+    the broken-promise accounting of gossip_tracer.go:79-115.
+
+    pend: [N, M] int32 pending-pull slot per message (-1 none); answers:
+    [W, N] u32 sender mcache table (malicious columns zeroed, VMEM-pinned);
+    have/vm/inv_n: [W, N] receiver tables; alive: [W, 1]; data_ok_u8:
+    [N, K] uint8 graylist admission; topic_bits: [T, W]; nbr pre-clipped.
+    Same eligibility as the hop kernel (no caps/gater/provenance), so the
+    budget/throttle plumbing of the XLA path is dead here.
+    """
+    from jax.experimental import pallas as pl
+
+    w, n = answers.shape
+    k = nbr.shape[1]
+    t = topic_bits.shape[0]
+    bn = _block_rows(n, 4 * w * k * 4)
+    assert bn is not None, "resolve_hop_mode admitted an infeasible shape"
+
+    def kernel(pend_ref, ans_ref, have_ref, vm_ref, inv_ref, alive_ref,
+               ok_ref, tb_ref, nbr_ref,
+               out_ga, out_gva, out_nv, out_ni, out_bk):
+        tab = ans_ref[:]                                  # [W, N] in VMEM
+        pend_b = pend_ref[:]                              # [BN, M]
+        nbrb = nbr_ref[:]
+        have_b = have_ref[:]
+        vm_b = vm_ref[:]
+        inv_b = inv_ref[:]
+        alive_b = alive_ref[:]                            # [W, 1]
+        ok_b = ok_ref[:]                                  # [BN, K] u8
+        tb = tb_ref[:]
+
+        def pack(bits):                                   # [BN, M] -> [W, BN]
+            return pack_words(bits)                       # ops/bits layout
+
+        nv = jnp.zeros((t, k, pend_b.shape[0]), jnp.uint8)
+        ni = jnp.zeros((t, k, pend_b.shape[0]), jnp.uint8)
+        bk = jnp.zeros((k, pend_b.shape[0]), jnp.uint8)
+        got_any = jnp.zeros_like(have_b)
+        got_valid_any = jnp.zeros_like(have_b)
+        for ki in range(k):
+            asked = pack(pend_b == ki) & alive_b          # [W, BN]
+            ans_k = jnp.take(tab, nbrb[:, ki], axis=1)    # [W, BN]
+            adm = jnp.where((ok_b[:, ki] != 0)[None, :],
+                            U32(0xFFFFFFFF), U32(0))
+            got = asked & ans_k & ~have_b & adm
+            broken = asked & ~ans_k
+            gv = got & vm_b
+            got_any = got_any | got
+            got_valid_any = got_valid_any | gv
+            bk = bk.at[ki, :].add(jnp.sum(
+                jax.lax.population_count(broken), axis=0).astype(jnp.uint8))
+            for ti in range(t):
+                tw = tb[ti][:, None]
+                nv = nv.at[ti, ki, :].add(jnp.sum(jax.lax.population_count(
+                    gv & tw), axis=0).astype(jnp.uint8))
+                ni = ni.at[ti, ki, :].add(jnp.sum(jax.lax.population_count(
+                    got & inv_b & tw), axis=0).astype(jnp.uint8))
+        out_ga[:] = got_any
+        out_gva[:] = got_valid_any
+        out_nv[:] = nv
+        out_ni[:] = ni
+        out_bk[:] = bk
+
+    wn = lambda i: (0, i)                                 # noqa: E731
+    tkn = lambda i: (0, 0, i)                             # noqa: E731
+    outs = pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((bn, m), lambda i: (i, 0)),      # pend
+            pl.BlockSpec((w, n), lambda i: (0, 0)),       # answers table
+            pl.BlockSpec((w, bn), wn),                    # have
+            pl.BlockSpec((w, bn), wn),                    # vm
+            pl.BlockSpec((w, bn), wn),                    # inv
+            pl.BlockSpec((w, 1), lambda i: (0, 0)),       # alive
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),      # data_ok
+            pl.BlockSpec((t, w), lambda i: (0, 0)),
+            pl.BlockSpec((bn, k), lambda i: (i, 0)),      # nbr
+        ],
+        out_specs=[
+            pl.BlockSpec((w, bn), wn), pl.BlockSpec((w, bn), wn),
+            pl.BlockSpec((t, k, bn), tkn), pl.BlockSpec((t, k, bn), tkn),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((w, n), U32),
+            jax.ShapeDtypeStruct((w, n), U32),
+            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
+            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
+            jax.ShapeDtypeStruct((k, n), jnp.uint8),
+        ],
+        interpret=interpret,
+    )(pend, answers, have, vm, inv_n, alive, data_ok_u8, topic_bits, nbr)
+    return ResolveOut(*outs)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
                valid_msg, nbr, fwd_mask_u8, mesh_u8, topic_bits,
@@ -92,21 +296,10 @@ def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
                out_nv, out_ni, out_dup):
         tab = fro_ref[:]                                  # [W, N] in VMEM
         nbrb = nbr_ref[:]                                 # [BN, K]
-        g = jnp.take(tab, nbrb.reshape(-1), axis=1)
-        g = g.reshape(w, nbrb.shape[0], k)                # [W, BN, K] offered
+        g = _take_rows(tab, nbrb, w, k)                   # [W, BN, K] offered
         tb = tb_ref[:]                                    # [T, W]
-        fwd = fwd_ref[:]                                  # [BN, T, K] u8
-        msh = mesh_ref[:]
-        # allowed[w, bn, k] = OR_t (fwd[bn,t,k] & topic_bits[t,w]);
-        # topic message sets are disjoint so OR == sum
-        allowed = jnp.zeros_like(g)
-        mesh_eb = jnp.zeros_like(g)
-        for ti in range(t):
-            tw = tb[ti][:, None, None]                    # [W, 1, 1]
-            allowed = allowed | jnp.where(
-                (fwd[:, ti, :] != 0)[None, :, :], tw, U32(0))
-            mesh_eb = mesh_eb | jnp.where(
-                (msh[:, ti, :] != 0)[None, :, :], tw, U32(0))
+        allowed = _expand_topic(fwd_ref[:], tb, g)
+        mesh_eb = _expand_topic(mesh_ref[:], tb, g)
         off = g & allowed                                 # [W, BN, K]
 
         have_b = have_ref[:]                              # [W, BN]

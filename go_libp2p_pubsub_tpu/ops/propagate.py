@@ -297,57 +297,85 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # -- step 1: resolve pending IWANTs from last tick (gossipsub.go:698-739:
     # the sender answers from its mcache; delivery counts as a first delivery
     # from a non-mesh peer) --
-    asked_k = _slot_bitplanes(state.iwant_pending, k) & alive_bits[:, None, None]
+    from .hopkernel import (
+        emit_pallas,
+        hop_pallas,
+        iwant_resolve_pallas,
+        resolve_emit_mode,
+        resolve_hop_mode,
+    )
+    hop_mode = resolve_hop_mode(cfg.hop_mode, cfg, w, n, k)
     # malicious sources never answer IWANTs (the iwantEverything-style actor
     # holds its promises open, gossipsub_spam_test.go:23-133); honest sources
     # answer from their mcache, which rejected/ignored messages never enter
     # (deliver_tick stays NEVER on rejection — validation.go:293-370)
     answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)             # [W,N]
-    answers_k = gather_words_rows(answer_bits, nbr, m,
-                                  cfg.edge_gather_mode)             # [W,K,N]
-    # pulled data is still data: graylist + gater admission apply, and pulls
-    # are charged against the same per-edge and validation budgets as eager
-    # traffic (an IHAVE-flooding adversary must not route unlimited data
-    # through the pull path)
-    adm_kn = jnp.where(data_ok.T[None, :, :], U32(0xFFFFFFFF), U32(0))
-    got_k = asked_k & answers_k & ~have_bits[:, None, :] & adm_kn
-    broken_k = asked_k & ~answers_k
-    throttled = jnp.zeros((n,), jnp.int32)
-    if cfg.edge_queue_cap > 0:
-        pull_sz = popcount_sum(got_k, axis=0, dtype=jnp.int32)          # [K,N]
-        got_k = jnp.where((pull_sz <= cfg.edge_queue_cap)[None, :, :],
-                          got_k, U32(0))
-    if cfg.validation_queue_cap > 0:
-        cnt0 = popcount_sum(reduce_or(got_k, axis=1), axis=0,
-                            dtype=jnp.int32)                            # [N]
-        fits0 = cnt0 <= cfg.validation_queue_cap
-        got_k = got_k & jnp.where(fits0, U32(0xFFFFFFFF), U32(0))[None, None, :]
-        # over-budget pulls are dropped unseen and charged as throttle
-        # events; the unanswered promise is NOT charged to the sender (it
-        # did answer — the local queue dropped it)
-        throttled = throttled + jnp.where(fits0, 0, cnt0)
-    got_any = reduce_or(got_k, axis=1)                                  # [W,N]
-    # pulled messages still go through the receiver's validation: deliver on
-    # ACCEPT, seen-only on IGNORE (an honest publisher answers pulls for its
-    # own ignore-class message), P4 on REJECT (unreachable in practice:
-    # rejecting answerers are malicious and never answer)
-    got_valid = got_k & vm[:, None, :]
-    got_valid_any = reduce_or(got_valid, axis=1)
-    # broken promises: one penalty point per unfulfilled message id
-    # (gossip_tracer.go:79-115, applied gossipsub.go:1620-1625)
-    behaviour_penalty = state.behaviour_penalty + \
-        popcount_sum(broken_k, axis=0).T
-    have_bits = have_bits | got_any
-    dlv_bits = dlv_bits | got_valid_any
+    if hop_mode == "pallas":
+        # fused resolve (PERF_MODEL.md S6): eligibility (resolve_hop_mode)
+        # guarantees the cap/throttle plumbing below is dead here
+        r = iwant_resolve_pallas(
+            state.iwant_pending, answer_bits, have_bits, vm, inv_n,
+            alive_bits[:, None],
+            data_ok.astype(jnp.uint8), topic_bits, nbr, m=m,
+            interpret=jax.default_backend() != "tpu")
+        got_any, got_valid_any = r.got_any, r.got_valid_any
+        behaviour_penalty = state.behaviour_penalty \
+            + r.broken.astype(jnp.float32).T
+        have_bits = have_bits | got_any
+        dlv_bits = dlv_bits | got_valid_any
+        throttled = jnp.zeros((n,), jnp.int32)
+        edge_used = jnp.zeros((k, n), jnp.int32)
+        arrivals = jnp.zeros((n,), jnp.int32)
+        validated = jnp.zeros((n,), jnp.float32)
+        seed_nv, seed_ni = r.nv, r.ni
+        got_k = got_valid = None
+    else:
+        seed_nv = seed_ni = None
+        asked_k = _slot_bitplanes(state.iwant_pending, k) \
+            & alive_bits[:, None, None]
+        answers_k = gather_words_rows(answer_bits, nbr, m,
+                                      cfg.edge_gather_mode)             # [W,K,N]
+        # pulled data is still data: graylist + gater admission apply, and pulls
+        # are charged against the same per-edge and validation budgets as eager
+        # traffic (an IHAVE-flooding adversary must not route unlimited data
+        # through the pull path)
+        adm_kn = jnp.where(data_ok.T[None, :, :], U32(0xFFFFFFFF), U32(0))
+        got_k = asked_k & answers_k & ~have_bits[:, None, :] & adm_kn
+        broken_k = asked_k & ~answers_k
+        throttled = jnp.zeros((n,), jnp.int32)
+        if cfg.edge_queue_cap > 0:
+            pull_sz = popcount_sum(got_k, axis=0, dtype=jnp.int32)          # [K,N]
+            got_k = jnp.where((pull_sz <= cfg.edge_queue_cap)[None, :, :],
+                              got_k, U32(0))
+        if cfg.validation_queue_cap > 0:
+            cnt0 = popcount_sum(reduce_or(got_k, axis=1), axis=0,
+                                dtype=jnp.int32)                            # [N]
+            fits0 = cnt0 <= cfg.validation_queue_cap
+            got_k = got_k & jnp.where(fits0, U32(0xFFFFFFFF), U32(0))[None, None, :]
+            # over-budget pulls are dropped unseen and charged as throttle
+            # events; the unanswered promise is NOT charged to the sender (it
+            # did answer — the local queue dropped it)
+            throttled = throttled + jnp.where(fits0, 0, cnt0)
+        got_any = reduce_or(got_k, axis=1)                                  # [W,N]
+        # pulled messages still go through the receiver's validation: deliver on
+        # ACCEPT, seen-only on IGNORE (an honest publisher answers pulls for its
+        # own ignore-class message), P4 on REJECT (unreachable in practice:
+        # rejecting answerers are malicious and never answer)
+        got_valid = got_k & vm[:, None, :]
+        got_valid_any = reduce_or(got_valid, axis=1)
+        # broken promises: one penalty point per unfulfilled message id
+        # (gossip_tracer.go:79-115, applied gossipsub.go:1620-1625)
+        behaviour_penalty = state.behaviour_penalty + \
+            popcount_sum(broken_k, axis=0).T
+        have_bits = have_bits | got_any
+        dlv_bits = dlv_bits | got_valid_any
 
-    # per-tick admission budgets, seeded with the (cap-masked) IWANT pulls
-    edge_used = popcount_sum(got_k, axis=0, dtype=jnp.int32)            # [K,N]
-    arrivals = popcount_sum(got_any, axis=0, dtype=jnp.int32)           # [N]
-    validated = arrivals.astype(jnp.float32)
+        # per-tick admission budgets, seeded with the (cap-masked) IWANT pulls
+        edge_used = popcount_sum(got_k, axis=0, dtype=jnp.int32)            # [K,N]
+        arrivals = popcount_sum(got_any, axis=0, dtype=jnp.int32)           # [N]
+        validated = arrivals.astype(jnp.float32)
 
     # -- step 2: eager forwarding, prop_substeps hops, fully bit-packed --
-    from .hopkernel import hop_pallas, resolve_hop_mode
-    hop_mode = resolve_hop_mode(cfg.hop_mode, cfg, w, n, k)
     fwd_mask = _edge_forward_mask(state, cfg, k_fwd, fwd_send)
     fwd_mask = fwd_mask & data_ok[:, None, :]
     if hop_mode == "pallas":
@@ -412,8 +440,11 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         "have": have_bits,
         "dlv": dlv_bits,
         "dlv_new": got_valid_any,          # deliveries accumulated this tick
-        "nv": topic_counts(got_valid),     # first-delivery counts [T,K,N]
-        "ni": topic_counts(got_k & inv_n[:, None, :]),   # reject (P4) counts
+        # first-delivery / reject (P4) seed counts [T,K,N]: from the fused
+        # resolve kernel, or from the XLA pull sets
+        "nv": seed_nv if seed_nv is not None else topic_counts(got_valid),
+        "ni": seed_ni if seed_ni is not None
+        else topic_counts(got_k & inv_n[:, None, :]),
         "dup": jnp.zeros((t, k, n), jnp.uint8),  # mesh-duplicate counts
         "edge_used": edge_used,
         "arrivals": arrivals,
@@ -615,6 +646,16 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         & alive_bits[:, None]
     # malicious peers advertise everything alive (IHAVE flood)
     window_bits = jnp.where(mal[None, :], alive_bits[:, None], window_bits)
+    if resolve_emit_mode(cfg.hop_mode, w, n, k) == "pallas":
+        # fused chooser (PERF_MODEL.md S7): window table in VMEM, budget
+        # scan per receiver block; covers budgeted and unbudgeted paths
+        # (budget >= M reduces to the lowest-offering-slot choice)
+        iwant_pending = emit_pallas(
+            window_bits, have_bits, inc_gossip.astype(jnp.uint8),
+            topic_bits, nbr, m=m,
+            budget=min(cfg.max_iwant_per_tick, m),
+            interpret=jax.default_backend() != "tpu")
+        return state._replace(iwant_pending=iwant_pending)
     gossip_allowed = _edge_topic_bits(inc_gossip, topic_bits, w)        # [W,K,N]
     offer = gather_words_rows(window_bits, nbr, m,
                               cfg.edge_gather_mode) & gossip_allowed

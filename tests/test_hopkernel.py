@@ -22,9 +22,9 @@ from go_libp2p_pubsub_tpu.sim.scenarios import default_topic_params
 
 
 def _build(n=192, k=8, t=1, m=64, degree=5, **over):
-    cfg = SimConfig(n_peers=n, k_slots=k, n_topics=t, msg_window=m,
-                    publishers_per_tick=4, prop_substeps=8,
-                    scoring_enabled=True, **over)
+    kw = dict(publishers_per_tick=4, prop_substeps=8, scoring_enabled=True)
+    kw.update(over)
+    cfg = SimConfig(n_peers=n, k_slots=k, n_topics=t, msg_window=m, **kw)
     tp = default_topic_params(t)
     st = init_state(cfg, topology.sparse(n, k, degree=degree))
     return cfg, tp, st
@@ -61,6 +61,42 @@ class TestHopKernelParity:
         _states_equal(st_x, st_p)
         # and the run actually delivered traffic (non-vacuous parity)
         assert float(st_p.delivered_total) > 0
+
+    def _pull_heavy(self, **over):
+        """A config where the gossip pull path actually fires: few eager
+        hops leave peers missing messages, so IHAVE/IWANT traffic (and the
+        S6/S7 kernels) carry real load — ~3k pending pulls over 8 ticks."""
+        return _build(n=192, k=16, degree=14, prop_substeps=2,
+                      publishers_per_tick=4, **over)
+
+    def test_gossip_pull_path_identical_and_nonvacuous(self):
+        """The fused IWANT-resolve (S6) and gossip-emit (S7) kernels must
+        match the XLA formulations under REAL pull traffic."""
+        import go_libp2p_pubsub_tpu.sim.engine as eng
+
+        cfg, tp, st = self._pull_heavy()
+        key = jax.random.PRNGKey(11)
+        pulls = 0
+        st_x, st_p = st, st
+        for i in range(8):
+            st_x = eng.step_jit(st_x, dataclasses.replace(cfg, hop_mode="xla"),
+                                tp, jax.random.fold_in(key, i))
+            st_p = eng.step_jit(st_p, dataclasses.replace(cfg, hop_mode="pallas"),
+                                tp, jax.random.fold_in(key, i))
+            pulls += int(np.sum(np.asarray(st_p.iwant_pending) >= 0))
+        _states_equal(st_x, st_p)
+        assert pulls > 500, f"pull path barely exercised: {pulls} pulls"
+
+    def test_budgeted_iwant_identical(self):
+        """The fused gossip-emit kernel's per-slot budget scan must match
+        _budgeted_iwant exactly (MaxIHaveLength flood protection,
+        gossipsub.go:654-676) — with a budget small enough to bind under
+        real pull traffic."""
+        cfg, tp, st = self._pull_heavy(max_iwant_per_tick=2)
+        key = jax.random.PRNGKey(11)
+        st_x = run(st, dataclasses.replace(cfg, hop_mode="xla"), tp, key, 8)
+        st_p = run(st, dataclasses.replace(cfg, hop_mode="pallas"), tp, key, 8)
+        _states_equal(st_x, st_p)
 
     def test_resolution_policy(self, monkeypatch):
         import go_libp2p_pubsub_tpu.ops.hopkernel as hk
