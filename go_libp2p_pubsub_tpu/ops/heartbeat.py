@@ -79,10 +79,22 @@ def edge_gather_packed(masks: list, state: SimState,
     valid = ((state.neighbors >= 0) & (state.reverse_slot >= 0))[:, None, :]
     mode = resolve_edge_packed_mode(mode, n, k, b)
     if mode == "pallas":
+        from functools import partial
+
+        from ..parallel.kernel_context import (
+            PEER, current_kernel_mesh, shard_kernel)
         from .bits import pack_bool
         table = pack_bool(planes.reshape(n, b * k))        # [N, ceil(BK/32)]
-        groups = _edge_table_pallas(table, jn, rk, b_planes=b,
-                                    interpret=jax.default_backend() != "tpu")
+        fn = partial(_edge_table_pallas, b_planes=b,
+                     interpret=jax.default_backend() != "tpu")
+        if current_kernel_mesh() is not None:
+            n_groups = (b + 31) // 32
+            groups = shard_kernel(
+                lambda tab, j, r: tuple(fn(tab, j, r)),
+                in_specs=[(None, None), (PEER, None), (PEER, None)],
+                out_specs=[(PEER, None)] * n_groups)(table, jn, rk)
+        else:
+            groups = fn(table, jn, rk)
     else:
         groups = []
         for w0 in range(0, b, 32):

@@ -31,6 +31,12 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..parallel.kernel_context import (
+    PEER,
+    current_kernel_mesh,
+    local_rows,
+    shard_kernel,
+)
 from .bits import U32, pack_words, unpack_words
 from .permgather import _PALLAS_VMEM_PAYLOAD_BYTES, _block_rows
 
@@ -75,8 +81,10 @@ def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
                 or cfg.edge_queue_cap > 0 or cfg.validation_queue_cap > 0
                 or (cfg.flood_publish and cfg.router == "gossipsub")):
             return "xla"
+        # table feasibility is GLOBAL n; block feasibility is the
+        # per-shard row count under a kernel mesh
         if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
-                or _block_rows(n, 4 * w * k * 4) is None):
+                or _block_rows(local_rows(n), 4 * w * k * 4) is None):
             return "xla"
     return mode
 
@@ -92,7 +100,7 @@ def resolve_emit_mode(mode: str, w: int, n: int, k: int) -> str:
         mode = "pallas" if backend == "tpu" else "xla"
     if mode == "pallas":
         if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
-                or _block_rows(n, 4 * w * k * 4) is None):
+                or _block_rows(local_rows(n), 4 * w * k * 4) is None):
             return "xla"
     return mode
 
@@ -117,9 +125,9 @@ def emit_pallas(window, have, gossip_u8, topic_bits, nbr, m, budget,
     from jax.experimental import pallas as pl
 
     w, n = window.shape
-    k = nbr.shape[1]
-    t = topic_bits.shape[0]
-    bn = _block_rows(n, 4 * w * k * 4)
+    nr, k = nbr.shape                  # receiver rows (local shard under
+    t = topic_bits.shape[0]            # a kernel mesh; == n unsharded)
+    bn = _block_rows(nr, 4 * w * k * 4)
     assert bn is not None, "resolve_emit_mode admitted an infeasible shape"
 
     def kernel(win_ref, have_ref, gos_ref, tb_ref, nbr_ref, out_ref):
@@ -147,7 +155,7 @@ def emit_pallas(window, have, gossip_u8, topic_bits, nbr, m, budget,
 
     return pl.pallas_call(
         kernel,
-        grid=(n // bn,),
+        grid=(nr // bn,),
         in_specs=[
             pl.BlockSpec((w, n), lambda i: (0, 0)),       # window table
             pl.BlockSpec((w, bn), lambda i: (0, i)),      # have
@@ -156,7 +164,7 @@ def emit_pallas(window, have, gossip_u8, topic_bits, nbr, m, budget,
             pl.BlockSpec((bn, k), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((bn, m), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, m), jnp.int32),
+        out_shape=jax.ShapeDtypeStruct((nr, m), jnp.int32),
         interpret=interpret,
     )(window, have, gossip_u8, topic_bits, nbr)
 
@@ -185,9 +193,9 @@ def iwant_resolve_pallas(pend, answers, have, vm, inv_n, alive, data_ok_u8,
     from jax.experimental import pallas as pl
 
     w, n = answers.shape
-    k = nbr.shape[1]
-    t = topic_bits.shape[0]
-    bn = _block_rows(n, 4 * w * k * 4)
+    nr, k = nbr.shape                  # receiver rows (local shard under
+    t = topic_bits.shape[0]            # a kernel mesh; == n unsharded)
+    bn = _block_rows(nr, 4 * w * k * 4)
     assert bn is not None, "resolve_hop_mode admitted an infeasible shape"
 
     def kernel(pend_ref, ans_ref, have_ref, vm_ref, inv_ref, alive_ref,
@@ -239,7 +247,7 @@ def iwant_resolve_pallas(pend, answers, have, vm, inv_n, alive, data_ok_u8,
     tkn = lambda i: (0, 0, i)                             # noqa: E731
     outs = pl.pallas_call(
         kernel,
-        grid=(n // bn,),
+        grid=(nr // bn,),
         in_specs=[
             pl.BlockSpec((bn, m), lambda i: (i, 0)),      # pend
             pl.BlockSpec((w, n), lambda i: (0, 0)),       # answers table
@@ -257,11 +265,11 @@ def iwant_resolve_pallas(pend, answers, have, vm, inv_n, alive, data_ok_u8,
             pl.BlockSpec((k, bn), lambda i: (0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((w, n), U32),
-            jax.ShapeDtypeStruct((w, n), U32),
-            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
-            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
-            jax.ShapeDtypeStruct((k, n), jnp.uint8),
+            jax.ShapeDtypeStruct((w, nr), U32),
+            jax.ShapeDtypeStruct((w, nr), U32),
+            jax.ShapeDtypeStruct((t, k, nr), jnp.uint8),
+            jax.ShapeDtypeStruct((t, k, nr), jnp.uint8),
+            jax.ShapeDtypeStruct((k, nr), jnp.uint8),
         ],
         interpret=interpret,
     )(pend, answers, have, vm, inv_n, alive, data_ok_u8, topic_bits, nbr)
@@ -284,9 +292,9 @@ def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
     from jax.experimental import pallas as pl
 
     w, n = frontier.shape
-    k = nbr.shape[1]
-    t = topic_bits.shape[0]
-    bn = _block_rows(n, 4 * w * k * 4)
+    nr, k = nbr.shape                  # receiver rows (local shard under
+    t = topic_bits.shape[0]            # a kernel mesh; == n unsharded)
+    bn = _block_rows(nr, 4 * w * k * 4)
     assert bn is not None, "resolve_hop_mode admitted an infeasible shape"
 
     def kernel(fro_ref, have_ref, dlv_ref, dlvnew_ref, vm_ref, inv_ref,
@@ -348,7 +356,7 @@ def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
 
     wn = lambda i: (0, i)       # [W, BN] blocks          # noqa: E731
     tkn = lambda i: (0, 0, i)   # [T, K, BN] blocks       # noqa: E731
-    grid = n // bn
+    grid = nr // bn
     outs = pl.pallas_call(
         kernel,
         grid=(grid,),
@@ -379,16 +387,84 @@ def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
             pl.BlockSpec((t, k, bn), tkn),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((w, n), U32),
-            jax.ShapeDtypeStruct((w, n), U32),
-            jax.ShapeDtypeStruct((w, n), U32),
-            jax.ShapeDtypeStruct((w, n), U32),
-            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
-            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
-            jax.ShapeDtypeStruct((t, k, n), jnp.uint8),
+            jax.ShapeDtypeStruct((w, nr), U32),
+            jax.ShapeDtypeStruct((w, nr), U32),
+            jax.ShapeDtypeStruct((w, nr), U32),
+            jax.ShapeDtypeStruct((w, nr), U32),
+            jax.ShapeDtypeStruct((t, k, nr), jnp.uint8),
+            jax.ShapeDtypeStruct((t, k, nr), jnp.uint8),
+            jax.ShapeDtypeStruct((t, k, nr), jnp.uint8),
         ],
         input_output_aliases={1: 1, 2: 2, 3: 3, 12: 4, 13: 5, 14: 6},
         interpret=interpret,
+    )(frontier, have, dlv, dlv_new, vm, inv_n, window_old, valid_msg,
+      nbr, fwd_mask_u8, mesh_u8, topic_bits, nv, ni, dup)
+    return HopOut(*outs)
+
+
+# --- kernel-mesh dispatch (parallel/kernel_context.py) ---
+#
+# Under a sharded step the SPMD partitioner cannot split a pallas_call, so
+# each kernel dispatches through shard_map: the packed lookup table (the
+# sender-indexed [W, N] window — the ONLY operand read through global
+# neighbor ids) replicates via one small all-gather, every receiver-indexed
+# operand stays sharded, and each device runs its own peer rows. Unsharded
+# callers fall through to the plain kernels.
+
+_WN = (None, PEER)          # [W, N] receiver-indexed packed words
+_ROWS = (PEER, None)        # [N, K]-style receiver-major arrays
+_TKN = (None, None, PEER)   # [T, K, N] count accumulators
+_REPL2 = (None, None)       # replicated 2-D (tables, topic bits)
+
+
+def emit_dispatch(window, have, gossip_u8, topic_bits, nbr, m, budget,
+                  interpret=False):
+    """emit_pallas, shard_map-wrapped when a kernel mesh is active."""
+    fn = functools.partial(emit_pallas, m=m, budget=budget,
+                           interpret=interpret)
+    if current_kernel_mesh() is None:
+        return fn(window, have, gossip_u8, topic_bits, nbr)
+    return shard_kernel(
+        fn,
+        in_specs=[_REPL2, _WN, (PEER, None, None), _REPL2, _ROWS],
+        out_specs=[_ROWS],
+    )(window, have, gossip_u8, topic_bits, nbr)
+
+
+def iwant_resolve_dispatch(pend, answers, have, vm, inv_n, alive,
+                           data_ok_u8, topic_bits, nbr, m,
+                           interpret=False) -> ResolveOut:
+    """iwant_resolve_pallas, shard_map-wrapped when a kernel mesh is active."""
+    fn = functools.partial(iwant_resolve_pallas, m=m, interpret=interpret)
+    if current_kernel_mesh() is None:
+        return fn(pend, answers, have, vm, inv_n, alive, data_ok_u8,
+                  topic_bits, nbr)
+    outs = shard_kernel(
+        lambda *a: tuple(fn(*a)),
+        in_specs=[_ROWS, _REPL2, _WN, _WN, _WN, _REPL2, _ROWS, _REPL2,
+                  _ROWS],
+        out_specs=[_WN, _WN, _TKN, _TKN, _WN],
+    )(pend, answers, have, vm, inv_n, alive, data_ok_u8, topic_bits, nbr)
+    return ResolveOut(*outs)
+
+
+def hop_dispatch(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
+                 valid_msg, nbr, fwd_mask_u8, mesh_u8, topic_bits,
+                 nv, ni, dup, interpret=False) -> HopOut:
+    """hop_pallas, shard_map-wrapped when a kernel mesh is active. The
+    frontier is the one sender-indexed table; its replication is the whole
+    per-hop cross-device exchange (0.8 MB at the 100k headline shape)."""
+    fn = functools.partial(hop_pallas, interpret=interpret)
+    if current_kernel_mesh() is None:
+        return fn(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
+                  valid_msg, nbr, fwd_mask_u8, mesh_u8, topic_bits,
+                  nv, ni, dup)
+    outs = shard_kernel(
+        lambda *a: tuple(fn(*a)),
+        in_specs=[_REPL2, _WN, _WN, _WN, _WN, _WN, _WN, _REPL2, _ROWS,
+                  (PEER, None, None), (PEER, None, None), _REPL2,
+                  _TKN, _TKN, _TKN],
+        out_specs=[_WN, _WN, _WN, _WN, _TKN, _TKN, _TKN],
     )(frontier, have, dlv, dlv_new, vm, inv_n, window_old, valid_msg,
       nbr, fwd_mask_u8, mesh_u8, topic_bits, nv, ni, dup)
     return HopOut(*outs)

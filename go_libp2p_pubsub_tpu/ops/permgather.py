@@ -34,11 +34,40 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..parallel.kernel_context import (
+    PEER,
+    current_kernel_mesh,
+    local_rows,
+    shard_kernel,
+)
+
 # VMEM budgets (v5e ~16MB/core): the kernel holds the whole [N,K] payload
 # plus one [BN,K,K] row-take scratch per block; both must fit with headroom
 # for the index/output blocks
 _PALLAS_VMEM_PAYLOAD_BYTES = 8 * 1024 * 1024
 _PALLAS_VMEM_SCRATCH_BYTES = 4 * 1024 * 1024
+
+
+def _mosaic_take(tab, idx):
+    """``out[r, l] = tab[r, idx[l]]`` — the one gather Mosaic lowers.
+
+    Pallas-TPU supports exactly one gather form: a same-shape 2-D
+    ``take_along_axis`` (lowered to ``tpu.dynamic_gather``); arbitrary-length
+    ``jnp.take`` raises "Shape mismatch in input, indices and output"
+    (discovered on the first live tunnel window — interpret mode accepts
+    anything). So: pad ``idx`` [L] to the table width C (L <= C, enforced by
+    the callers' block-size caps), broadcast it across rows, take, slice."""
+    r, c = tab.shape
+    length = idx.shape[0]
+    if length > c:
+        raise ValueError(f"flat index length {length} exceeds table width "
+                         f"{c}; caller must cap its block size")
+    if length < c:
+        idx = jnp.concatenate(
+            [idx, jnp.zeros((c - length,), idx.dtype)])
+    g = jnp.take_along_axis(tab, jnp.broadcast_to(idx[None, :], (r, c)),
+                            axis=1)
+    return g[:, :length]
 
 
 def _gather_scalar(payload, jn, rk):
@@ -50,25 +79,40 @@ def _gather_rows(payload, jn, rk):
     return jnp.take_along_axis(rows, rk[:, :, None], axis=-1)[..., 0]
 
 
-def _block_rows(n: int, row_bytes: int) -> int | None:
+def _block_rows(n: int, row_bytes: int, cap: int | None = None) -> int | None:
     """Largest receiver-block size whose per-block scratch (``row_bytes``
     per receiver row) fits the VMEM budget, among divisors of n; None when
-    no feasible block exists (caller falls back to the XLA formulation)."""
+    no feasible block exists (caller falls back to the XLA formulation).
+    ``cap`` additionally bounds the block (the _mosaic_take gather needs
+    block_rows * K flat indices to fit the table width). Prefers
+    power-of-two blocks (TPU tile alignment); sharded-local row counts like
+    100000/8 = 12500 have no feasible power-of-two divisor, so the fallback
+    scans all divisors for the largest fitting one."""
     bn_max = _PALLAS_VMEM_SCRATCH_BYTES // max(1, row_bytes)
+    if cap is not None:
+        bn_max = min(bn_max, cap)
+    if bn_max < 1:
+        return None
     for bn in (1024, 512, 256, 128, 64, 32, 16, 8):
         if bn <= bn_max and n % bn == 0:
             return bn
     if n <= bn_max:
         return n                      # single block, scratch still fits
+    for bn in range(min(bn_max, n - 1), 0, -1):
+        if n % bn == 0:
+            return bn
     return None
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _gather_pallas(payload, jn, rk, interpret=False):
+    """``payload`` is the full [N, K] table (global under sharding); ``jn``/
+    ``rk`` may cover a subset of receiver rows (the local shard)."""
     from jax.experimental import pallas as pl
 
     n, k = payload.shape
-    bn = _block_rows(n, k * k * payload.dtype.itemsize)
+    nr = jn.shape[0]                                       # local rows
+    bn = _block_rows(nr, k * k * payload.dtype.itemsize)
     assert bn is not None, "resolve_mode admitted an infeasible shape"
 
     def kernel(payload_ref, jn_ref, rk_ref, out_ref):
@@ -79,14 +123,14 @@ def _gather_pallas(payload, jn, rk, interpret=False):
 
     return pl.pallas_call(
         kernel,
-        grid=(n // bn,),
+        grid=(nr // bn,),
         in_specs=[
             pl.BlockSpec((n, k), lambda i: (0, 0)),        # full payload
             pl.BlockSpec((bn, k), lambda i: (i, 0)),
             pl.BlockSpec((bn, k), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((bn, k), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, k), payload.dtype),
+        out_shape=jax.ShapeDtypeStruct((nr, k), payload.dtype),
         interpret=interpret,
     )(payload, jn, rk)
 
@@ -99,27 +143,28 @@ def _gather_words_pallas(x_w, nbr, interpret=False):
     from jax.experimental import pallas as pl
 
     w, n = x_w.shape
-    k = nbr.shape[1]
+    nr, k = nbr.shape                                      # local rows
     # x2: the [W,K,BN] output block matches the gather temporary in size
-    # (unlike the edge kernel whose output is K-times smaller than scratch)
-    bn = _block_rows(n, 2 * w * k * x_w.dtype.itemsize)
+    # (unlike the edge kernel whose output is K-times smaller than scratch);
+    # cap: the flat _mosaic_take needs BN*K <= table width N
+    bn = _block_rows(nr, 2 * w * k * x_w.dtype.itemsize, cap=n // k)
     assert bn is not None, "resolve_words_mode admitted an infeasible shape"
 
     def kernel(pay_ref, nbr_ref, out_ref):
         pay = pay_ref[:]                                   # [W, N] in VMEM
-        idx = nbr_ref[:]                                   # [BN, K]
-        g = jnp.take(pay, idx.reshape(-1), axis=1)         # [W, BN*K]
-        out_ref[:] = jnp.swapaxes(g.reshape(w, bn, k), 1, 2)
+        idx = nbr_ref[:].T.reshape(-1)                     # [K*BN] k-major
+        g = _mosaic_take(pay, idx)                         # [W, K*BN]
+        out_ref[:] = g.reshape(w, k, bn)
 
     return pl.pallas_call(
         kernel,
-        grid=(n // bn,),
+        grid=(nr // bn,),
         in_specs=[
             pl.BlockSpec((w, n), lambda i: (0, 0)),        # full table
             pl.BlockSpec((bn, k), lambda i: (i, 0)),
         ],
         out_specs=pl.BlockSpec((w, k, bn), lambda i: (0, 0, i)),
-        out_shape=jax.ShapeDtypeStruct((w, k, n), x_w.dtype),
+        out_shape=jax.ShapeDtypeStruct((w, k, nr), x_w.dtype),
         interpret=interpret,
     )(x_w, nbr)
 
@@ -140,10 +185,10 @@ def _edge_table_pallas(table, jn, rk, b_planes, interpret=False):
     from jax.experimental import pallas as pl
 
     n, wb = table.shape
-    k = jn.shape[1]
+    nr, k = jn.shape                                       # local rows
     n_groups = (b_planes + 31) // 32
     # scratch per receiver row: [K, WB] gathered rows + [K] work vectors
-    bn = _block_rows(n, 2 * k * wb * 4)
+    bn = _block_rows(nr, 2 * k * wb * 4)
     assert bn is not None, "resolve admitted an infeasible shape"
 
     def kernel(tab_ref, jn_ref, rk_ref, *out_refs):
@@ -164,7 +209,7 @@ def _edge_table_pallas(table, jn, rk, b_planes, interpret=False):
 
     return pl.pallas_call(
         kernel,
-        grid=(n // bn,),
+        grid=(nr // bn,),
         in_specs=[
             pl.BlockSpec((n, wb), lambda i: (0, 0)),       # full table
             pl.BlockSpec((bn, k), lambda i: (i, 0)),
@@ -172,7 +217,7 @@ def _edge_table_pallas(table, jn, rk, b_planes, interpret=False):
         ],
         out_specs=[pl.BlockSpec((bn, k), lambda i: (i, 0))
                    for _ in range(n_groups)],
-        out_shape=[jax.ShapeDtypeStruct((n, k), jnp.uint32)
+        out_shape=[jax.ShapeDtypeStruct((nr, k), jnp.uint32)
                    for _ in range(n_groups)],
         interpret=interpret,
     )(table, jn, rk)
@@ -189,9 +234,11 @@ def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
         # hit the interpret-mode emulator, far slower than compiled rows
         mode = {"cpu": "scalar", "tpu": "pallas"}.get(backend, "rows")
     if mode == "pallas":
+        # table feasibility is GLOBAL n (the whole bit-table pins in VMEM);
+        # block feasibility is the per-shard row count under a kernel mesh
         wb = (b_planes * k + 31) // 32
         if (n * wb * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
-                or _block_rows(n, 2 * k * wb * 4) is None):
+                or _block_rows(local_rows(n), 2 * k * wb * 4) is None):
             return "rows"
     return mode
 
@@ -213,7 +260,7 @@ def resolve_words_mode(mode: str, w: int, n: int, k: int,
         mode = {"cpu": "scalar", "tpu": "pallas"}.get(backend, "rows")
     if mode == "pallas":
         if (w * n * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
-                or _block_rows(n, 2 * w * k * itemsize) is None):
+                or _block_rows(local_rows(n), 2 * w * k * itemsize) is None):
             return "rows"
     return mode
 
@@ -240,8 +287,14 @@ def gather_words(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
         rows = planes[nbr]                                # [N, K, M]
         return jnp.transpose(pack_bool(rows), (2, 1, 0))  # [W, K, N]
     if mode == "pallas":
-        return _gather_words_pallas(x_w, nbr,
-                                    interpret=jax.default_backend() != "tpu")
+        fn = functools.partial(_gather_words_pallas,
+                               interpret=jax.default_backend() != "tpu")
+        if current_kernel_mesh() is not None:
+            # table replicated (one small all-gather), rows per-shard
+            return shard_kernel(fn,
+                                in_specs=[(None, None), (PEER, None)],
+                                out_specs=[(None, None, PEER)])(x_w, nbr)
+        return fn(x_w, nbr)
     raise ValueError(f"unknown gather_words mode {mode!r}")
 
 
@@ -253,7 +306,7 @@ def resolve_mode(mode: str, payload_dtype, n: int, k: int) -> str:
     if mode == "pallas":
         itemsize = jnp.dtype(payload_dtype).itemsize
         if (itemsize < 4 or n * k * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
-                or _block_rows(n, k * k * itemsize) is None):
+                or _block_rows(local_rows(n), k * k * itemsize) is None):
             return "rows"    # sub-word dtype, payload > VMEM budget, or no
                              # block size whose row scratch fits
     return mode
@@ -273,6 +326,12 @@ def permutation_gather(payload: jnp.ndarray, jn: jnp.ndarray,
     if mode == "rows":
         return _gather_rows(payload, jn, rk)
     if mode == "pallas":
-        return _gather_pallas(payload, jn, rk,
-                              interpret=jax.default_backend() != "tpu")
+        fn = functools.partial(_gather_pallas,
+                               interpret=jax.default_backend() != "tpu")
+        if current_kernel_mesh() is not None:
+            return shard_kernel(fn,
+                                in_specs=[(None, None), (PEER, None),
+                                          (PEER, None)],
+                                out_specs=[(PEER, None)])(payload, jn, rk)
+        return fn(payload, jn, rk)
     raise ValueError(f"unknown edge_gather_mode {mode!r}")

@@ -298,9 +298,9 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # the sender answers from its mcache; delivery counts as a first delivery
     # from a non-mesh peer) --
     from .hopkernel import (
-        emit_pallas,
-        hop_pallas,
-        iwant_resolve_pallas,
+        emit_dispatch,
+        hop_dispatch,
+        iwant_resolve_dispatch,
         resolve_emit_mode,
         resolve_hop_mode,
     )
@@ -313,7 +313,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     if hop_mode == "pallas":
         # fused resolve (PERF_MODEL.md S6): eligibility (resolve_hop_mode)
         # guarantees the cap/throttle plumbing below is dead here
-        r = iwant_resolve_pallas(
+        r = iwant_resolve_dispatch(
             state.iwant_pending, answer_bits, have_bits, vm, inv_n,
             alive_bits[:, None],
             data_ok.astype(jnp.uint8), topic_bits, nbr, m=m,
@@ -468,11 +468,11 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             # expansion + K-prefix winner attribution + uint8 event counts
             # in one VMEM pass; eligibility (resolve_hop_mode) guarantees
             # the cap/gater/provenance/flood paths below are dead here
-            h = hop_pallas(c["frontier"], c["have"], c["dlv"], c["dlv_new"],
-                           vm, inv_n, window_old, valid_msg_bits[:, None],
-                           nbr, fwd_u8, mesh_u8, topic_bits,
-                           c["nv"], c["ni"], c["dup"],
-                           interpret=jax.default_backend() != "tpu")
+            h = hop_dispatch(c["frontier"], c["have"], c["dlv"], c["dlv_new"],
+                             vm, inv_n, window_old, valid_msg_bits[:, None],
+                             nbr, fwd_u8, mesh_u8, topic_bits,
+                             c["nv"], c["ni"], c["dup"],
+                             interpret=jax.default_backend() != "tpu")
             out = dict(c)
             out.update(i=c["i"] + 1, frontier=h.new_valid, have=h.have,
                        dlv=h.dlv, dlv_new=h.dlv_new, nv=h.nv, ni=h.ni,
@@ -650,7 +650,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         # fused chooser (PERF_MODEL.md S7): window table in VMEM, budget
         # scan per receiver block; covers budgeted and unbudgeted paths
         # (budget >= M reduces to the lowest-offering-slot choice)
-        iwant_pending = emit_pallas(
+        iwant_pending = emit_dispatch(
             window_bits, have_bits, inc_gossip.astype(jnp.uint8),
             topic_bits, nbr, m=m,
             budget=min(cfg.max_iwant_per_tick, m),

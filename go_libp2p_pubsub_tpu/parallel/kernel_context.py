@@ -1,0 +1,99 @@
+"""Trace-time mesh context for shard_map-wrapped Pallas kernels.
+
+The engine's Pallas kernels (ops/permgather, ops/hopkernel) are opaque to
+the SPMD partitioner: under a plain ``pjit`` over a device mesh it can only
+satisfy them by all-gathering EVERY operand and running the full-size kernel
+replicated on every device — full work × n_devices, the opposite of
+scaling. The fix (ROUND4_NOTES.md sharded-path item) is to dispatch them
+under ``jax.shard_map`` with explicit specs: the small packed lookup tables
+(the [W, N] message windows / [N, WB] edge bit-tables — ≤ ~1 MB at the
+100k-peer headline shape) replicate, which the partitioner realizes as one
+cheap all-gather per call, and every receiver-indexed operand stays
+sharded, so each device runs the kernel over its own peer rows only. This
+is the TPU-native analogue of the reference's per-connection stream fan-out
+(comm.go:44-191): the only cross-device traffic is the table everyone
+reads.
+
+``parallel.sharding.make_sharded_step`` enters :func:`kernel_mesh` while
+tracing the sharded step; the kernel dispatch sites consult
+:func:`current_kernel_mesh` at trace time and wrap themselves with
+:func:`shard_kernel` when a mesh is active. Unsharded runs (context absent)
+dispatch the kernels directly, exactly as before.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import NamedTuple
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+PEER = "__peer_axes__"          # spec placeholder for the sharded peer axis
+
+
+class KernelMesh(NamedTuple):
+    mesh: Mesh
+    peer_axes: tuple            # mesh axis name(s) the peer dim shards over
+
+
+_current: KernelMesh | None = None
+
+
+@contextmanager
+def kernel_mesh(mesh: Mesh, peer_axes):
+    """Activate shard_map kernel dispatch for code traced inside."""
+    global _current
+    prev = _current
+    _current = KernelMesh(mesh, tuple(peer_axes))
+    try:
+        yield
+    finally:
+        _current = prev
+
+
+def current_kernel_mesh() -> KernelMesh | None:
+    return _current
+
+
+def peer_shards() -> int:
+    """Number of shards the peer axis splits over (1 when unsharded)."""
+    ctx = _current
+    if ctx is None:
+        return 1
+    size = 1
+    for ax in ctx.peer_axes:
+        size *= ctx.mesh.shape[ax]
+    return size
+
+
+def local_rows(n: int) -> int:
+    """Per-device peer-row count under the active context (n when absent)."""
+    shards = peer_shards()
+    if n % shards:
+        raise ValueError(
+            f"n_peers {n} does not divide the {shards}-shard peer axis")
+    return n // shards
+
+
+def _spec(dims) -> P:
+    ctx = _current
+    return P(*[ctx.peer_axes if d is PEER else None for d in dims])
+
+
+def shard_kernel(fn, in_specs, out_specs):
+    """shard_map ``fn`` over the active mesh. ``in_specs``/``out_specs`` are
+    per-array dim tuples using ``PEER`` for the sharded peer dimension and
+    None for replicated dims (an all-``None`` tuple replicates the whole
+    array — the table inputs). Must only be called with a context active."""
+    ctx = _current
+    assert ctx is not None, "shard_kernel outside a kernel_mesh context"
+    ins = tuple(_spec(s) for s in in_specs)
+    outs = tuple(_spec(s) for s in out_specs)
+    if len(outs) == 1:
+        outs = outs[0]
+    # check_vma off: pallas_call carries no varying-manual-axes rule, and
+    # the specs above are exactly the partitioning the kernels are written
+    # for (tables whole, rows local)
+    return jax.shard_map(fn, mesh=ctx.mesh, in_specs=ins, out_specs=outs,
+                         check_vma=False)

@@ -7,9 +7,12 @@ gathers over the neighbor table; under jit's SPMD partitioner those lower to
 XLA collectives riding ICI (the TPU-native replacement for the reference's
 libp2p streams, SURVEY.md §2.3).
 
-No shard_map needed at this layer: annotate in/out shardings and let the
-compiler insert all_gathers/collective-permutes for the (sparse, Dhi-bounded)
-cross-shard edges.
+The XLA-formulation kernels need no shard_map: annotate in/out shardings and
+let the compiler insert all_gathers/collective-permutes for the (sparse,
+Dhi-bounded) cross-shard edges. The Pallas kernels DO — the partitioner
+cannot split an opaque pallas_call — so ``make_sharded_step`` activates
+``kernel_context.kernel_mesh`` while tracing and the kernel dispatch sites
+shard_map themselves (tables replicated, receiver rows local).
 """
 
 from __future__ import annotations
@@ -95,14 +98,25 @@ def shard_state(state: SimState, mesh: Mesh, cfg: SimConfig) -> SimState:
 
 
 def make_sharded_step(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
-    """jit the full network step with explicit peer-sharded in/out state."""
+    """jit the full network step with explicit peer-sharded in/out state.
+
+    Entering :func:`kernel_context.kernel_mesh` while the step traces makes
+    the Pallas kernel dispatch sites (ops/permgather, ops/hopkernel) wrap
+    themselves in shard_map — without it the SPMD partitioner could only
+    replicate the pallas_calls (full-size kernel on every device). The
+    XLA-formulation paths ignore the context and auto-partition as before.
+    """
     from ..sim.engine import step
+    from .kernel_context import kernel_mesh
 
     shardings = state_shardings(mesh, cfg)
     key_sh = NamedSharding(mesh, P())
+    peer_axes = tuple(ax for ax in (DCN_AXIS, PEER_AXIS)
+                      if ax in mesh.axis_names)
 
     @partial(jax.jit, in_shardings=(shardings, key_sh), out_shardings=shardings)
     def sharded_step(state: SimState, key: jax.Array) -> SimState:
-        return step(state, cfg, tp, key)
+        with kernel_mesh(mesh, peer_axes):
+            return step(state, cfg, tp, key)
 
     return sharded_step

@@ -24,7 +24,14 @@ prints XLA's own bytes-accessed estimate next to the analytic number. On CPU
 the lowering differs (scalar gathers, no rows temporaries), so the check
 validates the *inventory* (which arrays a phase touches), not the TPU total.
 
-Usage: python scripts/perf_model.py [scenario] [--cost-analysis]
+Usage: python scripts/perf_model.py [scenario] [--cost-analysis] [--sharded N]
+
+--sharded N prints the v5e-N projection for the landed design: per-device
+HBM traffic is total/N (every [N, ...] array shards on the peer axis), plus
+the cross-device exchange the shard_map-wrapped Pallas kernels pay — the
+replicated packed lookup tables (parallel/kernel_context.py), one small
+all-gather per kernel call. BASELINE.md specifies the 1000 hb/s bar on
+v5e-8, so --sharded 8 is the number that answers it.
 """
 
 import os
@@ -231,6 +238,41 @@ def report(name, n, k, t, m, w, hops, p, design):
     return total, 1e3 / ms
 
 
+def report_sharded(name, n, k, t, m, w, hops, p, n_dev,
+                   ici_gbps=400.0):
+    """v5e-N projection for the landed design: per-device roofline time +
+    the replicated-table all-gather payload per tick. ICI bandwidth is a
+    conservative per-chip number (v5e: 4 links x ~100+ GB/s usable)."""
+    phases = model(n, k, t, m, w, hops, p, "planned")
+    total = sum(ph.total for ph in phases)
+    per_dev = total / n_dev
+    f = 4
+    wn_table = f * w * n                    # [W, N] u32 packed table
+    wb1 = f * n * (((1 * k) + 31) // 32)    # [N, ceil(BK/32)] bit-tables
+    wb2 = f * n * (((2 * k) + 31) // 32)
+    exchange = [
+        ("hop frontier table x hops", hops * wn_table),
+        ("IWANT-resolve answer table", wn_table),
+        ("gossip-emit window table", wn_table),
+        ("edge bit-tables (B=2,1,2 planes)", 2 * wb2 + wb1),
+    ]
+    ex_total = sum(b for _, b in exchange)
+    hbm_ms = fmt_mb(per_dev) / 1e3 * V5E_MS_PER_GB
+    ici_ms = fmt_mb(ex_total) / 1e3 * (1e3 / ici_gbps)
+    ms = hbm_ms + ici_ms
+    print(f"\n== {name} [landed, sharded x{n_dev}] N={n} K={k} T={t} "
+          f"M={m} W={w} hops={hops} ==")
+    print(f"  {'per-device HBM':44s} {fmt_mb(per_dev):9.1f} MB  "
+          f"{hbm_ms:7.3f} ms")
+    for lbl, b in exchange:
+        print(f"      {lbl:52s} {fmt_mb(b):9.1f} MB")
+    print(f"  {'all-gather payload @ ' + str(ici_gbps) + ' GB/s ICI':44s} "
+          f"{fmt_mb(ex_total):9.1f} MB  {ici_ms:7.3f} ms")
+    print(f"  {'TOTAL':44s} {'':9s}     {ms:7.3f} ms"
+          f"   -> {1e3 / ms:8.1f} hb/s")
+    return 1e3 / ms
+
+
 def cost_analysis_check(n=10_000, k=32, m=64, p=8):
     """Compile each phase and print XLA's own bytes-accessed — an inventory
     check. MUST run in a process whose environment was scrubbed BEFORE
@@ -285,6 +327,9 @@ def main():
     if os.environ.get("_PERF_MODEL_CHILD") != "1":    # parent prints these
         for design in ("current", "planned"):
             report(which, design=design, **sh)
+        if "--sharded" in sys.argv:
+            n_dev = int(sys.argv[sys.argv.index("--sharded") + 1])
+            report_sharded(which, n_dev=n_dev, **sh)
     if "--cost-analysis" in sys.argv:
         # cross-check at the chosen shape, downscaled to 10k peers so the
         # CPU compile stays sane (the inventory, not N, is what's checked).

@@ -1,0 +1,98 @@
+"""Compile-and-run smoke test for every Pallas kernel on the real TPU.
+
+Round-4 lesson: interpret-mode parity (the CPU test tier) proves semantics
+but NOT that Mosaic can lower the kernel — the first live tunnel window
+revealed unsupported-gather failures in every fused kernel. This script
+runs each kernel natively (interpret=False) at a small shape and diffs the
+output against interpret mode, so a lowering regression is caught the
+moment a window is open, one kernel at a time, with full tracebacks.
+
+Usage (tunnel must be live): python scripts/tpu_kernel_smoke.py
+Exit code = number of failing kernels.
+"""
+
+import sys
+import traceback
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> int:
+    from go_libp2p_pubsub_tpu.ops import permgather as pg
+    from go_libp2p_pubsub_tpu.ops import hopkernel as hk
+    from go_libp2p_pubsub_tpu.ops.bits import U32
+
+    if jax.default_backend() != "tpu":
+        print(f"default backend is {jax.default_backend()}, not tpu — abort")
+        return 1
+
+    rng = np.random.default_rng(0)
+    n, k, t, m, w = 1024, 32, 1, 64, 2
+    nbr = jnp.asarray(rng.integers(0, n, (n, k)), jnp.int32)
+    rk = jnp.asarray(rng.integers(0, k, (n, k)), jnp.int32)
+    tab_wn = jnp.asarray(rng.integers(0, 2**32, (w, n), dtype=np.uint64),
+                         U32)
+    payload_nk = jnp.asarray(rng.integers(0, 2**32, (n, k), dtype=np.uint64),
+                             U32)
+    table_bits = jnp.asarray(
+        rng.integers(0, 2**32, (n, (2 * k + 31) // 32), dtype=np.uint64), U32)
+    planes_u8 = jnp.asarray(rng.integers(0, 2, (n, t, k)), jnp.uint8)
+    topic_bits = jnp.asarray(
+        rng.integers(0, 2**32, (t, w), dtype=np.uint64), U32)
+    pend = jnp.asarray(
+        np.where(rng.random((n, m)) < 0.1, rng.integers(0, k, (n, m)), -1),
+        jnp.int32)
+    acc = jnp.zeros((t, k, n), jnp.uint8)
+
+    fails = 0
+
+    def check(name, fn):
+        nonlocal fails
+        try:
+            got = jax.tree.map(np.asarray, fn(False))
+            want = jax.tree.map(np.asarray, fn(True))
+            jax.tree.map(np.testing.assert_array_equal, want, got)
+            print(f"PASS {name}")
+        except Exception:
+            fails += 1
+            print(f"FAIL {name}")
+            traceback.print_exc(limit=8)
+
+    check("gather_words_pallas",
+          lambda i: pg._gather_words_pallas(tab_wn, nbr, interpret=i))
+    check("gather_pallas (edge payload)",
+          lambda i: pg._gather_pallas(payload_nk, nbr, rk, interpret=i))
+    check("edge_table_pallas",
+          lambda i: tuple(pg._edge_table_pallas(table_bits, nbr, rk,
+                                                b_planes=2, interpret=i)))
+    check("emit_pallas",
+          lambda i: hk.emit_pallas(tab_wn, tab_wn ^ U32(0xA5A5A5A5),
+                                   planes_u8, topic_bits, nbr, m=m,
+                                   budget=m, interpret=i))
+    check("emit_pallas (binding budget)",
+          lambda i: hk.emit_pallas(tab_wn, tab_wn ^ U32(0xA5A5A5A5),
+                                   planes_u8, topic_bits, nbr, m=m,
+                                   budget=3, interpret=i))
+    check("iwant_resolve_pallas",
+          lambda i: hk.iwant_resolve_pallas(
+              pend, tab_wn, tab_wn ^ U32(0x33CC33CC), tab_wn | U32(1),
+              tab_wn & U32(0xF0F0F0F0), jnp.full((w, 1), U32(0xFFFFFFFF)),
+              planes_u8[:, 0, :], topic_bits, nbr, m=m, interpret=i))
+    check("hop_pallas",
+          lambda i: hk.hop_pallas(
+              tab_wn, tab_wn ^ U32(0x55AA55AA), tab_wn & U32(0xFF00FF00),
+              jnp.zeros_like(tab_wn), tab_wn | U32(3),
+              tab_wn & U32(0x0F0F0F0F), jnp.zeros_like(tab_wn),
+              jnp.full((w, 1), U32(0xFFFFFFFF)), nbr, planes_u8, planes_u8,
+              topic_bits, acc, acc, acc, interpret=i))
+    print(f"{fails} failing kernel(s)")
+    return fails
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
