@@ -69,13 +69,18 @@ class HopOut(NamedTuple):
 
 
 def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
-    """'pallas' on TPU for cap-free/gater-free/provenance-free gossipsub
-    configs with a VMEM-resident frontier table; 'xla' otherwise."""
+    """'xla' everywhere on ``auto``: the fused kernels are bit-exact and
+    shard_map-ready, but the first live-tunnel window proved current
+    Mosaic CANNOT lower any >128-wide table lookup ("Multiple source vregs
+    along gather dimension" — tpu.dynamic_gather shuffles within one
+    vector register only), so the VMEM-table design is not compilable on
+    real v5e today. Explicit ``pallas`` stays available for interpret-mode
+    tests, the virtual-mesh sharded path, and future Mosaic versions;
+    config eligibility still applies to it."""
     if mode not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown hop_mode {mode!r}")
-    backend = jax.default_backend()
     if mode == "auto":
-        mode = "pallas" if backend == "tpu" else "xla"
+        mode = "xla"
     if mode == "pallas":
         if (cfg.gater_enabled or cfg.record_provenance
                 or cfg.edge_queue_cap > 0 or cfg.validation_queue_cap > 0
@@ -95,9 +100,8 @@ def resolve_emit_mode(mode: str, w: int, n: int, k: int) -> str:
     only backend and VMEM-feasibility gates."""
     if mode not in ("auto", "xla", "pallas"):
         raise ValueError(f"unknown hop_mode {mode!r}")
-    backend = jax.default_backend()
     if mode == "auto":
-        mode = "pallas" if backend == "tpu" else "xla"
+        mode = "xla"               # see resolve_hop_mode: Mosaic gather wall
     if mode == "pallas":
         if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 4 * w * k * 4) is None):

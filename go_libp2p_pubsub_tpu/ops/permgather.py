@@ -55,19 +55,21 @@ def _mosaic_take(tab, idx):
     ``take_along_axis`` (lowered to ``tpu.dynamic_gather``); arbitrary-length
     ``jnp.take`` raises "Shape mismatch in input, indices and output"
     (discovered on the first live tunnel window — interpret mode accepts
-    anything). So: pad ``idx`` [L] to the table width C (L <= C, enforced by
-    the callers' block-size caps), broadcast it across rows, take, slice."""
+    anything). So the flat index vector [L] is processed in full-table-width
+    chunks: pad the (last) chunk to width C, broadcast across rows, take,
+    concatenate, slice back to L."""
     r, c = tab.shape
     length = idx.shape[0]
-    if length > c:
-        raise ValueError(f"flat index length {length} exceeds table width "
-                         f"{c}; caller must cap its block size")
-    if length < c:
-        idx = jnp.concatenate(
-            [idx, jnp.zeros((c - length,), idx.dtype)])
-    g = jnp.take_along_axis(tab, jnp.broadcast_to(idx[None, :], (r, c)),
-                            axis=1)
-    return g[:, :length]
+    outs = []
+    for s in range(0, length, c):
+        part = jax.lax.slice_in_dim(idx, s, min(s + c, length))
+        if part.shape[0] < c:
+            part = jnp.concatenate(
+                [part, jnp.zeros((c - part.shape[0],), part.dtype)])
+        outs.append(jnp.take_along_axis(
+            tab, jnp.broadcast_to(part[None, :], (r, c)), axis=1))
+    g = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
+    return g[:, :length] if g.shape[1] != length else g
 
 
 def _gather_scalar(payload, jn, rk):
@@ -79,60 +81,61 @@ def _gather_rows(payload, jn, rk):
     return jnp.take_along_axis(rows, rk[:, :, None], axis=-1)[..., 0]
 
 
-def _block_rows(n: int, row_bytes: int, cap: int | None = None) -> int | None:
-    """Largest receiver-block size whose per-block scratch (``row_bytes``
-    per receiver row) fits the VMEM budget, among divisors of n; None when
-    no feasible block exists (caller falls back to the XLA formulation).
-    ``cap`` additionally bounds the block (the _mosaic_take gather needs
-    block_rows * K flat indices to fit the table width). Prefers
-    power-of-two blocks (TPU tile alignment); sharded-local row counts like
-    100000/8 = 12500 have no feasible power-of-two divisor, so the fallback
-    scans all divisors for the largest fitting one."""
+def _block_rows(n: int, row_bytes: int) -> int | None:
+    """Receiver-block size for the Pallas kernels: the largest 128-multiple
+    divisor of n whose per-block scratch (``row_bytes`` per receiver row)
+    fits the VMEM budget, else the whole array as one block. None when
+    neither exists (caller falls back to the XLA formulation).
+
+    The 128-multiple constraint is Mosaic's, learned on the real chip: a
+    block's minor dimension must be lane-aligned (divisible by 128) or
+    cover the full array dimension — and the peer axis is the minor axis of
+    every packed table and accumulator these kernels block. Shapes whose
+    peer count has no 128-multiple divisor (e.g. exactly 100000) only get
+    the single-block form; the benchmark scenarios size their networks
+    128-friendly (102400, 51200, 10240, 1024) for this reason."""
     bn_max = _PALLAS_VMEM_SCRATCH_BYTES // max(1, row_bytes)
-    if cap is not None:
-        bn_max = min(bn_max, cap)
-    if bn_max < 1:
-        return None
-    for bn in (1024, 512, 256, 128, 64, 32, 16, 8):
+    for bn in (1024, 512, 256, 128):
         if bn <= bn_max and n % bn == 0:
             return bn
     if n <= bn_max:
         return n                      # single block, scratch still fits
-    for bn in range(min(bn_max, n - 1), 0, -1):
-        if n % bn == 0:
-            return bn
     return None
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def _gather_pallas(payload, jn, rk, interpret=False):
     """``payload`` is the full [N, K] table (global under sharding); ``jn``/
-    ``rk`` may cover a subset of receiver rows (the local shard)."""
+    ``rk`` may cover a subset of receiver rows (the local shard). The
+    payload flattens to a [1, N*K] VMEM row and the (row, slot) pair to a
+    linear index, so the in-kernel lookup is the one gather Mosaic supports
+    (_mosaic_take)."""
     from jax.experimental import pallas as pl
 
     n, k = payload.shape
     nr = jn.shape[0]                                       # local rows
-    bn = _block_rows(nr, k * k * payload.dtype.itemsize)
+    bn = _block_rows(nr, 2 * k * payload.dtype.itemsize)
     assert bn is not None, "resolve_mode admitted an infeasible shape"
+    flat = payload.reshape(1, n * k)
+    jn_t, rk_t = jn.T, rk.T                                # [K, N] k-major
 
-    def kernel(payload_ref, jn_ref, rk_ref, out_ref):
-        pay = payload_ref[:]                               # [N, K] in VMEM
-        rows = jnp.take(pay, jn_ref[:], axis=0)            # [BN, K, K]
-        out_ref[:] = jnp.take_along_axis(
-            rows, rk_ref[:][:, :, None], axis=-1)[..., 0]
+    def kernel(pay_ref, jnt_ref, rkt_ref, out_ref):
+        li = (jnt_ref[:] * k + rkt_ref[:]).reshape(-1)     # [K*BN] linear
+        g = _mosaic_take(pay_ref[:], li)                   # [1, K*BN]
+        out_ref[:] = g.reshape(k, bn).T                    # [BN, K] block
 
     return pl.pallas_call(
         kernel,
         grid=(nr // bn,),
         in_specs=[
-            pl.BlockSpec((n, k), lambda i: (0, 0)),        # full payload
-            pl.BlockSpec((bn, k), lambda i: (i, 0)),
-            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+            pl.BlockSpec((1, n * k), lambda i: (0, 0)),    # full payload
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((bn, k), lambda i: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((nr, k), payload.dtype),
         interpret=interpret,
-    )(payload, jn, rk)
+    )(flat, jn_t, rk_t)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -145,14 +148,14 @@ def _gather_words_pallas(x_w, nbr, interpret=False):
     w, n = x_w.shape
     nr, k = nbr.shape                                      # local rows
     # x2: the [W,K,BN] output block matches the gather temporary in size
-    # (unlike the edge kernel whose output is K-times smaller than scratch);
-    # cap: the flat _mosaic_take needs BN*K <= table width N
-    bn = _block_rows(nr, 2 * w * k * x_w.dtype.itemsize, cap=n // k)
+    # (unlike the edge kernel whose output is K-times smaller than scratch)
+    bn = _block_rows(nr, 2 * w * k * x_w.dtype.itemsize)
     assert bn is not None, "resolve_words_mode admitted an infeasible shape"
+    nbr_t = nbr.T                                          # [K, N] k-major
 
-    def kernel(pay_ref, nbr_ref, out_ref):
+    def kernel(pay_ref, nbrt_ref, out_ref):
         pay = pay_ref[:]                                   # [W, N] in VMEM
-        idx = nbr_ref[:].T.reshape(-1)                     # [K*BN] k-major
+        idx = nbrt_ref[:].reshape(-1)                      # [K*BN] k-major
         g = _mosaic_take(pay, idx)                         # [W, K*BN]
         out_ref[:] = g.reshape(w, k, bn)
 
@@ -161,12 +164,12 @@ def _gather_words_pallas(x_w, nbr, interpret=False):
         grid=(nr // bn,),
         in_specs=[
             pl.BlockSpec((w, n), lambda i: (0, 0)),        # full table
-            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((w, k, bn), lambda i: (0, 0, i)),
         out_shape=jax.ShapeDtypeStruct((w, k, nr), x_w.dtype),
         interpret=interpret,
-    )(x_w, nbr)
+    )(x_w, nbr_t)
 
 
 @functools.partial(jax.jit, static_argnames=("b_planes", "interpret"))
@@ -187,40 +190,45 @@ def _edge_table_pallas(table, jn, rk, b_planes, interpret=False):
     n, wb = table.shape
     nr, k = jn.shape                                       # local rows
     n_groups = (b_planes + 31) // 32
-    # scratch per receiver row: [K, WB] gathered rows + [K] work vectors
+    # scratch per receiver row: [WB, K] gathered row words + work vectors
     bn = _block_rows(nr, 2 * k * wb * 4)
     assert bn is not None, "resolve admitted an infeasible shape"
+    u32 = jnp.uint32
+    tab_t = table.T                                        # [WB, N]
+    jn_t, rk_t = jn.T, rk.T                                # [K, N] k-major
 
-    def kernel(tab_ref, jn_ref, rk_ref, *out_refs):
-        tab = tab_ref[:]                                   # [N, WB] in VMEM
-        jn_b = jn_ref[:]                                   # [BN, K]
-        rk_b = rk_ref[:]
-        rows = jnp.take(tab, jn_b.reshape(-1), axis=0)     # [BN*K, WB]
-        rows = rows.reshape(jn_b.shape[0], k, wb)
-        accs = [jnp.zeros(jn_b.shape, jnp.uint32) for _ in range(n_groups)]
+    def kernel(tabt_ref, jnt_ref, rkt_ref, *out_refs):
+        tab = tabt_ref[:]                                  # [WB, N] in VMEM
+        idx = jnt_ref[:].reshape(-1)                       # [K*BN] k-major
+        rows = _mosaic_take(tab, idx)                      # [WB, K*BN]
+        pos0 = rkt_ref[:].reshape(-1)[None, :]             # [1, K*BN]
+        accs = [jnp.zeros_like(pos0, dtype=u32) for _ in range(n_groups)]
         for b in range(b_planes):
-            pos = rk_b + b * k                             # bit positions
-            word = jnp.take_along_axis(rows, (pos // 32)[..., None],
-                                       axis=-1)[..., 0]
-            bit = (word >> (pos % 32).astype(jnp.uint32)) & jnp.uint32(1)
-            accs[b // 32] = accs[b // 32] | (bit << jnp.uint32(b % 32))
+            pos = pos0 + b * k                             # bit positions
+            wsel = pos // 32
+            word = jnp.zeros_like(accs[0])
+            for wi in range(wb):                           # wb is tiny and
+                word = jnp.where(wsel == wi,               # static: select
+                                 rows[wi:wi + 1], word)    # replaces gather
+            bit = (word >> (pos % 32).astype(u32)) & u32(1)
+            accs[b // 32] = accs[b // 32] | (bit << u32(b % 32))
         for ref, acc in zip(out_refs, accs):
-            ref[:] = acc
+            ref[:] = acc.reshape(k, bn).T                  # [BN, K] block
 
     return pl.pallas_call(
         kernel,
         grid=(nr // bn,),
         in_specs=[
-            pl.BlockSpec((n, wb), lambda i: (0, 0)),       # full table
-            pl.BlockSpec((bn, k), lambda i: (i, 0)),
-            pl.BlockSpec((bn, k), lambda i: (i, 0)),
+            pl.BlockSpec((wb, n), lambda i: (0, 0)),       # full table
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
+            pl.BlockSpec((k, bn), lambda i: (0, i)),
         ],
         out_specs=[pl.BlockSpec((bn, k), lambda i: (i, 0))
                    for _ in range(n_groups)],
         out_shape=[jax.ShapeDtypeStruct((nr, k), jnp.uint32)
                    for _ in range(n_groups)],
         interpret=interpret,
-    )(table, jn, rk)
+    )(tab_t, jn_t, rk_t)
 
 
 def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
@@ -230,9 +238,11 @@ def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
     per-group gather. Ineligible shapes degrade pallas -> rows."""
     backend = jax.default_backend()
     if mode == "auto":
-        # pallas only where it compiles natively; other accelerators would
-        # hit the interpret-mode emulator, far slower than compiled rows
-        mode = {"cpu": "scalar", "tpu": "pallas"}.get(backend, "rows")
+        # TPU auto is the packed-u32 advanced-index form: the live-window
+        # microbench measured it fastest of the compilable forms at 100k
+        # (39.9 ms vs rows 55.0), and Mosaic cannot lower the bit-table
+        # kernel's >128-wide VMEM gather (see hopkernel.resolve_hop_mode)
+        mode = {"cpu": "scalar", "tpu": "scalar"}.get(backend, "rows")
     if mode == "pallas":
         # table feasibility is GLOBAL n (the whole bit-table pins in VMEM);
         # block feasibility is the per-shard row count under a kernel mesh
@@ -256,8 +266,12 @@ def resolve_words_mode(mode: str, w: int, n: int, k: int,
     """
     backend = jax.default_backend()
     if mode == "auto":
-        # pallas only where it compiles natively (see resolve_edge_packed_mode)
-        mode = {"cpu": "scalar", "tpu": "pallas"}.get(backend, "rows")
+        # TPU auto reverts to rows (vector-DMA row slices): the Mosaic
+        # gather wall blocks the VMEM-table kernel (resolve_hop_mode), and
+        # rows beat scalar 2.5x for the M-wide window rows in round-2
+        # on-chip ablations (wide rows amortize per-index overhead in a
+        # way the 4-byte edge-payload rows do not)
+        mode = {"cpu": "scalar", "tpu": "rows"}.get(backend, "rows")
     if mode == "pallas":
         if (w * n * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 2 * w * k * itemsize) is None):
@@ -299,14 +313,17 @@ def gather_words(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
 
 
 def resolve_mode(mode: str, payload_dtype, n: int, k: int) -> str:
-    """Resolve ``auto``/ineligible requests to a concrete formulation."""
-    backend = jax.default_backend()
+    """Resolve ``auto``/ineligible requests to a concrete formulation.
+
+    TPU auto is ``scalar``: the live-window microbench at 100k measured
+    the direct advanced-index form at 39.9 ms vs 55.0 for rows — the
+    [N,K,K] rows temporary loses once its DMA rows are only K*4 bytes."""
     if mode == "auto":
-        mode = "scalar" if backend == "cpu" else "rows"
+        mode = "scalar"
     if mode == "pallas":
         itemsize = jnp.dtype(payload_dtype).itemsize
         if (itemsize < 4 or n * k * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
-                or _block_rows(local_rows(n), k * k * itemsize) is None):
+                or _block_rows(local_rows(n), 2 * k * itemsize) is None):
             return "rows"    # sub-word dtype, payload > VMEM budget, or no
                              # block size whose row scratch fits
     return mode

@@ -101,13 +101,16 @@ class TestHopKernelParity:
     def test_resolution_policy(self, monkeypatch):
         import go_libp2p_pubsub_tpu.ops.hopkernel as hk
         cfg, _, _ = _build()
-        # cpu auto keeps the XLA path
+        # auto keeps the XLA path on EVERY backend: Mosaic cannot lower
+        # the >128-wide VMEM table gather (resolve_hop_mode docstring)
         assert resolve_hop_mode("auto", cfg, 2, 100_000, 32) == "xla"
         monkeypatch.setattr(hk.jax, "default_backend", lambda: "tpu")
-        assert hk.resolve_hop_mode("auto", cfg, 2, 100_000, 32) == "pallas"
-        # ineligible configs fall back on TPU too
+        assert hk.resolve_hop_mode("auto", cfg, 2, 100_000, 32) == "xla"
+        # explicit pallas resolves for eligible configs at aligned shapes
+        assert hk.resolve_hop_mode("pallas", cfg, 2, 102_400, 32) == "pallas"
+        # ineligible configs fall back even when pallas is requested
         for bad in (dict(gater_enabled=True), dict(record_provenance=True),
                     dict(edge_queue_cap=8), dict(validation_queue_cap=64),
                     dict(flood_publish=True)):
             c = dataclasses.replace(cfg, **bad)
-            assert hk.resolve_hop_mode("auto", c, 2, 100_000, 32) == "xla", bad
+            assert hk.resolve_hop_mode("pallas", c, 2, 102_400, 32) == "xla", bad

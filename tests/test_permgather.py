@@ -94,13 +94,19 @@ class TestWordsGatherParity:
         # cpu auto stays on the scalar fast path
         assert resolve_words_mode("auto", 2, 1024, 8) == "scalar"
 
-    def test_resolve_words_auto_is_pallas_on_tpu(self, monkeypatch):
-        """TPU auto resolves to the VMEM table kernel (PERF_MODEL.md S1),
-        still falling back to rows for VMEM-infeasible shapes."""
+    def test_resolve_words_auto_policy(self, monkeypatch):
+        """TPU auto is rows: the live-window microbench + the Mosaic
+        >128-wide-gather wall (resolve_hop_mode docstring) retired the
+        VMEM table kernel from auto; it stays reachable explicitly."""
         import go_libp2p_pubsub_tpu.ops.permgather as pg
         monkeypatch.setattr(pg.jax, "default_backend", lambda: "tpu")
-        assert pg.resolve_words_mode("auto", 2, 100_000, 32) == "pallas"
-        assert pg.resolve_words_mode("auto", 64, 1_000_000, 8) == "rows"
+        assert pg.resolve_words_mode("auto", 2, 100_000, 32) == "rows"
+        # explicit pallas needs a lane-aligned block: 102400 has a
+        # 128-multiple divisor, exactly-100000 does not (Mosaic requires
+        # the blocked peer axis aligned to 128 — _block_rows docstring)
+        assert pg.resolve_words_mode("pallas", 2, 102_400, 32) == "pallas"
+        assert pg.resolve_words_mode("pallas", 2, 100_000, 32) == "rows"
+        assert pg.resolve_words_mode("pallas", 64, 1_000_000, 8) == "rows"
 
 
 class TestEdgeTableKernel:
@@ -135,12 +141,16 @@ class TestEdgeTableKernel:
         import go_libp2p_pubsub_tpu.ops.permgather as pg
         assert pg.resolve_edge_packed_mode("auto", 1024, 8, 2) == "scalar"
         monkeypatch.setattr(pg.jax, "default_backend", lambda: "tpu")
-        # 100k x (2 planes * 32 slots) table = 0.8MB -> pallas-eligible
-        assert pg.resolve_edge_packed_mode("auto", 100_000, 32, 2) == "pallas"
-        # beacon shape: 18 planes x 48 slots at 10k peers -> still eligible
-        assert pg.resolve_edge_packed_mode("auto", 10_000, 48, 18) == "pallas"
-        # table over the VMEM budget degrades to rows
-        assert pg.resolve_edge_packed_mode("auto", 2_000_000, 32, 64) == "rows"
+        # TPU auto is the packed-u32 advanced-index form (fastest measured
+        # compilable form on the live window; Mosaic blocks the bit-table
+        # kernel's wide gather — hopkernel.resolve_hop_mode docstring)
+        assert pg.resolve_edge_packed_mode("auto", 100_000, 32, 2) == "scalar"
+        # explicit pallas still resolves while VMEM-feasible AND the peer
+        # count has a 128-aligned block (102400 yes, 100000/10000 no)
+        assert pg.resolve_edge_packed_mode("pallas", 102_400, 32, 2) == "pallas"
+        assert pg.resolve_edge_packed_mode("pallas", 10_240, 48, 18) == "pallas"
+        # ...and a table over the VMEM budget degrades to rows
+        assert pg.resolve_edge_packed_mode("pallas", 2_000_000, 32, 64) == "rows"
 
 
 class TestShardedStepParity:
