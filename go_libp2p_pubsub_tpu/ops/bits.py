@@ -63,7 +63,8 @@ def unpack_words(p: jnp.ndarray, m: int) -> jnp.ndarray:
 
 
 def gather_words_rows(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
-                      mode: str = "auto") -> jnp.ndarray:
+                      mode: str = "auto",
+                      sort_key: jnp.ndarray | None = None) -> jnp.ndarray:
     """out[w, k, n] = x_w[w, nbr[n, k]] — neighbor gather of packed words.
 
     Formulation per ``mode`` (ops/permgather.py gather_words): on TPU the
@@ -74,7 +75,7 @@ def gather_words_rows(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
     in VMEM and skips the unpacked temporary entirely.
     """
     from .permgather import gather_words
-    return gather_words(x_w, nbr, m, mode)
+    return gather_words(x_w, nbr, m, mode, sort_key=sort_key)
 
 
 def reduce_or(x: jnp.ndarray, axis: int) -> jnp.ndarray:

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
 from .bits import U32, pack_bool
-from .permgather import permutation_gather
+from .permgather import edge_sort_key, permutation_gather
 from .score_ops import apply_prune_penalty, compute_scores
 
 
@@ -43,7 +43,8 @@ def _symmetric_value(state: SimState, x: jnp.ndarray,
     n, k = state.neighbors.shape
     nbr = jnp.clip(state.neighbors, 0, n - 1)
     rk = jnp.clip(state.reverse_slot, 0, k - 1)
-    x_rev = permutation_gather(x, nbr, rk, mode)
+    sk = edge_sort_key(state.neighbors, state.reverse_slot, k_major=False)
+    x_rev = permutation_gather(x, nbr, rk, mode, sort_key=sk)
     mine_wins = jnp.arange(n)[:, None] < nbr
     return jnp.where(mine_wins, x, x_rev)
 
@@ -61,7 +62,8 @@ def _symmetric_bools(state: SimState, bits: list,
     payload = jnp.zeros((n, k), U32)
     for i, b in enumerate(bits):
         payload = payload | jnp.where(b, U32(1) << U32(i), U32(0))
-    g = permutation_gather(payload, nbr, rk, mode)
+    sk = edge_sort_key(state.neighbors, state.reverse_slot, k_major=False)
+    g = permutation_gather(payload, nbr, rk, mode, sort_key=sk)
     mine_wins = jnp.arange(n)[:, None] < nbr
     return [jnp.where(mine_wins, b, ((g >> U32(i)) & U32(1)).astype(bool))
             for i, b in enumerate(bits)]

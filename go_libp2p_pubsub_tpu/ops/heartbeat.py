@@ -64,12 +64,16 @@ def edge_gather_packed(masks: list, state: SimState,
     of one [N,T,K] advanced-index gather per mask. The permutation gather is
     the expensive op on TPU; packing divides its index count by T-per-mask
     and amortizes it across masks, while the pack/unpack shifts are cheap
-    VPU passes. ``mode`` picks the formulation: ``pallas`` (TPU auto) packs
-    all B planes x K slots into a [N, ceil(B*K/32)] u32 bit-table pinned in
-    VMEM (PERF_MODEL.md S2 — no [N,K,K] temporary at any N); the others
-    build per-32-plane [N, K] u32 payloads routed through
+    VPU passes. ``mode`` picks the formulation: ``sort`` (TPU auto) routes
+    every 32-plane payload group through ONE variadic sort-permute over
+    the edge involution (permgather.edge_sort_key — fastest measured on
+    real TPU); ``pallas`` packs all B planes x K slots into a
+    [N, ceil(B*K/32)] u32 bit-table pinned in VMEM (PERF_MODEL.md S2 —
+    blocked from auto by the Mosaic gather wall); the others build
+    per-32-plane [N, K] u32 payloads routed through
     ops/permgather.permutation_gather."""
-    from .permgather import _edge_table_pallas, resolve_edge_packed_mode
+    from .permgather import (
+        _edge_table_pallas, edge_sort_key, resolve_edge_packed_mode)
 
     n, t, k = masks[0].shape
     planes = jnp.concatenate(masks, axis=1)                    # [N, B, K]
@@ -78,6 +82,8 @@ def edge_gather_packed(masks: list, state: SimState,
     rk = jnp.clip(state.reverse_slot, 0, k - 1)
     valid = ((state.neighbors >= 0) & (state.reverse_slot >= 0))[:, None, :]
     mode = resolve_edge_packed_mode(mode, n, k, b)
+    sk = edge_sort_key(state.neighbors, state.reverse_slot, k_major=False) \
+        if mode == "sort" else None
     if mode == "pallas":
         from functools import partial
 
@@ -96,13 +102,21 @@ def edge_gather_packed(masks: list, state: SimState,
         else:
             groups = fn(table, jn, rk)
     else:
-        groups = []
+        payloads = []
         for w0 in range(0, b, 32):
             bits = planes[:, w0:w0 + 32, :]
             nb = bits.shape[1]
             sh = (U32(1) << jnp.arange(nb, dtype=U32))[None, :, None]
-            payload = jnp.sum(bits.astype(U32) * sh, axis=1, dtype=U32)
-            groups.append(permutation_gather(payload, jn, rk, mode))
+            payloads.append(jnp.sum(bits.astype(U32) * sh, axis=1, dtype=U32))
+        if mode == "sort":
+            # ONE variadic sort routes every 32-plane group: the keys are
+            # identical across groups, so sorting once moves all payloads
+            # for a single O(NK log NK) comparator pass
+            outs = jax.lax.sort(
+                (sk, *[p.reshape(-1) for p in payloads]), num_keys=1)
+            groups = [o.reshape(n, k) for o in outs[1:]]
+        else:
+            groups = [permutation_gather(p, jn, rk, mode) for p in payloads]
     parts = []
     for w0, g in zip(range(0, b, 32), groups):
         nb = min(32, b - w0)

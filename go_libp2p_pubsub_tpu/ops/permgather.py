@@ -72,6 +72,48 @@ def _mosaic_take(tab, idx):
     return g[:, :length] if g.shape[1] != length else g
 
 
+def edge_sort_key(neighbors: jnp.ndarray, reverse_slot: jnp.ndarray,
+                  k_major: bool) -> jnp.ndarray:
+    """Destination key per SOURCE edge slot for the sort-permute gather.
+
+    The (sender, slot) -> (receiver, reverse_slot) map is an involution of
+    the N*K directed edge slots (reverse of reverse = self), so routing
+    per-slot values to their receivers is applying a PERMUTATION — and on
+    this chip `lax.sort` moves payload bytes ~4x faster than any gather
+    formulation (live-window measurement: 9.0 ms vs 24.7 ms for the hop
+    words-gather at 100k; XLA gathers pay ~7 ns per index regardless of
+    form). Sorting by the destination slot index IS the permutation apply.
+
+    Invalid slots (no neighbor) keep their own index — identity-mapped, so
+    the keys stay a bijection (valid slots map valid<->valid under the
+    involution; the two sets are disjoint) and the sort never sees
+    duplicate keys, which would misalign everything after them. Values
+    landing at invalid destinations are garbage the callers already mask.
+
+    ``k_major``: True -> destination flat order k*N+n (for [W, K, N]
+    packed-word outputs); False -> n*K+k (for [N, K] payload outputs).
+    """
+    n, k = neighbors.shape
+    valid = (neighbors >= 0) & (reverse_slot >= 0)
+    jn = jnp.clip(neighbors, 0, n - 1)
+    rk = jnp.clip(reverse_slot, 0, k - 1)
+    if k_major:
+        dest = rk * n + jn
+        own = jnp.arange(k)[None, :] * n + jnp.arange(n)[:, None]
+    else:
+        dest = jn * k + rk
+        own = jnp.arange(n)[:, None] * k + jnp.arange(k)[None, :]
+    return jnp.where(valid, dest, own).reshape(-1)
+
+
+def _gather_sort(payload, sort_key):
+    """out_flat[dest] = payload_flat[src] via one variadic sort: n-major
+    destination keys -> [N, K] output."""
+    n, k = payload.shape
+    _, out = jax.lax.sort((sort_key, payload.reshape(-1)), num_keys=1)
+    return out.reshape(n, k)
+
+
 def _gather_scalar(payload, jn, rk):
     return payload[jn, rk]
 
@@ -238,11 +280,11 @@ def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
     per-group gather. Ineligible shapes degrade pallas -> rows."""
     backend = jax.default_backend()
     if mode == "auto":
-        # TPU auto is the packed-u32 advanced-index form: the live-window
-        # microbench measured it fastest of the compilable forms at 100k
-        # (39.9 ms vs rows 55.0), and Mosaic cannot lower the bit-table
-        # kernel's >128-wide VMEM gather (see hopkernel.resolve_hop_mode)
-        mode = {"cpu": "scalar", "tpu": "scalar"}.get(backend, "rows")
+        # TPU auto is the sort-permute apply (edge_sort_key docstring:
+        # ~5-7 ms vs 34 ms scalar per exchange at 100k, honest-methodology
+        # live-window numbers); Mosaic cannot lower the bit-table kernel's
+        # >128-wide VMEM gather (see hopkernel.resolve_hop_mode)
+        mode = {"cpu": "scalar", "tpu": "sort"}.get(backend, "rows")
     if mode == "pallas":
         # table feasibility is GLOBAL n (the whole bit-table pins in VMEM);
         # block feasibility is the per-shard row count under a kernel mesh
@@ -254,24 +296,30 @@ def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
 
 
 def resolve_words_mode(mode: str, w: int, n: int, k: int,
-                       itemsize: int = 4) -> str:
+                       itemsize: int = 4,
+                       have_sort_key: bool = False) -> str:
     """Resolve the message-table gather mode (bits.gather_words_rows).
 
-    TPU ``auto`` is ``pallas``: the packed [W, N] table is 0.8 MB at 100k
-    peers — VMEM-resident at every shape this engine targets — while the
-    ``rows`` form materializes a [N, K, M] bool temporary (205 MB at 100k)
-    twice per call; PERF_MODEL.md prices the difference at ~3.6 GB/tick of
-    the headline config's 14 GB. Ineligible shapes still fall back to
-    ``rows``, and scripts/tpu_recheck.sh sweeps all three head-to-head.
+    TPU ``auto`` is ``sort`` when the caller passes the edge keys (the
+    sort-permute apply, edge_sort_key docstring; 9.0 vs 24.7 ms for the
+    100k hop gather on the live window), else ``rows``. ``pallas`` (the
+    VMEM table kernel PERF_MODEL.md S1 designed) is blocked from auto by
+    the Mosaic >128-wide gather wall and stays explicit-only;
+    scripts/ablate.py sweeps all formulations head-to-head.
     """
     backend = jax.default_backend()
     if mode == "auto":
-        # TPU auto reverts to rows (vector-DMA row slices): the Mosaic
-        # gather wall blocks the VMEM-table kernel (resolve_hop_mode), and
-        # rows beat scalar 2.5x for the M-wide window rows in round-2
-        # on-chip ablations (wide rows amortize per-index overhead in a
-        # way the 4-byte edge-payload rows do not)
-        mode = {"cpu": "scalar", "tpu": "rows"}.get(backend, "rows")
+        # TPU auto is the sort-permute form when the caller supplies the
+        # edge keys (9.0 ms vs rows 24.7 ms for the hop gather at 100k,
+        # live-window honest-methodology measurement), else rows (which
+        # beat scalar 2.5x for M-wide window rows). The Mosaic gather
+        # wall blocks the VMEM-table kernel (resolve_hop_mode).
+        if backend == "tpu":
+            mode = "sort" if have_sort_key else "rows"
+        else:
+            mode = "scalar"
+    if mode == "sort" and not have_sort_key:
+        return "rows"
     if mode == "pallas":
         if (w * n * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 2 * w * k * itemsize) is None):
@@ -280,20 +328,29 @@ def resolve_words_mode(mode: str, w: int, n: int, k: int,
 
 
 def gather_words(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
-                 mode: str = "auto") -> jnp.ndarray:
+                 mode: str = "auto",
+                 sort_key: jnp.ndarray | None = None) -> jnp.ndarray:
     """out[w, k, n] = x_w[w, nbr[n, k]] — the per-hop neighbor gather of the
     packed message window. ``nbr`` must be pre-clipped to [0, N).
 
     scalar: per-word advanced-index gather (CPU fast path). rows: unpack to
-    [N, M] bool, row-gather, repack — the vector-DMA formulation measured
-    2.5x+ faster on the chip (round-2 notes). pallas: VMEM-resident table
-    gather, no unpacked temporary at all.
+    [N, M] bool, row-gather, repack. sort: broadcast each sender's words
+    along its K slots and sort-permute them to the receivers (k-major
+    ``edge_sort_key``) — the fastest formulation measured on real TPU
+    (edge_sort_key docstring). pallas: VMEM-resident table gather, blocked
+    by the Mosaic gather wall on current chips.
     """
     from .bits import pack_bool, unpack_words
 
     w, n = x_w.shape
     k = nbr.shape[1]
-    mode = resolve_words_mode(mode, w, n, k, x_w.dtype.itemsize)
+    mode = resolve_words_mode(mode, w, n, k, x_w.dtype.itemsize,
+                              have_sort_key=sort_key is not None)
+    if mode == "sort":
+        vals = jnp.broadcast_to(x_w[:, :, None], (w, n, k)).reshape(w, n * k)
+        outs = jax.lax.sort((sort_key, *[vals[i] for i in range(w)]),
+                            num_keys=1)
+        return jnp.stack([o.reshape(k, n) for o in outs[1:]])
     if mode == "scalar":
         return jnp.stack([x_w[i][nbr.T] for i in range(w)])
     if mode == "rows":
@@ -312,14 +369,21 @@ def gather_words(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
     raise ValueError(f"unknown gather_words mode {mode!r}")
 
 
-def resolve_mode(mode: str, payload_dtype, n: int, k: int) -> str:
+def resolve_mode(mode: str, payload_dtype, n: int, k: int,
+                 have_sort_key: bool = False) -> str:
     """Resolve ``auto``/ineligible requests to a concrete formulation.
 
-    TPU auto is ``scalar``: the live-window microbench at 100k measured
-    the direct advanced-index form at 39.9 ms vs 55.0 for rows — the
-    [N,K,K] rows temporary loses once its DMA rows are only K*4 bytes."""
+    TPU auto is ``sort`` (the sort-permute apply, edge_sort_key docstring)
+    when the caller supplies the destination keys, else ``scalar`` — the
+    honest-methodology live-window numbers: sort ~5-7 ms vs scalar
+    advanced-index ~23-34 ms vs rows ~55 ms for a [N,K] u32 exchange at
+    100k (XLA gathers pay ~7 ns/index; sort moves the same bytes 4x
+    faster)."""
+    backend = jax.default_backend()
     if mode == "auto":
-        mode = "scalar"
+        mode = "sort" if (backend == "tpu" and have_sort_key) else "scalar"
+    if mode == "sort" and not have_sort_key:
+        return "scalar"
     if mode == "pallas":
         itemsize = jnp.dtype(payload_dtype).itemsize
         if (itemsize < 4 or n * k * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
@@ -330,14 +394,20 @@ def resolve_mode(mode: str, payload_dtype, n: int, k: int) -> str:
 
 
 def permutation_gather(payload: jnp.ndarray, jn: jnp.ndarray,
-                       rk: jnp.ndarray, mode: str = "auto") -> jnp.ndarray:
+                       rk: jnp.ndarray, mode: str = "auto",
+                       sort_key: jnp.ndarray | None = None) -> jnp.ndarray:
     """out[n, k] = payload[jn[n, k], rk[n, k]].
 
     ``payload`` is [N, K] of any dtype; ``jn``/``rk`` must be pre-clipped to
-    valid range (callers mask invalid slots on the result).
+    valid range (callers mask invalid slots on the result). ``sort_key``
+    (n-major ``edge_sort_key``) enables the sort-permute formulation — the
+    fastest measured on real TPU.
     """
     n, k = payload.shape
-    mode = resolve_mode(mode, payload.dtype, n, k)
+    mode = resolve_mode(mode, payload.dtype, n, k,
+                        have_sort_key=sort_key is not None)
+    if mode == "sort":
+        return _gather_sort(payload, sort_key)
     if mode == "scalar":
         return _gather_scalar(payload, jn, rk)
     if mode == "rows":

@@ -234,6 +234,11 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     k_fwd, k_gate = jax.random.split(key)
     nbr = jnp.clip(state.neighbors, 0, n - 1)                  # [N, K]
     mal = state.malicious
+    # destination keys for the sort-permute gathers (edge_sort_key
+    # docstring): computed once, shared by every gather this tick (XLA
+    # CSEs the duplicates; unused on backends that resolve away from sort)
+    from .permgather import edge_sort_key
+    sk_w = edge_sort_key(state.neighbors, state.reverse_slot, k_major=True)
 
     # --- per-tick packed masks ---
     age_pub = state.tick - state.msg_publish_tick
@@ -334,7 +339,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         asked_k = _slot_bitplanes(state.iwant_pending, k) \
             & alive_bits[:, None, None]
         answers_k = gather_words_rows(answer_bits, nbr, m,
-                                      cfg.edge_gather_mode)             # [W,K,N]
+                                      cfg.edge_gather_mode,
+                                      sort_key=sk_w)                    # [W,K,N]
         # pulled data is still data: graylist + gater admission apply, and pulls
         # are charged against the same per-edge and validation budgets as eager
         # traffic (an IHAVE-flooding adversary must not route unlimited data
@@ -396,13 +402,24 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         # path too). Only hop 0 carries origin messages. Sender-side values
         # (its score of me, its direct flag for me) arrive through the edge
         # permutation.
-        from .permgather import permutation_gather
+        from .permgather import permutation_gather, resolve_mode
         rk = jnp.clip(state.reverse_slot, 0, k - 1)
-        sender_scores_me = permutation_gather(
-            scores, nbr, rk, cfg.edge_gather_mode)                      # [N,K]
-        sender_direct_me = permutation_gather(
-            state.direct.astype(U32), nbr, rk,
-            cfg.edge_gather_mode).astype(bool)                          # [N,K]
+        sk_e = edge_sort_key(state.neighbors, state.reverse_slot,
+                             k_major=False)
+        if resolve_mode(cfg.edge_gather_mode, jnp.float32, n, k,
+                        have_sort_key=True) == "sort":
+            # both sender-side planes share one variadic sort
+            _, ss, sd = jax.lax.sort(
+                (sk_e, scores.reshape(-1),
+                 state.direct.astype(U32).reshape(-1)), num_keys=1)
+            sender_scores_me = ss.reshape(n, k)                         # [N,K]
+            sender_direct_me = sd.reshape(n, k).astype(bool)            # [N,K]
+        else:
+            sender_scores_me = permutation_gather(
+                scores, nbr, rk, cfg.edge_gather_mode)                  # [N,K]
+            sender_direct_me = permutation_gather(
+                state.direct.astype(U32), nbr, rk,
+                cfg.edge_gather_mode).astype(bool)                      # [N,K]
         if cfg.scoring_enabled:
             score_gate = sender_direct_me | \
                 (sender_scores_me >= cfg.publish_threshold)
@@ -417,7 +434,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             (state.deliver_tick == state.tick)
             & (state.msg_publish_tick == state.tick)[None, :])
         flood_offer = gather_words_rows(origin_bits, nbr, m,
-                                        cfg.edge_gather_mode) & flood_allowed
+                                        cfg.edge_gather_mode,
+                                        sort_key=sk_w) & flood_allowed
     else:
         flood_offer = None
 
@@ -484,7 +502,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             c["edge_used"], c["arrivals"], c["throttled"], c["validated"]
         is_first = i == 0
         offered = gather_words_rows(frontier, nbr, m,
-                                    cfg.edge_gather_mode) & allowed              # [W,K,N]
+                                    cfg.edge_gather_mode,
+                                    sort_key=sk_w) & allowed                     # [W,K,N]
         if flood_offer is not None:
             offered = offered | jnp.where(is_first, flood_offer, U32(0))
         if cfg.edge_queue_cap > 0:
@@ -658,7 +677,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         return state._replace(iwant_pending=iwant_pending)
     gossip_allowed = _edge_topic_bits(inc_gossip, topic_bits, w)        # [W,K,N]
     offer = gather_words_rows(window_bits, nbr, m,
-                              cfg.edge_gather_mode) & gossip_allowed
+                              cfg.edge_gather_mode,
+                              sort_key=sk_w) & gossip_allowed
     if cfg.max_iwant_per_tick >= m:
         # a sender can offer at most M ids per tick, so the iasked budget
         # cannot bind: pick the lowest offering slot per message

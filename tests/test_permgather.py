@@ -141,10 +141,10 @@ class TestEdgeTableKernel:
         import go_libp2p_pubsub_tpu.ops.permgather as pg
         assert pg.resolve_edge_packed_mode("auto", 1024, 8, 2) == "scalar"
         monkeypatch.setattr(pg.jax, "default_backend", lambda: "tpu")
-        # TPU auto is the packed-u32 advanced-index form (fastest measured
-        # compilable form on the live window; Mosaic blocks the bit-table
-        # kernel's wide gather — hopkernel.resolve_hop_mode docstring)
-        assert pg.resolve_edge_packed_mode("auto", 100_000, 32, 2) == "scalar"
+        # TPU auto is the sort-permute apply (fastest measured formulation
+        # on the live window; Mosaic blocks the bit-table kernel's wide
+        # gather — hopkernel.resolve_hop_mode docstring)
+        assert pg.resolve_edge_packed_mode("auto", 100_000, 32, 2) == "sort"
         # explicit pallas still resolves while VMEM-feasible AND the peer
         # count has a 128-aligned block (102400 yes, 100000/10000 no)
         assert pg.resolve_edge_packed_mode("pallas", 102_400, 32, 2) == "pallas"
@@ -220,3 +220,66 @@ class TestEngineTrajectoryParity:
                 np.testing.assert_array_equal(
                     np.asarray(a), np.asarray(b),
                     err_msg=f"{scenario}/{mode}: state.{field} diverged")
+
+
+class TestSortPermute:
+    """The sort-permute formulation (permgather.edge_sort_key): gathers as
+    one variadic lax.sort over the edge-slot involution — the fastest
+    formulation measured on real TPU (live-window round 4). Invalid slots
+    carry identity-mapped garbage, so op-level parity is checked on valid
+    slots; the engine masks them everywhere, so trajectory parity is
+    bit-exact."""
+
+    def test_permutation_gather_sort_parity(self):
+        from go_libp2p_pubsub_tpu.ops.permgather import (
+            edge_sort_key, permutation_gather)
+        n, k = 256, 8
+        nbr, rks = _random_edge_permutation(n, k, seed=5)
+        valid = (nbr >= 0) & (rks >= 0)
+        jn = jnp.clip(jnp.asarray(nbr), 0, n - 1)
+        rk = jnp.clip(jnp.asarray(rks), 0, k - 1)
+        sk = edge_sort_key(jnp.asarray(nbr), jnp.asarray(rks), k_major=False)
+        payload = jax.random.randint(jax.random.PRNGKey(3), (n, k), 0,
+                                     2**31 - 1, jnp.int32).astype(jnp.uint32)
+        ref = np.asarray(permutation_gather(payload, jn, rk, "scalar"))
+        out = np.asarray(permutation_gather(payload, jn, rk, "sort",
+                                            sort_key=sk))
+        np.testing.assert_array_equal(ref[valid], out[valid])
+
+    def test_words_gather_sort_parity(self):
+        from go_libp2p_pubsub_tpu.ops.bits import gather_words_rows, pack_words
+        from go_libp2p_pubsub_tpu.ops.permgather import edge_sort_key
+        n, k, m = 192, 8, 64
+        nbr, rks = _random_edge_permutation(n, k, seed=6)
+        valid = ((nbr >= 0) & (rks >= 0)).T[None, :, :]        # [1,K,N]
+        nbr_c = jnp.clip(jnp.asarray(nbr), 0, n - 1)
+        sk = edge_sort_key(jnp.asarray(nbr), jnp.asarray(rks), k_major=True)
+        planes = np.asarray(
+            jax.random.uniform(jax.random.PRNGKey(4), (n, m)) < 0.3)
+        x_w = pack_words(jnp.asarray(planes))
+        ref = np.asarray(gather_words_rows(x_w, nbr_c, m, "scalar"))
+        out = np.asarray(gather_words_rows(x_w, nbr_c, m, "sort",
+                                           sort_key=sk))
+        np.testing.assert_array_equal(np.where(valid, ref, 0),
+                                      np.where(valid, out, 0))
+
+    def test_engine_trajectory_sort_equals_scalar(self):
+        import dataclasses
+
+        from go_libp2p_pubsub_tpu.sim import (
+            SimConfig, TopicParams, init_state, topology)
+        from go_libp2p_pubsub_tpu.sim.engine import run
+
+        cfg = SimConfig(n_peers=256, k_slots=16, n_topics=2, msg_window=32,
+                        publishers_per_tick=4, prop_substeps=4,
+                        scoring_enabled=True)
+        tp = TopicParams.disabled(2)
+        st0 = init_state(cfg, topology.sparse(256, 16, degree=6, seed=9))
+        key = jax.random.PRNGKey(11)
+        st_a = run(st0, dataclasses.replace(cfg, edge_gather_mode="scalar"),
+                   tp, key, 6)
+        st_b = run(st0, dataclasses.replace(cfg, edge_gather_mode="sort"),
+                   tp, key, 6)
+        for name, a, b in zip(st_a._fields, st_a, st_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)
