@@ -10,8 +10,8 @@ for step in "microbench_beacon:python scripts/microbench_kernels.py 10000 9 48 6
             "microbench_100k:python scripts/microbench_kernels.py 100000 1 32 64" \
             "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
             "ablate_100k:python scripts/ablate.py 100k_sweep 5" \
+            "modes_sort:env GRAFT_EDGE_GATHER=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_rows:env GRAFT_EDGE_GATHER=rows BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
-            "modes_pallas:env GRAFT_EDGE_GATHER=pallas BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_scalar:env GRAFT_EDGE_GATHER=scalar BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_iter:env GRAFT_SELECTION=iter BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_ranks:env GRAFT_SELECTION=ranks BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
