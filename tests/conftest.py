@@ -54,7 +54,8 @@ _SLOW = {
                                 "test_count_bound_guard_fires"),
     "test_sharding.py": ("test_sharded_step_matches_unsharded",
                          "test_2d_dcn_mesh_matches_unsharded",
-                         "test_sharded_pallas_kernels_match_unsharded"),
+                         "test_sharded_pallas_kernels_match_unsharded",
+                         "test_sharded_sort_mode_matches_unsharded"),
     "test_sim_control.py": ("TestFanout", "TestGraftFloodPenalty"),
     "test_sim_engine.py": ("test_negative_score_peer_gets_pruned",
                            "TestBackoff",

@@ -146,3 +146,29 @@ def test_sharded_pallas_kernels_match_unsharded(eight_devices):
             np.asarray(a), np.asarray(b),
             err_msg=f"field {name} diverged between sharded and unsharded "
                     "pallas dispatch")
+
+
+def test_sharded_sort_mode_matches_unsharded(eight_devices):
+    """The sort-permute gathers (TPU auto's formulation of record) under
+    the peer-sharded pjit step: a global lax.sort over a sharded flat edge
+    axis must still route every payload identically. This is the path a
+    real multi-chip TPU run takes after round 4's auto-mode flip."""
+    import dataclasses
+
+    cfg, tp, st = _build()
+    cfg = dataclasses.replace(cfg, edge_gather_mode="sort")
+    mesh = make_mesh(eight_devices)
+    sharded_step = make_sharded_step(mesh, cfg, tp)
+
+    st_sh = shard_state(st, mesh, cfg)
+    st_un = st
+    key = jax.random.PRNGKey(17)
+    for i in range(4):
+        key, k = jax.random.split(key)
+        st_sh = sharded_step(st_sh, k)
+        st_un = step_jit(st_un, cfg, tp, k)
+
+    for name, a, b in zip(st_un._fields, st_un, st_sh):
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"field {name} diverged under sharded sort mode")
