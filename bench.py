@@ -112,6 +112,13 @@ def run_scenario(name: str) -> None:
         cfg = dataclasses.replace(cfg, selection_mode=sel)
         print(json.dumps({"info": "selection sweep", "requested": sel}),
               flush=True)
+    cdt = os.environ.get("GRAFT_COUNT_DTYPE")
+    if cdt:
+        # hop-count accumulator width sweep (sim/config.py count_dtype)
+        import dataclasses
+        cfg = dataclasses.replace(cfg, count_dtype=cdt)
+        print(json.dumps({"info": "count dtype sweep", "requested": cdt}),
+              flush=True)
     bench_one(_label(name), cfg, tp, st, ticks)
 
 

@@ -84,7 +84,8 @@ def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
     if mode == "pallas":
         if (cfg.gater_enabled or cfg.record_provenance
                 or cfg.edge_queue_cap > 0 or cfg.validation_queue_cap > 0
-                or (cfg.flood_publish and cfg.router == "gossipsub")):
+                or (cfg.flood_publish and cfg.router == "gossipsub")
+                or cfg.count_dtype != "uint8"):
             return "xla"
         # table feasibility is GLOBAL n; block feasibility is the
         # per-shard row count under a kernel mesh

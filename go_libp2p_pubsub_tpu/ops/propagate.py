@@ -279,25 +279,34 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     else:
         data_ok = accept_ok
 
-    # Delivery-event accumulators are per-topic uint8 COUNTS, not [W,K,N]
-    # bit sets (PERF_MODEL.md S3): frontier semantics make each
+    # Delivery-event accumulators are per-topic COUNTS, not [W,K,N] bit
+    # sets (PERF_MODEL.md S3): frontier semantics make each
     # (receiver, sender-slot, message) event occur in at most one hop, so
     # per-hop popcounts summed across hops equal the popcount of the OR'd
-    # sets — at 1/8th the accumulator width. uint8 is safe because events
-    # per (topic, slot, receiver) per tick are bounded by the message
-    # window (every event consumes a distinct message bit).
-    if m > 255:       # not assert: -O must not strip the overflow guard
+    # sets. ``cfg.count_dtype`` picks the width: uint8 minimizes HBM
+    # bytes (safe: events per (topic, slot, receiver) per tick are
+    # bounded by the message window); int32 trades bytes for native
+    # vector lanes (config.py note).
+    if cfg.count_dtype not in ("uint8", "int32"):
         raise ValueError(
-            f"msg_window={m} > 255 would wrap the uint8 hop-count "
-            "accumulators; shrink the window or widen the counts")
+            f"count_dtype={cfg.count_dtype!r}: only 'uint8' and 'int32' "
+            "are supported (numpy shorthands like 'u8' parse as OTHER "
+            "widths and would silently defeat the knob)")
+    cdt = jnp.dtype(cfg.count_dtype)
+    if m > jnp.iinfo(cdt).max:
+        # not assert: -O must not strip the overflow guard
+        raise ValueError(
+            f"msg_window={m} > {jnp.iinfo(cdt).max} would wrap the "
+            f"{cfg.count_dtype} hop-count accumulators; shrink the window "
+            "or widen count_dtype")
 
     def topic_counts(events_wkn):
-        """[W,K,N] packed event bits -> [T,K,N] per-topic uint8 counts.
-        (jnp.sum promotes uint8 accumulation to uint32, so cast back.)"""
+        """[W,K,N] packed event bits -> [T,K,N] per-topic counts.
+        (jnp.sum promotes sub-word accumulation to uint32, so cast back.)"""
         return jnp.stack([
             popcount_sum(events_wkn & topic_bits[ti][:, None, None],
-                         axis=0, dtype=jnp.uint8)
-            for ti in range(t)]).astype(jnp.uint8)
+                         axis=0, dtype=cdt)
+            for ti in range(t)]).astype(cdt)
 
     # -- step 1: resolve pending IWANTs from last tick (gossipsub.go:698-739:
     # the sender answers from its mcache; delivery counts as a first delivery
@@ -463,7 +472,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         "nv": seed_nv if seed_nv is not None else topic_counts(got_valid),
         "ni": seed_ni if seed_ni is not None
         else topic_counts(got_k & inv_n[:, None, :]),
-        "dup": jnp.zeros((t, k, n), jnp.uint8),  # mesh-duplicate counts
+        "dup": jnp.zeros((t, k, n), cdt),        # mesh-duplicate counts
         "edge_used": edge_used,
         "arrivals": arrivals,
         "throttled": throttled,
@@ -472,9 +481,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     if cfg.gater_enabled:
         # gater-only stats compile only when the gater can consume them
         carry0["ig"] = popcount_sum(got_k & ign_n[:, None, :], axis=0,
-                                    dtype=jnp.uint8
-                                    ).astype(jnp.uint8)  # ignore counts [K,N]
-        carry0["gdup"] = jnp.zeros((k, n), jnp.uint8)    # any-duplicate [K,N]
+                                    dtype=cdt).astype(cdt)  # ignore [K,N]
+        carry0["gdup"] = jnp.zeros((k, n), cdt)          # any-duplicate [K,N]
     if cfg.record_provenance:
         # trace export needs the winning sender slot per first delivery —
         # the one consumer that still wants per-slot bit sets
@@ -549,7 +557,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         if cfg.gater_enabled:
             out["ig"] = c["ig"] + popcount_sum(
                 new_from_k & ign_n[:, None, :], axis=0,
-                dtype=jnp.uint8).astype(jnp.uint8)
+                dtype=cdt).astype(cdt)
             # gater duplicate stat: any offer of a message already seen OR
             # won by another slot this same hop (pubsub.go:1145-1148
             # seen-cache hit -> DuplicateMessage; same-hop losers hit the
@@ -558,7 +566,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             # duplicates — new_any is post-throttle.
             out["gdup"] = c["gdup"] + popcount_sum(
                 offered & ~new_from_k & (have_bits | new_any)[:, None, :],
-                axis=0, dtype=jnp.uint8).astype(jnp.uint8)
+                axis=0, dtype=cdt).astype(cdt)
         if cfg.record_provenance:
             out["nv_acc"] = c["nv_acc"] | nv_ev
         out["i"] = i + 1
@@ -585,7 +593,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     arrivals, throttled, validated = \
         carry["arrivals"], carry["throttled"], carry["validated"]
 
-    # [T,K,N] uint8 counts -> [N,T,K] f32 counter increments
+    # [T,K,N] counts -> [N,T,K] f32 counter increments
     fmd_add = jnp.transpose(carry["nv"], (2, 0, 1)).astype(jnp.float32)
     imd_add = jnp.transpose(carry["ni"], (2, 0, 1)).astype(jnp.float32)
     mmd_add = jnp.transpose(carry["dup"], (2, 0, 1)).astype(jnp.float32)

@@ -99,9 +99,18 @@ class SimConfig:
     selection_mode: str = "auto"
 
     # forwarding-hop formulation (ops/hopkernel.py): "auto" | "xla" |
-    # "pallas" — the fused Pallas hop (TPU auto) needs cap-free/gater-free/
+    # "pallas" — the fused Pallas hop needs cap-free/gater-free/
     # provenance-free configs and falls back to the XLA hop otherwise
+    # (auto is xla everywhere: the Mosaic gather wall, resolve_hop_mode)
     hop_mode: str = "auto"
+
+    # dtype of the per-hop delivery-event count accumulators
+    # (ops/propagate.py, PERF_MODEL.md S3): "uint8" minimizes HBM bytes;
+    # "int32" trades 4x bytes for native 32-bit vector ops — TPU emulates
+    # sub-word lanes with masking, a live-window ablation candidate for
+    # the ~16 ms/hop of non-gather math. Trajectories are bit-identical
+    # either way (counts are bounded by msg_window and land in f32).
+    count_dtype: str = "uint8"
 
     # record delivery provenance (msg_publisher / deliver_from) so a run can
     # be exported as a pb/trace event stream (sim/trace_export.py); when on

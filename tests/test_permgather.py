@@ -283,3 +283,28 @@ class TestSortPermute:
         for name, a, b in zip(st_a._fields, st_a, st_b):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
                                           err_msg=name)
+
+
+def test_count_dtype_trajectory_parity():
+    """count_dtype=int32 (the native-lane ablation of the uint8 S3
+    accumulators, sim/config.py) must leave trajectories bit-identical:
+    counts are bounded by msg_window and land in f32 counters either way.
+    Gater on so the ig/gdup accumulators are exercised too."""
+    import dataclasses
+
+    from go_libp2p_pubsub_tpu.sim import (
+        SimConfig, TopicParams, init_state, topology)
+    from go_libp2p_pubsub_tpu.sim.engine import run
+
+    cfg = SimConfig(n_peers=192, k_slots=16, n_topics=2, msg_window=32,
+                    publishers_per_tick=4, prop_substeps=4,
+                    scoring_enabled=True, gater_enabled=True)
+    tp = TopicParams.disabled(2)
+    st0 = init_state(cfg, topology.sparse(192, 16, degree=6, seed=13))
+    key = jax.random.PRNGKey(5)
+    st_a = run(st0, cfg, tp, key, 6)
+    st_b = run(st0, dataclasses.replace(cfg, count_dtype="int32"), tp,
+               key, 6)
+    for name, a, b in zip(st_a._fields, st_a, st_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
