@@ -79,8 +79,13 @@ def run_scenario(name: str) -> None:
 
     def headline():
         from __graft_entry__ import _build
-        return _build(n_peers=n, k_slots=32, degree=12, msg_window=64,
-                      publishers=8)
+        # BENCH_K right-sizes the slot capacity: the degree-12 underlay
+        # needs k > Dhi=12 headroom, and every edge-slot op (sorts,
+        # selections, accumulators) scales with N*K — k=16 is the same
+        # simulated network at 2x less padding than the historical k=32
+        return _build(n_peers=n,
+                      k_slots=int(os.environ.get("BENCH_K", 32)),
+                      degree=12, msg_window=64, publishers=8)
 
     builders = {
         "1k_single_topic": scenarios.single_topic_1k,

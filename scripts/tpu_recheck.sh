@@ -17,6 +17,7 @@ for step in "microbench_beacon:python scripts/microbench_kernels.py 10000 9 48 6
             "sel_ranks:env GRAFT_SELECTION=ranks BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_sort:env GRAFT_SELECTION=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "acc_i32:env GRAFT_COUNT_DTYPE=int32 BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "headline_k16:env BENCH_K=16 BENCH_SCENARIOS=headline python bench.py" \
             "bench:python bench.py"; do
   name="${step%%:*}"; cmd="${step#*:}"
   echo "== $name: $cmd =="
