@@ -1,24 +1,28 @@
 #!/bin/bash
-# One-shot TPU re-measurement after the kernel rebuild: per-phase ablations
-# at the two scales that exposed the scalar-gather pathology, then the full
-# benchmark suite. Each step logs independently so a tunnel wedge mid-way
-# loses only the remaining steps.
+# One-shot TPU re-measurement: ordered so a SHORT live window still banks
+# the most important artifacts — the full benchmark suite FIRST (the
+# round's headline evidence), then the perf-knob sweeps (sort/count-dtype/
+# slot-width/selection), then the diagnostics (ablations, microbenches,
+# Pallas lowering smoke). Each step logs independently so a tunnel wedge
+# mid-way loses only the remaining steps.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/tpu_recheck
-for step in "microbench_beacon:python scripts/microbench_kernels.py 10000 9 48 64" \
-            "microbench_100k:python scripts/microbench_kernels.py 100000 1 32 64" \
-            "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
-            "ablate_100k:python scripts/ablate.py 100k_sweep 5" \
+for step in "bench:python bench.py" \
             "modes_sort:env GRAFT_EDGE_GATHER=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "acc_i32:env GRAFT_COUNT_DTYPE=int32 BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "headline_k16:env BENCH_K=16 BENCH_SCENARIOS=headline python bench.py" \
+            "headline_k16_i32:env BENCH_K=16 GRAFT_COUNT_DTYPE=int32 BENCH_SCENARIOS=headline python bench.py" \
             "modes_rows:env GRAFT_EDGE_GATHER=rows BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_scalar:env GRAFT_EDGE_GATHER=scalar BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_iter:env GRAFT_SELECTION=iter BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_ranks:env GRAFT_SELECTION=ranks BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_sort:env GRAFT_SELECTION=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
-            "acc_i32:env GRAFT_COUNT_DTYPE=int32 BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
-            "headline_k16:env BENCH_K=16 BENCH_SCENARIOS=headline python bench.py" \
-            "bench:python bench.py"; do
+            "ablate_100k:python scripts/ablate.py headline_100000 10" \
+            "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
+            "pallas_smoke:python scripts/tpu_kernel_smoke.py" \
+            "microbench_beacon:python scripts/microbench_kernels.py 10000 9 48 64" \
+            "microbench_100k:python scripts/microbench_kernels.py 100000 1 32 64"; do
   name="${step%%:*}"; cmd="${step#*:}"
   echo "== $name: $cmd =="
   timeout 1500 $cmd 2>&1 | grep -v WARNING | tee "/tmp/tpu_recheck/$name.log"
