@@ -72,6 +72,7 @@ def edge_gather_packed(masks: list, state: SimState,
     blocked from auto by the Mosaic gather wall); the others build
     per-32-plane [N, K] u32 payloads routed through
     ops/permgather.permutation_gather."""
+    from ..parallel.kernel_context import current_kernel_mesh
     from .permgather import (
         _edge_table_pallas, edge_sort_key, resolve_edge_packed_mode)
 
@@ -108,7 +109,13 @@ def edge_gather_packed(masks: list, state: SimState,
             nb = bits.shape[1]
             sh = (U32(1) << jnp.arange(nb, dtype=U32))[None, :, None]
             payloads.append(jnp.sum(bits.astype(U32) * sh, axis=1, dtype=U32))
-        if mode == "sort":
+        ctx = current_kernel_mesh() if mode == "sort" else None
+        if mode == "sort" and ctx is not None and ctx.route == "halo":
+            # sharded: every group rides one per-shard halo route
+            from ..parallel.halo import route_payloads_halo
+            groups = route_payloads_halo(payloads, state.neighbors,
+                                         state.reverse_slot)
+        elif mode == "sort":
             # ONE variadic sort routes every 32-plane group: the keys are
             # identical across groups, so sorting once moves all payloads
             # for a single O(NK log NK) comparator pass

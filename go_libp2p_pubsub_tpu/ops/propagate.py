@@ -237,8 +237,23 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # destination keys for the sort-permute gathers (edge_sort_key
     # docstring): computed once, shared by every gather this tick (XLA
     # CSEs the duplicates; unused on backends that resolve away from sort)
-    from .permgather import edge_sort_key
+    from ..parallel.kernel_context import current_kernel_mesh
+    from .permgather import edge_sort_key, resolve_words_mode
     sk_w = edge_sort_key(state.neighbors, state.reverse_slot, k_major=True)
+    _ctx = current_kernel_mesh()
+    _halo = (_ctx is not None and _ctx.route == "halo"
+             and resolve_words_mode(cfg.edge_gather_mode, w, n, k,
+                                    have_sort_key=True) == "sort")
+
+    def gw(table):
+        """The per-tick words gather: halo-routed under a sharded step
+        when configured, else the mode-dispatched gather."""
+        if _halo:
+            from ..parallel.halo import route_words_halo
+            return route_words_halo(table, state.neighbors,
+                                    state.reverse_slot)
+        return gather_words_rows(table, nbr, m, cfg.edge_gather_mode,
+                                 sort_key=sk_w)
 
     # --- per-tick packed masks ---
     age_pub = state.tick - state.msg_publish_tick
@@ -347,9 +362,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         seed_nv = seed_ni = None
         asked_k = _slot_bitplanes(state.iwant_pending, k) \
             & alive_bits[:, None, None]
-        answers_k = gather_words_rows(answer_bits, nbr, m,
-                                      cfg.edge_gather_mode,
-                                      sort_key=sk_w)                    # [W,K,N]
+        answers_k = gw(answer_bits)                                     # [W,K,N]
         # pulled data is still data: graylist + gater admission apply, and pulls
         # are charged against the same per-edge and validation budgets as eager
         # traffic (an IHAVE-flooding adversary must not route unlimited data
@@ -415,8 +428,16 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         rk = jnp.clip(state.reverse_slot, 0, k - 1)
         sk_e = edge_sort_key(state.neighbors, state.reverse_slot,
                              k_major=False)
-        if resolve_mode(cfg.edge_gather_mode, jnp.float32, n, k,
-                        have_sort_key=True) == "sort":
+        _sort_e = resolve_mode(cfg.edge_gather_mode, jnp.float32, n, k,
+                               have_sort_key=True) == "sort"
+        if _sort_e and _ctx is not None and _ctx.route == "halo":
+            from ..parallel.halo import route_payloads_halo
+            ss, sd = route_payloads_halo(
+                [scores, state.direct.astype(U32)],
+                state.neighbors, state.reverse_slot)
+            sender_scores_me = ss                                       # [N,K]
+            sender_direct_me = sd.astype(bool)                          # [N,K]
+        elif _sort_e:
             # both sender-side planes share one variadic sort
             _, ss, sd = jax.lax.sort(
                 (sk_e, scores.reshape(-1),
@@ -442,9 +463,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         origin_bits = pack_words(
             (state.deliver_tick == state.tick)
             & (state.msg_publish_tick == state.tick)[None, :])
-        flood_offer = gather_words_rows(origin_bits, nbr, m,
-                                        cfg.edge_gather_mode,
-                                        sort_key=sk_w) & flood_allowed
+        flood_offer = gw(origin_bits) & flood_allowed
     else:
         flood_offer = None
 
@@ -509,9 +528,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         edge_used, arrivals, throttled, validated = \
             c["edge_used"], c["arrivals"], c["throttled"], c["validated"]
         is_first = i == 0
-        offered = gather_words_rows(frontier, nbr, m,
-                                    cfg.edge_gather_mode,
-                                    sort_key=sk_w) & allowed                     # [W,K,N]
+        offered = gw(frontier) & allowed                                # [W,K,N]
         if flood_offer is not None:
             offered = offered | jnp.where(is_first, flood_offer, U32(0))
         if cfg.edge_queue_cap > 0:
@@ -684,9 +701,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             interpret=jax.default_backend() != "tpu")
         return state._replace(iwant_pending=iwant_pending)
     gossip_allowed = _edge_topic_bits(inc_gossip, topic_bits, w)        # [W,K,N]
-    offer = gather_words_rows(window_bits, nbr, m,
-                              cfg.edge_gather_mode,
-                              sort_key=sk_w) & gossip_allowed
+    offer = gw(window_bits) & gossip_allowed
     if cfg.max_iwant_per_tick >= m:
         # a sender can offer at most M ids per tick, so the iasked budget
         # cannot bind: pick the lowest offering slot per message

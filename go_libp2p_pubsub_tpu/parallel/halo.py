@@ -1,0 +1,167 @@
+"""Distributed sort-permute: per-shard routing with an all-to-all halo.
+
+The engine's edge routing (ops/permgather ``sort`` mode) applies the
+edge-slot involution as one global ``lax.sort``. Under the peer-sharded
+step that global sort lowers to all-gathers plus a REPLICATED sort on
+every device — correct (tests pin it bit-exact) but the sort itself does
+not scale with devices. This module is the scaling formulation, the
+TPU-native analogue of the reference's per-connection stream fan-out
+scaled across hosts (comm.go:44-191, SURVEY.md §2.3/§5.7): each device
+routes only its own edge slots and exchanges cross-shard values with ONE
+``all_to_all``:
+
+    1. locally sort each VALID source slot by (destination device,
+       destination slot) — cross-device traffic becomes contiguous
+       buckets; invalid slots never enter the exchange (their value is
+       the local identity, merged back in step 3 — routing them would
+       concentrate on the diagonal bucket and blow its capacity);
+    2. pad each bucket to a static capacity and ``all_to_all`` them
+       (the MoE capacity-factor pattern: random underlays spread valid
+       edges ~uniformly over device pairs, so capacity 4x the mean
+       covers the tails; a bucket overflow POISONS the routed keys so
+       trajectory tests fail loudly instead of silently dropping edges);
+    3. locally sort received pairs together with the local
+       invalid-slot identities — ascending global destination key
+       restricted to one shard IS the shard's flat order in both
+       layouts.
+
+Wall-clock: two local sorts of ~L/D + one all_to_all of ~4L/D² per
+device pair, vs one replicated global sort of L. Enabled by
+``SimConfig.sharded_route="halo"`` under an active kernel mesh; the
+default ("replicated") keeps the global sort. Bit-exact vs the
+unsharded trajectory either way (tests/test_sharding.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .kernel_context import PEER, current_kernel_mesh, peer_shards, shard_kernel
+
+# capacity factor for the per-(src,dst) device buckets: random underlays
+# put ~(valid L)/D^2 slots in each bucket; 4x the mean covers the tails
+# at the shapes the engine targets. Overflow poisons, never drops.
+_CAPACITY_FACTOR = 4
+
+_BIG = jnp.int32(2_147_483_647)
+
+
+def _route_local(keys, dest_dev, valid, vals, ld, n_dev, axis_name):
+    """keys [Ld]: global destination key per local source slot (valid
+    slots: the involution target; invalid: the slot's own global index —
+    both bijective, disjoint). vals: list of [Ld] payloads. Returns the
+    payloads in local destination-flat order."""
+    cap = min(ld, _CAPACITY_FACTOR * (-(-ld // n_dev)))
+    dd_ext = jnp.where(valid, dest_dev, n_dev)              # invalid -> tail
+    srt = jax.lax.sort((dd_ext, keys, *vals), num_keys=2)
+    dd_s, keys_s = srt[0], srt[1]
+    vals_s = list(srt[2:])
+    counts = jnp.bincount(dd_s, length=n_dev)               # valid only
+    offsets = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                               jnp.cumsum(counts)[:-1]])
+    idx = offsets[:, None] + jnp.arange(cap)[None, :]       # [D, CAP]
+    in_bucket = jnp.arange(cap)[None, :] < counts[:, None]
+    overflow = jnp.any(counts > cap)
+    idx_c = jnp.clip(idx, 0, ld - 1)
+    send_keys = jnp.where(in_bucket & ~overflow,
+                          jnp.take(keys_s, idx_c.reshape(-1)
+                                   ).reshape(n_dev, cap), -1)
+    send_vals = [jnp.where(in_bucket,
+                           jnp.take(v, idx_c.reshape(-1)
+                                    ).reshape(n_dev, cap), 0)
+                 for v in vals_s]
+    recv_keys = jax.lax.all_to_all(send_keys, axis_name, 0, 0)
+    # payloads of one dtype stack into a single exchange (mixed-dtype
+    # callers, e.g. the flood scores+direct pair, get one per dtype)
+    by_dtype: dict = {}
+    for i, v in enumerate(send_vals):
+        by_dtype.setdefault(v.dtype, []).append(i)
+    recv_vals = [None] * len(send_vals)
+    for dt, idxs in by_dtype.items():
+        stacked = jnp.stack([send_vals[i] for i in idxs])    # [P, D, CAP]
+        r = jax.lax.all_to_all(stacked, axis_name, 1, 1)
+        for j, i in enumerate(idxs):
+            recv_vals[i] = r[j]
+    # merge: received valid-routed pairs + the local invalid identities
+    # (key BIG for everything that must not land: padding and local
+    # valid slots, which arrived via the exchange already)
+    mk = jnp.where(recv_keys.reshape(-1) < 0, _BIG, recv_keys.reshape(-1))
+    lk = jnp.where(valid, _BIG, keys)
+    all_keys = jnp.concatenate([mk, lk])
+    out = jax.lax.sort(
+        (all_keys, *[jnp.concatenate([rv.reshape(-1), v])
+                     for rv, v in zip(recv_vals, vals)]), num_keys=1)
+    return [o[:ld] for o in out[1:]]
+
+
+def _axis_tuple():
+    axes = current_kernel_mesh().peer_axes
+    return axes if len(axes) > 1 else axes[0]
+
+
+def route_words_halo(x_w, neighbors, reverse_slot):
+    """Sharded words gather: out[w, k, n] = x_w[w, neighbors[n, k]] via the
+    per-shard halo route (k-major destination layout). Inputs are the
+    GLOBAL arrays; shard_map applies the sharding."""
+    assert current_kernel_mesh() is not None
+    w, n = x_w.shape
+    k = neighbors.shape[1]
+    n_dev = peer_shards()
+    nl = n // n_dev
+    axis = _axis_tuple()
+
+    def body(x_l, nbr_l, rks_l):
+        d = jax.lax.axis_index(axis)
+        n0 = d * nl
+        valid = ((nbr_l >= 0) & (rks_l >= 0)).reshape(-1)
+        jn = jnp.clip(nbr_l, 0, n - 1)
+        rk = jnp.clip(rks_l, 0, k - 1)
+        own = (jnp.arange(k)[None, :] * n
+               + (n0 + jnp.arange(nl))[:, None])            # k-major self
+        keys = jnp.where(valid.reshape(nl, k), rk * n + jn, own).reshape(-1)
+        dest = (keys % n) // nl
+        vals = [jnp.broadcast_to(x_l[i][:, None], (nl, k)).reshape(-1)
+                for i in range(w)]
+        outs = _route_local(keys, dest, valid, vals, nl * k, n_dev, axis)
+        return jnp.stack([o.reshape(k, nl) for o in outs])
+
+    return shard_kernel(
+        body,
+        in_specs=[(None, PEER), (PEER, None), (PEER, None)],
+        out_specs=[(None, None, PEER)],
+    )(x_w, neighbors, reverse_slot)
+
+
+def route_payloads_halo(payloads, neighbors, reverse_slot):
+    """Sharded packed-edge exchange: out[n, k] = payload[jn[n,k], rk[n,k]]
+    for each [N, K] payload plane (n-major destination layout), all planes
+    riding one halo."""
+    assert current_kernel_mesh() is not None
+    n, k = neighbors.shape
+    n_dev = peer_shards()
+    nl = n // n_dev
+    axis = _axis_tuple()
+    n_pl = len(payloads)
+
+    def body(nbr_l, rks_l, *pl_l):
+        d = jax.lax.axis_index(axis)
+        n0 = d * nl
+        valid = ((nbr_l >= 0) & (rks_l >= 0)).reshape(-1)
+        jn = jnp.clip(nbr_l, 0, n - 1)
+        rk = jnp.clip(rks_l, 0, k - 1)
+        own = ((n0 + jnp.arange(nl))[:, None] * k
+               + jnp.arange(k)[None, :])                    # n-major self
+        keys = jnp.where(valid.reshape(nl, k), jn * k + rk, own).reshape(-1)
+        dest = (keys // k) // nl
+        vals = [p.reshape(-1) for p in pl_l]
+        outs = _route_local(keys, dest, valid, vals, nl * k, n_dev, axis)
+        out = tuple(o.reshape(nl, k) for o in outs)
+        return out if n_pl > 1 else out[0]
+
+    res = shard_kernel(
+        body,
+        in_specs=[(PEER, None), (PEER, None)] + [(PEER, None)] * n_pl,
+        out_specs=[(PEER, None)] * n_pl,
+    )(neighbors, reverse_slot, *payloads)
+    return list(res) if n_pl > 1 else [res]

@@ -35,17 +35,20 @@ PEER = "__peer_axes__"          # spec placeholder for the sharded peer axis
 class KernelMesh(NamedTuple):
     mesh: Mesh
     peer_axes: tuple            # mesh axis name(s) the peer dim shards over
+    route: str = "replicated"   # sort-mode routing: "replicated" global
+                                # sort | "halo" per-shard all_to_all
+                                # (parallel/halo.py)
 
 
 _current: KernelMesh | None = None
 
 
 @contextmanager
-def kernel_mesh(mesh: Mesh, peer_axes):
+def kernel_mesh(mesh: Mesh, peer_axes, route: str = "replicated"):
     """Activate shard_map kernel dispatch for code traced inside."""
     global _current
     prev = _current
-    _current = KernelMesh(mesh, tuple(peer_axes))
+    _current = KernelMesh(mesh, tuple(peer_axes), route)
     try:
         yield
     finally:

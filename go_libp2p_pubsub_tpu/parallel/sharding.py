@@ -109,14 +109,45 @@ def make_sharded_step(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
     from ..sim.engine import step
     from .kernel_context import kernel_mesh
 
+    if cfg.sharded_route not in ("replicated", "halo"):
+        raise ValueError(f"unknown sharded_route {cfg.sharded_route!r}; "
+                         "expected 'replicated' or 'halo'")
     shardings = state_shardings(mesh, cfg)
     key_sh = NamedSharding(mesh, P())
+    repl = NamedSharding(mesh, P())
+    tp_sh = jax.tree.map(lambda _: repl, tp)
     peer_axes = tuple(ax for ax in (DCN_AXIS, PEER_AXIS)
                       if ax in mesh.axis_names)
 
-    @partial(jax.jit, in_shardings=(shardings, key_sh), out_shardings=shardings)
-    def sharded_step(state: SimState, key: jax.Array) -> SimState:
-        with kernel_mesh(mesh, peer_axes):
-            return step(state, cfg, tp, key)
+    # tp is passed as a traced ARGUMENT, not closed over: closure arrays
+    # become hoisted constants, and round 4 hit a jit AOT/dispatch
+    # disagreement about them ("compiled for 60 inputs but called with
+    # 41" whenever a .lower().compile() of the program preceded a regular
+    # dispatch anywhere in the process). With no captured arrays the
+    # lowered parameter list equals the explicit arguments and both
+    # execution paths agree.
+    @partial(jax.jit,
+             in_shardings=(shardings, tp_sh, key_sh), out_shardings=shardings)
+    def _step(state: SimState, tp_arg: TopicParams,
+              key: jax.Array) -> SimState:
+        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route):
+            return step(state, cfg, tp_arg, key)
 
+    def sharded_step(state: SimState, key: jax.Array) -> SimState:
+        # commit the key before dispatch: the jit fast path was observed
+        # re-sharding an uncommitted PRNG key with a STATE leaf's spec
+        return _step(state, tp, jax.device_put(key, key_sh))
+
+    # pin the jit object alive: the dispatch cache keys on function
+    # identity, and a garbage-collected closure's id() can be REUSED by
+    # the next factory call, hitting a stale executable. Bounded so a
+    # config sweep cannot leak executables without limit.
+    _LIVE_STEPS.append(_step)
+    sharded_step.lower = lambda st, k: _step.lower(
+        st, tp, jax.device_put(k, key_sh))
     return sharded_step
+
+
+from collections import deque                                  # noqa: E402
+
+_LIVE_STEPS: deque = deque(maxlen=64)

@@ -104,6 +104,13 @@ class SimConfig:
     # (auto is xla everywhere: the Mosaic gather wall, resolve_hop_mode)
     hop_mode: str = "auto"
 
+    # sort-mode routing under a sharded step (parallel/halo.py):
+    # "replicated" lowers the global sorts via all-gathers (correct,
+    # unscaled); "halo" routes per-shard with one all_to_all of padded
+    # buckets (scales with devices; capacity-factor assumption on random
+    # underlays, overflow poisons rather than drops)
+    sharded_route: str = "replicated"
+
     # dtype of the per-hop delivery-event count accumulators
     # (ops/propagate.py, PERF_MODEL.md S3): "uint8" minimizes HBM bytes;
     # "int32" trades 4x bytes for native 32-bit vector ops — TPU emulates

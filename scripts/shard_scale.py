@@ -48,13 +48,15 @@ def child_main(n_peers: int, ticks: int) -> None:
         inv = _collective_inventory(txt)
         # drive the AOT executable directly — step() would re-trace and
         # re-compile through the jit dispatch cache, doubling the dominant
-        # cost of this script per device count
+        # cost of this script per device count. The executable's signature
+        # is (state, tp, key): tp rides as an argument, not a hoisted
+        # closure constant (parallel/sharding.py note).
         for i in range(3):       # warm + converge so measured ticks are typical
-            st = compiled(st, jax.random.fold_in(key, i))
+            st = compiled(st, tp, jax.random.fold_in(key, i))
         jax.block_until_ready(st)
         t0 = time.perf_counter()
         for i in range(ticks):
-            st = compiled(st, jax.random.fold_in(key, 100 + i))
+            st = compiled(st, tp, jax.random.fold_in(key, 100 + i))
         jax.block_until_ready(st)
         dt = (time.perf_counter() - t0) / ticks
         print(f"devices={nd}: {dt * 1e3:8.1f} ms/tick   {inv}", flush=True)

@@ -55,7 +55,9 @@ _SLOW = {
     "test_sharding.py": ("test_sharded_step_matches_unsharded",
                          "test_2d_dcn_mesh_matches_unsharded",
                          "test_sharded_pallas_kernels_match_unsharded",
-                         "test_sharded_sort_mode_matches_unsharded"),
+                         "test_sharded_sort_mode_matches_unsharded",
+                         "test_sharded_halo_route_matches_unsharded",
+                         "test_sharded_halo_2d_mesh_and_multigroup"),
     "test_sim_control.py": ("TestFanout", "TestGraftFloodPenalty"),
     "test_sim_engine.py": ("test_negative_score_peer_gets_pruned",
                            "TestBackoff",
