@@ -1,0 +1,123 @@
+"""Honest-methodology on-chip probe of the edge-routing formulations.
+
+Round-4 lesson: through the axon tunnel, ``block_until_ready`` does NOT
+block — async-dispatch timing reported 770 TB/s "bandwidth". The only
+trustworthy numbers come from chaining the op inside one jit (so its cost
+cannot hide in the pipeline) and fetching a VALUE at the end (a real
+sync), then subtracting the measured fetch round trip.
+
+This script times, at the 100k headline shape (override: N K M as argv):
+  - the XLA gather formulations (2-index, flat 1-index, M-bool rows),
+  - the sort-permute apply (1 and 2 payload planes),
+  - the hop's non-gather math (prefix/winner/count chain) at uint8 vs
+    int32 accumulators — the count_dtype ablation's per-op ground truth.
+
+Run on a live window: python scripts/tpu_probe_gathers.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ITERS = 20
+
+
+def fetch_rtt():
+    f = jax.jit(lambda: jnp.float32(1.0))
+    np.asarray(f())
+    t0 = time.perf_counter()
+    np.asarray(f())
+    return time.perf_counter() - t0
+
+
+def timed(label, fjit, *args, rtt=0.0):
+    r = fjit(*args)
+    np.asarray(r).ravel()[0]
+    t0 = time.perf_counter()
+    r = fjit(*args)
+    np.asarray(r).ravel()[0]
+    dt = (time.perf_counter() - t0 - rtt) / ITERS
+    print(f"{label:52s} {dt * 1e3:9.2f} ms/iter", flush=True)
+
+
+def chain(body):
+    @jax.jit
+    def f(x, *rest):
+        def b(c, _):
+            c = jax.lax.optimization_barrier(c)
+            return body(c, *rest), None
+        o, _ = jax.lax.scan(b, x, None, length=ITERS)
+        return jax.tree.leaves(o)[0].ravel()[:4]
+    return f
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 100_000
+    k = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    m = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    w = (m + 31) // 32
+    print(f"== N={n} K={k} M={m} W={w} on {jax.devices()[0].platform} ==",
+          flush=True)
+    rtt = fetch_rtt()
+    print(f"(fetch RTT {rtt * 1e3:.1f} ms — subtracted)", flush=True)
+
+    from go_libp2p_pubsub_tpu.ops.bits import (
+        U32, exclusive_prefix_or, popcount_sum)
+
+    rng = np.random.default_rng(0)
+    jn = jnp.asarray(rng.integers(0, n, (n, k)).astype(np.int32))
+    rk = jnp.asarray(rng.integers(0, k, (n, k)).astype(np.int32))
+    pay = jnp.asarray(
+        rng.integers(0, 2**32, (n, k), dtype=np.uint64).astype(np.uint32))
+    planes = jnp.asarray(rng.random((n, m)) < 0.3)
+    perm = jnp.asarray(rng.permutation(n * k).astype(np.int32))
+    allowed = jnp.asarray(
+        rng.integers(0, 2**32, (w, k, n), dtype=np.uint64).astype(np.uint32))
+    tbw = jnp.asarray(
+        rng.integers(0, 2**32, (1, w), dtype=np.uint64).astype(np.uint32))
+
+    timed("gather 2-index payload[jn, rk]",
+          chain(lambda c, a, b: c[a, b]), pay, jn, rk, rtt=rtt)
+    timed("gather flat payload.ravel()[lin]",
+          chain(lambda c, li: c.reshape(-1)[li].reshape(n, k),
+                ), pay, (jn * k + rk).reshape(-1), rtt=rtt)
+    timed("gather rows planes[nbr] [N,K,M]b",
+          chain(lambda c, a: c ^ c[a][:, 0, :]), planes, jn, rtt=rtt)
+    timed("sort-permute 1 payload",
+          chain(lambda c, p: jax.lax.sort(
+              (p, c.reshape(-1)), num_keys=1)[1].reshape(n, k)),
+          pay, perm, rtt=rtt)
+    timed("sort-permute 2 payloads",
+          chain(lambda c, p: (lambda o: (o[1] ^ o[2]).reshape(n, k))(
+              jax.lax.sort((p, c.reshape(-1),
+                            (c ^ U32(7)).reshape(-1)), num_keys=1))),
+          pay, perm, rtt=rtt)
+
+    # hop math chain (no gather): prefix + winners + counts, per acc dtype
+    def hop_math(dt):
+        def body(f, a):
+            offered = jnp.broadcast_to(f[:, None, :], (w, k, n)) & a
+            excl = exclusive_prefix_or(offered, axis=1)
+            new_from_k = offered & ~excl & ~f[:, None, :]
+            cnt = popcount_sum(new_from_k & tbw[0][:, None, None],
+                               axis=0, dtype=dt).astype(dt)
+            new_any = (excl[:, -1] | offered[:, -1]) & ~f
+            return new_any ^ jnp.uint32(cnt.sum(dtype=jnp.uint32) & U32(1))
+        return body
+
+    fr = jnp.asarray(
+        rng.integers(0, 2**32, (w, n), dtype=np.uint64).astype(np.uint32))
+    timed("hop math (uint8 counts)", chain(hop_math(jnp.uint8)),
+          fr, allowed, rtt=rtt)
+    timed("hop math (int32 counts)", chain(hop_math(jnp.int32)),
+          fr, allowed, rtt=rtt)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,8 @@ for step in "bench:python bench.py" \
             "ablate_100k:python scripts/ablate.py headline_100000 10" \
             "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
             "pallas_smoke:python scripts/tpu_kernel_smoke.py" \
+            "probe_gathers:python scripts/tpu_probe_gathers.py" \
+            "probe_gathers_k16:python scripts/tpu_probe_gathers.py 100000 16 64" \
             "microbench_beacon:python scripts/microbench_kernels.py 10000 9 48 64" \
             "microbench_100k:python scripts/microbench_kernels.py 100000 1 32 64"; do
   name="${step%%:*}"; cmd="${step#*:}"
