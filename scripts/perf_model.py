@@ -273,6 +273,41 @@ def report_sharded(name, n, k, t, m, w, hops, p, n_dev,
     return 1e3 / ms
 
 
+def report_sort_era(name, n, k, t, m, w, hops, p, n_dev=1,
+                    sort_ms_per_3m2=5.0, ici_gbps=400.0):
+    """The EXECUTABLE model (post live-window): no Pallas gathers — every
+    edge routing is a sort-permute whose cost scales ~linearly in slots
+    (measured ~5 ms at L=3.2M on v5e through the tunnel), elementwise
+    runs at the measured ~232 GB/s, and the ~13 routing ops per tick are
+    serially dependent. n_dev > 1 uses the halo route (parallel/halo.py):
+    per-shard sorts of ~L/D plus an all_to_all of 4x-capacity buckets."""
+    achieved_gbps = 232.0
+    l_slots = n * k
+    ld = l_slots / n_dev
+    sort_ms = sort_ms_per_3m2 * ld / 3.2e6
+    n_sorts = hops + 2 + 3          # hops + resolve/emit + 3 exchanges
+    f = 4
+    elementwise_mb = fmt_mb(
+        hops * (12 * f * w * k * n // 4) +      # hop masked-math passes
+        6 * f * n * t * k +                      # scores/counters
+        4 * f * n * m)                           # [N,M] i32 passes
+    ew_ms = elementwise_mb / n_dev / 1e3 * (1e3 / achieved_gbps)
+    halo_ms = 0.0
+    if n_dev > 1:
+        bucket_mb = n_sorts * (4 * ld / n_dev) * n_dev * f / 1e6
+        halo_ms = bucket_mb / ici_gbps       # MB over GB/s -> ms
+    ms = n_sorts * sort_ms + ew_ms + halo_ms
+    print(f"\n== {name} [sort-era{', halo x' + str(n_dev) if n_dev > 1 else ''}]"
+          f" N={n} K={k} hops={hops} ==")
+    print(f"  {n_sorts} serial sort-permutes @ {sort_ms:5.2f} ms "
+          f"{n_sorts * sort_ms:8.2f} ms")
+    print(f"  {'elementwise @ 232 GB/s achieved':38s} {ew_ms:8.2f} ms")
+    if n_dev > 1:
+        print(f"  {'halo all_to_all buckets':38s} {halo_ms:8.2f} ms")
+    print(f"  {'TOTAL':38s} {ms:8.2f} ms   -> {1e3 / ms:7.1f} hb/s")
+    return 1e3 / ms
+
+
 def cost_analysis_check(n=10_000, k=32, m=64, p=8):
     """Compile each phase and print XLA's own bytes-accessed — an inventory
     check. MUST run in a process whose environment was scrubbed BEFORE
@@ -330,6 +365,10 @@ def main():
         if "--sharded" in sys.argv:
             n_dev = int(sys.argv[sys.argv.index("--sharded") + 1])
             report_sharded(which, n_dev=n_dev, **sh)
+        if "--sort-era" in sys.argv:
+            report_sort_era(which, **sh)
+            report_sort_era(which, n_dev=8, **sh)
+            report_sort_era(which, **{**sh, "k": 16})
     if "--cost-analysis" in sys.argv:
         # cross-check at the chosen shape, downscaled to 10k peers so the
         # CPU compile stays sane (the inventory, not N, is what's checked).
