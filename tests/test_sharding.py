@@ -258,3 +258,19 @@ print("HALO2D_OK")
                          capture_output=True, text=True, timeout=420,
                          cwd=repo)
     assert "HALO2D_OK" in res.stdout, res.stderr[-2000:]
+
+
+def test_lower_then_call_same_instance(eight_devices):
+    """Regression for the round-4 AOT/dispatch disagreement: calling
+    .lower().compile() and then dispatching the SAME sharded step used to
+    fail with 'compiled for 60 inputs but called with 41' because closure
+    arrays (tp) became hoisted constants. tp now rides as a traced
+    argument, so both paths agree."""
+    cfg, tp, st = _build()
+    mesh = make_mesh(eight_devices)
+    stp = make_sharded_step(mesh, cfg, tp)
+    st_sh = shard_state(st, mesh, cfg)
+    txt = stp.lower(st_sh, jax.random.PRNGKey(0)).compile().as_text()
+    assert txt                                   # AOT path works...
+    out = stp(st_sh, jax.random.PRNGKey(0))      # ...and dispatch after it
+    assert int(out.tick) == 1
