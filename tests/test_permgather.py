@@ -308,3 +308,30 @@ def test_count_dtype_trajectory_parity():
     for name, a, b in zip(st_a._fields, st_a, st_b):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
                                       err_msg=name)
+
+
+def test_sort_mode_parity_under_churn():
+    """Sort-permute routing under connection churn + PX reconnect: the
+    edge involution keys recompute from state each tick, and churn only
+    flips connected/mesh flags on the static symmetric slot structure —
+    so sort must stay bit-equal to scalar through down/up rounds."""
+    import dataclasses
+
+    from go_libp2p_pubsub_tpu.sim import (
+        SimConfig, TopicParams, init_state, topology)
+    from go_libp2p_pubsub_tpu.sim.engine import run
+
+    cfg = SimConfig(n_peers=192, k_slots=16, n_topics=2, msg_window=32,
+                    publishers_per_tick=4, prop_substeps=4,
+                    scoring_enabled=True, gater_enabled=True,
+                    churn_disconnect_prob=0.05, churn_reconnect_prob=0.3)
+    tp = TopicParams.disabled(2)
+    st0 = init_state(cfg, topology.sparse(192, 16, degree=6, seed=21))
+    key = jax.random.PRNGKey(31)
+    st_a = run(st0, dataclasses.replace(cfg, edge_gather_mode="scalar"),
+               tp, key, 8)
+    st_b = run(st0, dataclasses.replace(cfg, edge_gather_mode="sort"),
+               tp, key, 8)
+    for name, a, b in zip(st_a._fields, st_a, st_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
