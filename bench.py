@@ -16,12 +16,14 @@ Configs (BASELINE.json `configs`, built in sim/scenarios.py):
   5. 100k-peer floodsub / randomsub / gossipsub propagation sweep
 
 Env overrides: BENCH_N (peers for the headline config, default 100_000),
-BENCH_TICKS (default 30), BENCH_SCENARIOS (comma list to filter; "headline"
-names the final line).
+BENCH_TICKS (in-graph window length; default per scenario, TICKS_DEFAULT),
+BENCH_REPEATS (measured windows per config, median reported; default 3),
+BENCH_SCENARIOS (comma list to filter; "headline" names the final line).
 """
 
 import json
 import os
+import statistics
 import subprocess
 import sys
 import time
@@ -31,26 +33,57 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 TARGET_HBPS = 1000.0
 
 
-def bench_one(name, cfg, tp, st, ticks):
+def _fetch_rtt():
+    """Measured dispatch+value-fetch round trip (the axon tunnel's is
+    ~66 ms; local backends ~0), subtracted from every measured window.
+    `block_until_ready` does NOT block through the tunnel, so every timing
+    below syncs by fetching a value — which costs exactly this RTT. Median
+    of 5 samples: a single hiccup sample would bias EVERY window the same
+    way (the median over repeats cannot undo a shared offset)."""
     import jax
+    import jax.numpy as jnp
+    import numpy as np
+    f = jax.jit(lambda: jnp.float32(1.0))
+    np.asarray(f())                           # compile + warm
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.asarray(f())
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
+
+
+def bench_one(name, cfg, tp, st, ticks, repeats):
+    import jax
+    import numpy as np
     from go_libp2p_pubsub_tpu.sim.engine import (
         delivery_fraction, delivery_latency_ticks, run_donated)
 
-    k_warm, k_meas = jax.random.split(jax.random.PRNGKey(0))
+    keys = jax.random.split(jax.random.PRNGKey(0), 1 + repeats)
     # warmup with the SAME n_ticks (static jit arg): compiles the measured
-    # program and converges the mesh; the measured window uses a DIFFERENT
+    # program and converges the mesh; each measured window uses a DIFFERENT
     # key so it is not a cache-friendly replay of the warmup traffic.
     # run_donated: the input state buffers alias the output, halving peak
     # state memory at 100k peers
-    st = run_donated(st, cfg, tp, k_warm, ticks)
-    st.tick.block_until_ready()
+    st = run_donated(st, cfg, tp, keys[0], ticks)
+    np.asarray(st.tick)                       # real sync (not block_until_ready)
+    rtt = _fetch_rtt()
 
-    t0 = time.perf_counter()
-    st = run_donated(st, cfg, tp, k_meas, ticks)
-    st.tick.block_until_ready()
-    dt = time.perf_counter() - t0
+    # >=3 repeats, median reported: cross-round deltas must be larger than
+    # run-to-run noise to mean anything (VERDICT r4 weak #3 — the r3->r4
+    # driver-record comparison was drowned in single-shot variance)
+    rates = []
+    for k in keys[1:]:
+        t0 = time.perf_counter()
+        st = run_donated(st, cfg, tp, k, ticks)
+        np.asarray(st.tick)
+        raw = time.perf_counter() - t0
+        # floor at 5% of the raw window: a mis-measured RTT must degrade
+        # accuracy, never fabricate a absurd rate through a ~0 denominator
+        dt = max(raw - rtt, raw * 0.05)
+        rates.append(ticks / dt)
 
-    hbps = ticks / dt
+    hbps = statistics.median(rates)
     platform = jax.devices()[0].platform
     print(json.dumps({
         "metric": f"network_heartbeats_per_sec@{name}[{platform}]",
@@ -58,6 +91,11 @@ def bench_one(name, cfg, tp, st, ticks):
         "unit": "heartbeats/s",
         "platform": platform,
         "vs_baseline": round(hbps / TARGET_HBPS, 4),
+        "min": round(min(rates), 2),
+        "max": round(max(rates), 2),
+        "repeats": repeats,
+        "ticks_per_window": ticks,
+        "fetch_rtt_ms": round(rtt * 1e3, 1),
         "delivery_fraction": round(float(delivery_fraction(st, cfg)), 4),
         "mean_delivery_latency_ticks": round(
             float(delivery_latency_ticks(st, cfg)), 3),
@@ -71,11 +109,22 @@ NAMES = ["1k_single_topic", "10k_beacon", "50k_churn_gater_px",
                                               # parse of stdout picks it up
 
 
+# in-graph window length per scenario when BENCH_TICKS is unset: the whole
+# window is ONE lax.scan dispatch (sim/engine.run), so small-N configs need
+# long windows or the ~66 ms tunnel RTT dominates the measurement — at 1k
+# the roofline is sub-ms/tick, and a 10-tick window is >85% RTT (VERDICT r4
+# weak #4 "dispatch-bound"). Big-N configs stay short: their per-tick cost
+# already dwarfs the RTT.
+TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60}
+
+
 def run_scenario(name: str) -> None:
     from go_libp2p_pubsub_tpu.sim import scenarios
 
     n = int(os.environ.get("BENCH_N", 100_000))
-    ticks = int(os.environ.get("BENCH_TICKS", 30))
+    env_ticks = os.environ.get("BENCH_TICKS")
+    ticks = int(env_ticks) if env_ticks else TICKS_DEFAULT.get(name, 10)
+    repeats = max(1, int(os.environ.get("BENCH_REPEATS", 3)))
 
     def headline():
         from __graft_entry__ import _build
@@ -124,7 +173,7 @@ def run_scenario(name: str) -> None:
         cfg = dataclasses.replace(cfg, count_dtype=cdt)
         print(json.dumps({"info": "count dtype sweep", "requested": cdt}),
               flush=True)
-    bench_one(_label(name), cfg, tp, st, ticks)
+    bench_one(_label(name), cfg, tp, st, ticks, repeats)
 
 
 def _label(name: str) -> str:

@@ -99,3 +99,44 @@ def exclusive_prefix_or(x: jnp.ndarray, axis: int) -> jnp.ndarray:
 def popcount_sum(x: jnp.ndarray, axis: int = -1, dtype=jnp.float32) -> jnp.ndarray:
     """Total set bits summed over the word axis."""
     return jnp.sum(popcount(x).astype(dtype), axis=axis)
+
+
+def prefix_count(x: jnp.ndarray, exclusive: bool = False) -> jnp.ndarray:
+    """Running count of set bools along the LAST axis (inclusive by
+    default), as bit-pack + masked popcount instead of ``jnp.cumsum``.
+
+    XLA lowers a cumsum to a reduce-window / multi-pass associative scan —
+    measured ~16x slower than this formulation at the [N,T,K] heartbeat
+    shapes on CPU (246 vs 15 us at 1k peers; the round-4 GRAFT
+    capacity-vetting cumsums alone cost ~30% of the 1k-peer tick,
+    BENCH_r03->r04). Here every output element is one masked popcount of
+    its own 32-bit word plus a static per-word correction — pure
+    elementwise VPU work on TPU, vectorizable on CPU, O(ceil(K/32)) words
+    per element."""
+    return prefix_count_words(pack_bool(x), x.shape[-1], exclusive)
+
+
+def prefix_count_words(packed: jnp.ndarray, k: int,
+                       exclusive: bool = False) -> jnp.ndarray:
+    """:func:`prefix_count` on an ALREADY-PACKED ``[..., ceil(k/32)]`` u32
+    input -> ``[..., k]`` int32 — for callers that hold the packed words
+    anyway (the budgeted-IWANT scan masks packed offer words per step;
+    re-packing its unpacked view would pay an O(N*M) pack per scan step)."""
+    w = n_words(k)
+    assert packed.shape[-1] == w, (packed.shape, k)
+    kidx = jnp.arange(k)
+    word_of = kidx // 32
+    nbits = (kidx % 32).astype(U32) + (U32(0) if exclusive else U32(1))
+    # bits of the element's own word at or below it ("below" when
+    # exclusive); nbits=32 -> whole word (shift guarded: 1<<32 is UB)
+    own_mask = jnp.where(nbits >= 32, U32(0xFFFFFFFF),
+                         (U32(1) << jnp.minimum(nbits, U32(31))) - U32(1))
+    own_word = jnp.zeros_like(packed[..., :1])         # [..., 1] -> bcast [..., K]
+    total = jnp.zeros(packed.shape[:-1] + (k,), jnp.int32)
+    for wi in range(w):                                # static, w = ceil(K/32)
+        wrd = packed[..., wi:wi + 1]
+        own_word = jnp.where(word_of == wi, wrd, own_word)
+        if wi < w - 1:                                 # full words strictly below
+            total = total + jnp.where(word_of > wi,
+                                      popcount(wrd).astype(jnp.int32), 0)
+    return total + popcount(own_word & own_mask).astype(jnp.int32)

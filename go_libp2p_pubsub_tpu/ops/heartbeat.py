@@ -26,7 +26,7 @@ import numpy as np
 
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
-from .bits import U32
+from .bits import U32, prefix_count
 from .permgather import permutation_gather
 from .score_ops import (
     advance_active_latch,
@@ -294,9 +294,8 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     # the mesh at its arrival (own grafts + everything accepted in lower
     # slots, outbound included — accepted outbound grafts grow the mesh
     # and consume Dhi headroom for later arrivals) is still below Dhi
-    c_out_excl = jnp.cumsum(acc_out.astype(jnp.int32), axis=-1) \
-        - acc_out.astype(jnp.int32)
-    rank = jnp.cumsum(nonout.astype(jnp.int32), axis=-1)    # 1-based
+    c_out_excl = prefix_count(acc_out, exclusive=True)
+    rank = prefix_count(nonout)                             # 1-based
     accept = already | acc_out | \
         (nonout & (n_mine + c_out_excl + rank <= cfg.dhi))
     refuse = inc_graft & ~accept

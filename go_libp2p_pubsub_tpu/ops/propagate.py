@@ -57,6 +57,7 @@ from .bits import (
     pack_bool,
     pack_words,
     popcount_sum,
+    prefix_count_words,
     reduce_or,
     unpack_words,
 )
@@ -736,8 +737,9 @@ def _budgeted_iwant(offer: jnp.ndarray, have_bits: jnp.ndarray, m: int,
 
     def pick(carry, off_k):                       # off_k: [W, N]
         assigned, pend, slot_idx = carry
-        off_u = unpack_words(off_k & ~assigned, m)                # [N, M]
-        rank = jnp.cumsum(off_u.astype(jnp.int32), axis=1)
+        masked = off_k & ~assigned                                # [W, N]
+        off_u = unpack_words(masked, m)                           # [N, M]
+        rank = prefix_count_words(masked.T, m)
         take = off_u & (rank <= budget)
         pend = jnp.where(take, slot_idx, pend)
         assigned = assigned | pack_words(take)

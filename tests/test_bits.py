@@ -1,0 +1,45 @@
+"""prefix_count (ops/bits.py) must equal the cumsum it strength-reduces.
+
+The masked-popcount prefix replaces jnp.cumsum at the heartbeat GRAFT
+capacity-vetting and budgeted-IWANT call sites (XLA's cumsum lowering
+measured ~16x slower at those shapes on CPU — the r3->r4 driver-record
+regression, ROUND5_NOTES.md). Exactness is the contract: integer counts,
+bit-identical to cumsum at every shape the engine uses (K=16/32/48, M=64)
+plus awkward ones (non-multiples of 32, K=1, multi-word)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.ops.bits import prefix_count
+
+
+@pytest.mark.parametrize("k", [1, 7, 16, 31, 32, 33, 48, 64, 65, 100])
+@pytest.mark.parametrize("exclusive", [False, True])
+def test_prefix_count_matches_cumsum(k, exclusive):
+    x = jax.random.bernoulli(jax.random.PRNGKey(k), 0.3, (17, 3, k))
+    want = jnp.cumsum(x.astype(jnp.int32), axis=-1)
+    if exclusive:
+        want = want - x.astype(jnp.int32)
+    got = prefix_count(x, exclusive=exclusive)
+    assert got.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+@pytest.mark.parametrize("k", [32, 48, 64, 100])
+def test_prefix_count_words_matches_bool_form(k):
+    from go_libp2p_pubsub_tpu.ops.bits import pack_bool, prefix_count_words
+    x = jax.random.bernoulli(jax.random.PRNGKey(k + 1), 0.4, (9, k))
+    np.testing.assert_array_equal(
+        np.asarray(prefix_count_words(pack_bool(x), k)),
+        np.asarray(prefix_count(x)))
+
+
+def test_prefix_count_all_set_and_empty():
+    for k in (32, 48):
+        ones = jnp.ones((4, k), bool)
+        np.testing.assert_array_equal(
+            np.asarray(prefix_count(ones)), np.arange(1, k + 1)[None].repeat(4, 0))
+        np.testing.assert_array_equal(
+            np.asarray(prefix_count(jnp.zeros((4, k), bool))), np.zeros((4, k), np.int32))
