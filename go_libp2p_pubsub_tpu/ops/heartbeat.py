@@ -58,7 +58,8 @@ def edge_gather(x: jnp.ndarray, state: SimState, fill=False,
 
 
 def edge_gather_packed(masks: list, state: SimState,
-                       mode: str = "auto") -> list:
+                       mode: str = "auto",
+                       extra_words: list | None = None):
     """Gather several [N, T, K] boolean edge masks through the reverse-edge
     permutation in ceil(B/32) uint32 gathers (B = total bit-planes), instead
     of one [N,T,K] advanced-index gather per mask. The permutation gather is
@@ -71,7 +72,17 @@ def edge_gather_packed(masks: list, state: SimState,
     [N, ceil(B*K/32)] u32 bit-table pinned in VMEM (PERF_MODEL.md S2 —
     blocked from auto by the Mosaic gather wall); the others build
     per-32-plane [N, K] u32 payloads routed through
-    ops/permgather.permutation_gather."""
+    ops/permgather.permutation_gather.
+
+    ``extra_words``: optional [W_i, N] u32 word-tables to route through the
+    SAME involution as extra lanes of the SAME variadic sort (returned as
+    [W_i, K, N] receiver views, out[w, k, n] = table[w, neighbors[n, k]]).
+    Every serially-dependent sort is ~7% of the sort-era tick (VERDICT r4
+    item 1), so data-independent exchanges must share one comparator pass —
+    forward_tick's IWANT answer-table gather rides the heartbeat's final
+    exchange this way. Only legal when the resolved mode is ``sort``
+    (callers gate on resolve_edge_packed_mode); invalid slots carry
+    garbage the consumers mask, exactly like gather_words' sort path."""
     from ..parallel.kernel_context import current_kernel_mesh
     from .permgather import (
         _edge_table_pallas, edge_sort_key, resolve_edge_packed_mode)
@@ -83,8 +94,19 @@ def edge_gather_packed(masks: list, state: SimState,
     rk = jnp.clip(state.reverse_slot, 0, k - 1)
     valid = ((state.neighbors >= 0) & (state.reverse_slot >= 0))[:, None, :]
     mode = resolve_edge_packed_mode(mode, n, k, b)
+    has_extras = extra_words is not None      # [] still returns the 2-tuple
+    extra_words = extra_words or []
+    if extra_words and mode != "sort":
+        raise ValueError(
+            f"extra_words requires the sort formulation (resolved {mode!r}); "
+            "callers gate on resolve_edge_packed_mode")
     sk = edge_sort_key(state.neighbors, state.reverse_slot, k_major=False) \
         if mode == "sort" else None
+    # broadcast each extra word-table row along the slot axis: source slot
+    # (j, r) carries table[w, j], landing at its involution partner (n, k)
+    # with neighbors[n, k] == j — the receiver view, [N, K] per row
+    extra_lanes = [jnp.broadcast_to(tab[i][:, None], (n, k))
+                   for tab in extra_words for i in range(tab.shape[0])]
     if mode == "pallas":
         from functools import partial
 
@@ -111,17 +133,23 @@ def edge_gather_packed(masks: list, state: SimState,
             payloads.append(jnp.sum(bits.astype(U32) * sh, axis=1, dtype=U32))
         ctx = current_kernel_mesh() if mode == "sort" else None
         if mode == "sort" and ctx is not None and ctx.route == "halo":
-            # sharded: every group rides one per-shard halo route
+            # sharded: every group (and extra lane) rides one per-shard
+            # halo route
             from ..parallel.halo import route_payloads_halo
-            groups = route_payloads_halo(payloads, state.neighbors,
+            routed = route_payloads_halo(payloads + extra_lanes,
+                                         state.neighbors,
                                          state.reverse_slot)
+            groups, extra_out = routed[:len(payloads)], routed[len(payloads):]
         elif mode == "sort":
-            # ONE variadic sort routes every 32-plane group: the keys are
-            # identical across groups, so sorting once moves all payloads
-            # for a single O(NK log NK) comparator pass
+            # ONE variadic sort routes every 32-plane group AND every
+            # extra word lane: the keys are identical, so sorting once
+            # moves all payloads for a single O(NK log NK) comparator pass
             outs = jax.lax.sort(
-                (sk, *[p.reshape(-1) for p in payloads]), num_keys=1)
-            groups = [o.reshape(n, k) for o in outs[1:]]
+                (sk, *[p.reshape(-1) for p in payloads + extra_lanes]),
+                num_keys=1)
+            flat_outs = [o.reshape(n, k) for o in outs[1:]]
+            groups = flat_outs[:len(payloads)]
+            extra_out = flat_outs[len(payloads):]
         else:
             groups = [permutation_gather(p, jn, rk, mode) for p in payloads]
     parts = []
@@ -130,7 +158,15 @@ def edge_gather_packed(masks: list, state: SimState,
         parts.append(((g[:, None, :] >> jnp.arange(nb, dtype=U32)[None, :, None])
                       & U32(1)).astype(bool))
     flat = jnp.concatenate(parts, axis=1) & valid
-    return [flat[:, i * t:(i + 1) * t, :] for i in range(len(masks))]
+    results = [flat[:, i * t:(i + 1) * t, :] for i in range(len(masks))]
+    if not has_extras:
+        return results
+    extras, ofs = [], 0
+    for tab in extra_words:
+        wt = tab.shape[0]
+        extras.append(jnp.stack([extra_out[ofs + i].T for i in range(wt)]))
+        ofs += wt                                     # [W_i, K, N] each
+    return results, extras
 
 
 class HeartbeatOut(NamedTuple):
@@ -146,10 +182,16 @@ class HeartbeatOut(NamedTuple):
     fwd_send: jnp.ndarray    # [N, T, K] receiver view of the eager-forward
                              # edges (sender's mesh | non-subscribed fanout),
                              # consumed by forward_tick's gossipsub path
+    extra_routed: tuple = () # receiver views ([W_i, K, N]) of the caller's
+                             # extra_words tables, routed on the final
+                             # exchange's variadic sort (engine.step merges
+                             # forward_tick's IWANT answer gather here — one
+                             # fewer serially-dependent sort per tick)
 
 
 def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
-              key: jax.Array) -> HeartbeatOut:
+              key: jax.Array,
+              extra_words: list | None = None) -> HeartbeatOut:
     n, t, k = state.mesh.shape
     tick = state.tick
     ks = jax.random.split(key, 8)
@@ -396,8 +438,10 @@ def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,
     # who gossips to me, and whose eager forwarding reaches me
     # (gossipsub.go:1020-1035 mesh forward, :1007 fanout publish)
     send = new_mesh | (new_fanout & ~state.subscribed[:, :, None])
-    inc_gossip, fwd_send = edge_gather_packed([gossip_sel, send], st,
-                                             cfg.edge_gather_mode)
+    (inc_gossip, fwd_send), extras = edge_gather_packed(
+        [gossip_sel, send], st, cfg.edge_gather_mode,
+        extra_words=extra_words if extra_words is not None else [])
 
     return HeartbeatOut(state=st, scores=scores, scores_all=scores_all,
-                        inc_gossip=inc_gossip, fwd_send=fwd_send)
+                        inc_gossip=inc_gossip, fwd_send=fwd_send,
+                        extra_routed=tuple(extras))

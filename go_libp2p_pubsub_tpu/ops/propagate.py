@@ -203,7 +203,8 @@ def _bits_to_slot(chosen: jnp.ndarray, m: int) -> jnp.ndarray:
 def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                  inc_gossip: jnp.ndarray, scores: jnp.ndarray,
                  key: jax.Array, *,
-                 fwd_send: jnp.ndarray | None = None) -> SimState:
+                 fwd_send: jnp.ndarray | None = None,
+                 answers_k: jnp.ndarray | None = None) -> SimState:
     """One tick of data-plane traffic: resolve last tick's IWANTs, run
     ``prop_substeps`` forwarding hops, then emit this tick's IHAVE/IWANT.
 
@@ -363,7 +364,11 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         seed_nv = seed_ni = None
         asked_k = _slot_bitplanes(state.iwant_pending, k) \
             & alive_bits[:, None, None]
-        answers_k = gw(answer_bits)                                     # [W,K,N]
+        if answers_k is None:
+            answers_k = gw(answer_bits)                                 # [W,K,N]
+        # else: engine.step pre-routed the answer table on the heartbeat's
+        # final exchange (_iwant_answer_extras) — same receiver view, one
+        # fewer serially-dependent sort
         # pulled data is still data: graylist + gater admission apply, and pulls
         # are charged against the same per-edge and validation budgets as eager
         # traffic (an IHAVE-flooding adversary must not route unlimited data

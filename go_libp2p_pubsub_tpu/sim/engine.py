@@ -51,6 +51,31 @@ def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
     return peers, topics
 
 
+def _iwant_answer_extras(state: SimState, cfg: SimConfig) -> list | None:
+    """When the tick's exchanges ride the sort-permute formulation, the
+    IWANT answer-table gather (forward_tick step 1) is data-independent of
+    the heartbeat — it reads only deliver_tick and malicious, which the
+    heartbeat never writes — so it can share the heartbeat's FINAL
+    exchange's variadic sort instead of paying its own serially-dependent
+    comparator pass (~13 serial sorts bound the sort-era tick; VERDICT r4
+    item 1). Returns the [W, N] answer table to ride along, or None when
+    the formulations don't line up (non-sort modes, fused resolve kernel)."""
+    from ..ops.bits import pack_words
+    from ..ops.hopkernel import resolve_hop_mode
+    from ..ops.permgather import resolve_edge_packed_mode
+    from ..sim.state import NEVER as _NEVER
+
+    n, t, k = state.mesh.shape
+    w = (cfg.msg_window + 31) // 32
+    if resolve_hop_mode(cfg.hop_mode, cfg, w, n, k) == "pallas":
+        return None                  # fused resolve kernel gathers in VMEM
+    if resolve_edge_packed_mode(cfg.edge_gather_mode, n, k, 2 * t) != "sort":
+        return None
+    answer_bits = jnp.where(state.malicious[None, :], jnp.uint32(0),
+                            pack_words(state.deliver_tick < _NEVER))
+    return [answer_bits]
+
+
 def step(state: SimState, cfg: SimConfig, tp: TopicParams,
          key: jax.Array) -> SimState:
     k_pub, k_hb, k_fwd, k_churn, k_ign, k_sub = jax.random.split(key, 6)
@@ -61,7 +86,8 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
     if cfg.gater_enabled:
         state = gater_decay(state, cfg)
     if cfg.router == "gossipsub":
-        hb = heartbeat(state, cfg, tp, k_hb)
+        hb = heartbeat(state, cfg, tp, k_hb,
+                       extra_words=_iwant_answer_extras(state, cfg))
     else:
         # floodsub/randomsub run NO heartbeat: no mesh maintenance, no
         # gossip, no scoring (floodsub.go/randomsub.go define none of it)
@@ -73,7 +99,9 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
                           fwd_send=jnp.zeros((n, t, k), bool))
     state = forward_tick(hb.state, cfg, tp, hb.inc_gossip, hb.scores, k_fwd,
                          fwd_send=hb.fwd_send if cfg.router == "gossipsub"
-                         else None)
+                         else None,
+                         answers_k=hb.extra_routed[0]
+                         if hb.extra_routed else None)
     if cfg.churn_disconnect_prob > 0.0:
         # connection churn closes the tick, reusing the heartbeat's score
         # cache (its unmasked variant) for the PX reconnect gate — one

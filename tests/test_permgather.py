@@ -285,6 +285,39 @@ class TestSortPermute:
                                           err_msg=name)
 
 
+def test_merged_answer_exchange_equals_standalone_gather():
+    """The IWANT answer table riding the heartbeat's final exchange
+    (engine._iwant_answer_extras -> edge_gather_packed extra_words) must be
+    trajectory-identical to forward_tick's standalone words gather — under
+    a pull-heavy config so the answer lanes carry real load."""
+    import go_libp2p_pubsub_tpu.sim.engine as eng
+    from go_libp2p_pubsub_tpu.sim import (
+        SimConfig, TopicParams, init_state, topology)
+
+    cfg = SimConfig(n_peers=192, k_slots=16, n_topics=2, msg_window=32,
+                    publishers_per_tick=4, prop_substeps=2,
+                    scoring_enabled=True, edge_gather_mode="sort")
+    tp = TopicParams.disabled(2)
+    st0 = init_state(cfg, topology.sparse(192, 16, degree=14, seed=5))
+    key = jax.random.PRNGKey(13)
+
+    st_merged = eng.run(st0, cfg, tp, key, 8)
+
+    real_extras = eng._iwant_answer_extras
+    try:
+        eng._iwant_answer_extras = lambda state, cfg: None
+        st_plain = jax.jit(eng._run_impl, static_argnames=("cfg", "n_ticks")
+                           )(st0, cfg, tp, key, 8)
+    finally:
+        eng._iwant_answer_extras = real_extras
+
+    pulls = int(np.sum(np.asarray(st_merged.iwant_pending) >= 0))
+    assert pulls > 100, f"answer lanes barely exercised: {pulls} pulls"
+    for name, a, b in zip(st_merged._fields, st_merged, st_plain):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)
+
+
 def test_count_dtype_trajectory_parity():
     """count_dtype=int32 (the native-lane ablation of the uint8 S3
     accumulators, sim/config.py) must leave trajectories bit-identical:
