@@ -1,0 +1,118 @@
+"""Two-level gather-free VMEM table lookup: one-hot MXU matmul + lane select.
+
+The round-4 live window proved current Mosaic cannot lower ANY table
+lookup wider than one vector register — ``tpu.dynamic_gather`` is a
+128-lane in-vreg shuffle, and every wider formulation fails with
+``Not implemented: Multiple source vregs along gather dimension``
+(PERF_MODEL.md "reality check"; `scripts/tpu_kernel_smoke.py` keeps the
+distilled repro). That wall killed the S1–S7 fused-kernel design
+(1.36 ms/tick → ~734 hb/s single-chip at the 100k headline).
+
+This module is VERDICT r4 item 3's attack on the wall: express
+``table[idx]`` with NO gather op of any width. Factor idx = 128·b + l:
+
+    1. block select (MXU): rows = onehot(b) @ table_blocks — the [NB, 128]
+       re-blocked table hit with a [G, NB] one-hot bf16 matmul. Each
+       output row has exactly ONE nonzero term, and the table is split
+       into u8 chunks (0..255 — exact in bf16's 8-bit mantissa, and the
+       MXU accumulates in f32), so the select is EXACT integer routing.
+    2. lane select (VPU): out = sum_l rows[g, l] · onehot(l) — an
+       elementwise multiply + 128-lane reduction, again one nonzero term.
+
+    u32 words travel as 4 u8 chunk planes recombined by shifts.
+
+Ops used: iota, compare, convert, dot_general, multiply, reduce — all
+core Mosaic. FLOP cost per index: 2·NB (MXU) + 2·128 (VPU) per chunk; at
+the 100k headline's hop gather (L = N·K = 3.2M indices, NB = 800) that is
+~20 Gflop on a 197 TFLOP/s MXU ≈ 0.1 ms — against 9 ms for the measured
+sort-permute routing and ~25 ms for XLA's 7 ns/index gathers. If this
+lowers on a live window (scripts/tpu_kernel_smoke.py checks it), the
+ready-and-tested Pallas kernel suite comes back from the dead with its
+gathers rewritten this way.
+
+Reference seam being accelerated: the per-edge neighbor lookups behind
+every router exchange (gossipsub.go:1345-1606 heartbeat fan-out,
+comm.go:44-191 per-connection streams), batched here as table routing.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128
+
+
+def _prep_table(x_w: jnp.ndarray) -> jnp.ndarray:
+    """[W, N] u32 -> [W, 4, NB, 128] bf16 u8-chunk planes (N zero-padded up
+    to a 128 multiple; idx < N so pad rows are never selected)."""
+    w, n = x_w.shape
+    nb = -(-n // LANES)
+    pad = nb * LANES - n
+    if pad:
+        x_w = jnp.pad(x_w, ((0, 0), (0, pad)))
+    chunks = jnp.stack([(x_w >> (8 * c)) & jnp.uint32(0xFF)
+                        for c in range(4)], axis=1)          # [W, 4, NB*128]
+    return chunks.reshape(w, 4, nb, LANES).astype(jnp.bfloat16)
+
+
+def _select_block(tab_c: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """tab_c [NB, 128] bf16, idx [G] -> [G] f32 exact values (one chunk)."""
+    nb = tab_c.shape[0]
+    blk = idx // LANES
+    lane = idx % LANES
+    oh_b = (blk[:, None] == jnp.arange(nb)[None, :]).astype(jnp.bfloat16)
+    rows = jax.lax.dot_general(
+        oh_b, tab_c, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                  # [G, 128]
+    oh_l = (lane[:, None] == jnp.arange(LANES)[None, :]).astype(jnp.float32)
+    return jnp.sum(rows * oh_l, axis=1)                      # [G] f32
+
+
+def _kernel(tab_ref, idx_ref, out_ref, *, w: int):
+    idx = idx_ref[:].reshape(-1)
+    tab = tab_ref[:]                                         # [W, 4, NB, 128]
+    words = []
+    for wi in range(w):
+        acc = jnp.zeros(idx.shape, jnp.uint32)
+        for c in range(4):
+            v = _select_block(tab[wi, c], idx).astype(jnp.uint32)
+            acc = acc | (v << (8 * c))
+        words.append(acc)
+    out_ref[:] = jnp.stack(words).reshape(out_ref.shape)
+
+
+def take_words_twolevel(x_w: jnp.ndarray, idx: jnp.ndarray,
+                        block_g: int = 1024,
+                        interpret: bool = False) -> jnp.ndarray:
+    """out[w, r] = x_w[w, idx[r]] — the gather-free two-level take.
+
+    ``idx`` must be pre-clipped to [0, N). ``block_g`` indices are
+    processed per grid step (VMEM: the one-hot tile is block_g x NB bf16;
+    ~1.6 MB at the 100k headline's NB=800)."""
+    from jax.experimental import pallas as pl
+
+    w, n = x_w.shape
+    (r,) = idx.shape
+    assert r % block_g == 0 or r < block_g, (r, block_g)
+    bg = min(r, block_g)
+    tab = _prep_table(x_w)
+    nb = tab.shape[2]
+    return pl.pallas_call(
+        functools.partial(_kernel, w=w),
+        grid=(r // bg,),
+        in_specs=[
+            pl.BlockSpec((w, 4, nb, LANES), lambda i: (0, 0, 0, 0)),
+            pl.BlockSpec((bg,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((w, bg), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((w, r), jnp.uint32),
+        interpret=interpret,
+    )(tab, idx)
+
+
+def take_words_twolevel_ref(x_w: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """The XLA reference the kernel must match bit-for-bit."""
+    return x_w[:, idx]

@@ -289,7 +289,8 @@ def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
         # table feasibility is GLOBAL n (the whole bit-table pins in VMEM);
         # block feasibility is the per-shard row count under a kernel mesh
         wb = (b_planes * k + 31) // 32
-        if (n * wb * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
+        # table + _mosaic_take's table-width index/result temporaries
+        if (n * wb * 12 > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 2 * k * wb * 4) is None):
             return "rows"
     return mode
@@ -321,7 +322,8 @@ def resolve_words_mode(mode: str, w: int, n: int, k: int,
     if mode == "sort" and not have_sort_key:
         return "rows"
     if mode == "pallas":
-        if (w * n * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
+        # table + _mosaic_take's table-width index/result temporaries
+        if (w * n * (2 * itemsize + 4) > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 2 * w * k * itemsize) is None):
             return "rows"
     return mode
@@ -386,7 +388,12 @@ def resolve_mode(mode: str, payload_dtype, n: int, k: int,
         return "scalar"
     if mode == "pallas":
         itemsize = jnp.dtype(payload_dtype).itemsize
-        if (itemsize < 4 or n * k * itemsize > _PALLAS_VMEM_PAYLOAD_BYTES
+        # footprint = payload table + _mosaic_take's full-table-width
+        # broadcast index (i32) and take result per chunk — ~3x the
+        # payload for u32, which the old payload-only gate understated
+        # (round-4 advisor finding)
+        flat_bytes = n * k * (2 * itemsize + 4)
+        if (itemsize < 4 or flat_bytes > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 2 * k * itemsize) is None):
             return "rows"    # sub-word dtype, payload > VMEM budget, or no
                              # block size whose row scratch fits

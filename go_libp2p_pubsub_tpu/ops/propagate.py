@@ -486,7 +486,16 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # hops and per-hop counting counts each event exactly once. Accumulators
     # are seeded with the pull events so pulls share the attribution path.
     frontier = pack_words(state.deliver_tick == state.tick) | got_valid_any
+    # halo-route overflow accounting across the while_loop boundary: notes
+    # created OUTSIDE the loop (heartbeat exchanges, the resolve/flood
+    # gathers above) drain into the initial carry; notes created INSIDE
+    # the hop body drain within the body's own trace (a tracer must not
+    # escape the loop); the post-loop total is re-noted for engine.step
+    from ..parallel.kernel_context import (
+        drain_halo_overflow, note_halo_overflow)
+    halo_ovf0 = sum(drain_halo_overflow(), jnp.int32(0))
     carry0 = {
+        "halo_ovf": halo_ovf0,
         "i": jnp.int32(0),
         "frontier": frontier,
         "have": have_bits,
@@ -527,7 +536,9 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             out = dict(c)
             out.update(i=c["i"] + 1, frontier=h.new_valid, have=h.have,
                        dlv=h.dlv, dlv_new=h.dlv_new, nv=h.nv, ni=h.ni,
-                       dup=h.dup)
+                       dup=h.dup,
+                       halo_ovf=c["halo_ovf"]
+                       + sum(drain_halo_overflow(), jnp.int32(0)))
             return out
         i, frontier, have_bits, dlv_bits, dlv_new = \
             c["i"], c["frontier"], c["have"], c["dlv"], c["dlv_new"]
@@ -601,6 +612,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         out["arrivals"] = arrivals
         out["throttled"] = throttled
         out["validated"] = validated
+        out["halo_ovf"] = c["halo_ovf"] \
+            + sum(drain_halo_overflow(), jnp.int32(0))
         return out
 
     # the hop loop is a lax.while_loop (not unrolled): one hop's code
@@ -612,6 +625,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     carry = jax.lax.while_loop(
         lambda c: (c["i"] < cfg.prop_substeps) & jnp.any(c["frontier"] != 0),
         hop, carry0)
+    note_halo_overflow(carry["halo_ovf"])
     have_bits, dlv_bits = carry["have"], carry["dlv"]
     arrivals, throttled, validated = \
         carry["arrivals"], carry["throttled"], carry["validated"]

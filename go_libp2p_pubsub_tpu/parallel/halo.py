@@ -37,22 +37,65 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .kernel_context import PEER, current_kernel_mesh, peer_shards, shard_kernel
+from .kernel_context import (
+    PEER,
+    current_kernel_mesh,
+    note_halo_overflow,
+    peer_shards,
+    shard_kernel,
+)
 
-# capacity factor for the per-(src,dst) device buckets: random underlays
-# put ~(valid L)/D^2 slots in each bucket; 4x the mean covers the tails
-# at the shapes the engine targets. Overflow poisons, never drops.
-_CAPACITY_FACTOR = 4
-
+# CAPACITY RULE: each per-(src,dst)-device bucket holds
+#     cap = min(Ld, factor * ceil(Ld / D))      (Ld = local slots = N*K/D)
+# A uniformly-random underlay puts ~(valid Ld)/D slots in each of a
+# device's D buckets, so factor x the mean covers the tails (factor=4
+# default, SimConfig.halo_capacity_factor). The rule is EXACTLY checkable
+# per underlay before running: `required_capacity_factor(neighbors,
+# reverse_slot, d)` computes the worst bucket offline — bench underlays
+# (sparse random, incl. the beacon config's) measure <= ~1.3x
+# (tests/test_sharding.py capacity sweep); clustered/star-like underlays
+# can exceed 4x and must raise the config knob to that function's answer.
+# On overflow the routed keys are POISONED (-1 -> garbage everywhere, so
+# trajectory tests fail loudly rather than dropping edges silently) AND
+# the per-tick overflow count is surfaced in SimState.halo_overflow via
+# the kernel-context notes (engine.step drains them) — a production run
+# can alarm on halo_overflow > 0 without diffing trajectories.
 _BIG = jnp.int32(2_147_483_647)
+
+
+def _capacity_factor() -> int:
+    ctx = current_kernel_mesh()
+    return ctx.capacity_factor if ctx is not None else 4
+
+
+def required_capacity_factor(neighbors, reverse_slot, n_dev: int) -> int:
+    """The smallest INTEGER capacity factor that fits every (src,dst)
+    bucket of this underlay on an ``n_dev``-way peer sharding — host-side
+    numpy, directly assignable to ``SimConfig.halo_capacity_factor``
+    before a run (already ceiled: cap = factor * ceil(Ld/D) >= the worst
+    bucket)."""
+    import math
+
+    import numpy as np
+    nbr = np.asarray(neighbors)
+    rks = np.asarray(reverse_slot)
+    n, k = nbr.shape
+    nl = n // n_dev
+    valid = (nbr >= 0) & (rks >= 0)
+    src_dev = np.repeat(np.arange(n) // nl, k).reshape(n, k)
+    dest_dev = np.clip(nbr, 0, n - 1) // nl
+    pair = (src_dev * n_dev + dest_dev)[valid]
+    counts = np.bincount(pair, minlength=n_dev * n_dev)
+    mean_cap = -(-nl * k // n_dev)                  # ceil(Ld / D)
+    return math.ceil(int(counts.max()) / mean_cap) if mean_cap else 0
 
 
 def _route_local(keys, dest_dev, valid, vals, ld, n_dev, axis_name):
     """keys [Ld]: global destination key per local source slot (valid
     slots: the involution target; invalid: the slot's own global index —
-    both bijective, disjoint). vals: list of [Ld] payloads. Returns the
-    payloads in local destination-flat order."""
-    cap = min(ld, _CAPACITY_FACTOR * (-(-ld // n_dev)))
+    both bijective, disjoint). vals: list of [Ld] payloads. Returns
+    (payloads in local destination-flat order, overflowed-bucket count)."""
+    cap = min(ld, _capacity_factor() * (-(-ld // n_dev)))
     dd_ext = jnp.where(valid, dest_dev, n_dev)              # invalid -> tail
     srt = jax.lax.sort((dd_ext, keys, *vals), num_keys=2)
     dd_s, keys_s = srt[0], srt[1]
@@ -92,7 +135,7 @@ def _route_local(keys, dest_dev, valid, vals, ld, n_dev, axis_name):
     out = jax.lax.sort(
         (all_keys, *[jnp.concatenate([rv.reshape(-1), v])
                      for rv, v in zip(recv_vals, vals)]), num_keys=1)
-    return [o[:ld] for o in out[1:]]
+    return [o[:ld] for o in out[1:]], jnp.sum(counts > cap, dtype=jnp.int32)
 
 
 def _axis_tuple():
@@ -123,14 +166,17 @@ def route_words_halo(x_w, neighbors, reverse_slot):
         dest = (keys % n) // nl
         vals = [jnp.broadcast_to(x_l[i][:, None], (nl, k)).reshape(-1)
                 for i in range(w)]
-        outs = _route_local(keys, dest, valid, vals, nl * k, n_dev, axis)
-        return jnp.stack([o.reshape(k, nl) for o in outs])
+        outs, ovf = _route_local(keys, dest, valid, vals, nl * k, n_dev, axis)
+        return (jnp.stack([o.reshape(k, nl) for o in outs]),
+                jax.lax.psum(ovf, axis))
 
-    return shard_kernel(
+    out, overflow = shard_kernel(
         body,
         in_specs=[(None, PEER), (PEER, None), (PEER, None)],
-        out_specs=[(None, None, PEER)],
+        out_specs=[(None, None, PEER), ()],
     )(x_w, neighbors, reverse_slot)
+    note_halo_overflow(overflow)
+    return out
 
 
 def route_payloads_halo(payloads, neighbors, reverse_slot):
@@ -155,13 +201,13 @@ def route_payloads_halo(payloads, neighbors, reverse_slot):
         keys = jnp.where(valid.reshape(nl, k), jn * k + rk, own).reshape(-1)
         dest = (keys // k) // nl
         vals = [p.reshape(-1) for p in pl_l]
-        outs = _route_local(keys, dest, valid, vals, nl * k, n_dev, axis)
-        out = tuple(o.reshape(nl, k) for o in outs)
-        return out if n_pl > 1 else out[0]
+        outs, ovf = _route_local(keys, dest, valid, vals, nl * k, n_dev, axis)
+        return (*[o.reshape(nl, k) for o in outs], jax.lax.psum(ovf, axis))
 
     res = shard_kernel(
         body,
         in_specs=[(PEER, None), (PEER, None)] + [(PEER, None)] * n_pl,
-        out_specs=[(PEER, None)] * n_pl,
+        out_specs=[(PEER, None)] * n_pl + [()],
     )(neighbors, reverse_slot, *payloads)
-    return list(res) if n_pl > 1 else [res]
+    note_halo_overflow(res[-1])
+    return list(res[:-1])

@@ -23,6 +23,7 @@ dispatch the kernels directly, exactly as before.
 
 from __future__ import annotations
 
+import contextvars
 from contextlib import contextmanager
 from typing import NamedTuple
 
@@ -38,30 +39,58 @@ class KernelMesh(NamedTuple):
     route: str = "replicated"   # sort-mode routing: "replicated" global
                                 # sort | "halo" per-shard all_to_all
                                 # (parallel/halo.py)
+    capacity_factor: int = 4    # halo bucket capacity over the uniform mean
+                                # (parallel/halo.py capacity rule)
+    overflow_notes: list = None # trace-time accumulator: halo overflow
+                                # counts (outer-trace scalars) noted by
+                                # route_*_halo, drained once per step by
+                                # engine.step into SimState.halo_overflow
 
 
-_current: KernelMesh | None = None
+# a ContextVar, not a module global: the context is consulted at TRACE
+# time, and a process tracing a sharded and an unsharded step from
+# different threads (or an async retrace escaping the manager) must each
+# see their own mesh decision (round-4 advisor finding)
+_current: contextvars.ContextVar[KernelMesh | None] = \
+    contextvars.ContextVar("kernel_mesh", default=None)
 
 
 @contextmanager
-def kernel_mesh(mesh: Mesh, peer_axes, route: str = "replicated"):
+def kernel_mesh(mesh: Mesh, peer_axes, route: str = "replicated",
+                capacity_factor: int = 4):
     """Activate shard_map kernel dispatch for code traced inside."""
-    global _current
-    prev = _current
-    _current = KernelMesh(mesh, tuple(peer_axes), route)
+    tok = _current.set(KernelMesh(mesh, tuple(peer_axes), route,
+                                  capacity_factor, []))
     try:
         yield
     finally:
-        _current = prev
+        _current.reset(tok)
 
 
 def current_kernel_mesh() -> KernelMesh | None:
-    return _current
+    return _current.get()
+
+
+def note_halo_overflow(count) -> None:
+    """Record a halo-route bucket-overflow count (an outer-trace scalar —
+    shard_map has already psum'd it) for the current step to absorb."""
+    ctx = _current.get()
+    if ctx is not None and ctx.overflow_notes is not None:
+        ctx.overflow_notes.append(count)
+
+
+def drain_halo_overflow() -> list:
+    """Take (and clear) the overflow counts noted since the last drain."""
+    ctx = _current.get()
+    if ctx is None or not ctx.overflow_notes:
+        return []
+    notes, ctx.overflow_notes[:] = list(ctx.overflow_notes), []
+    return notes
 
 
 def peer_shards() -> int:
     """Number of shards the peer axis splits over (1 when unsharded)."""
-    ctx = _current
+    ctx = _current.get()
     if ctx is None:
         return 1
     size = 1
@@ -80,7 +109,7 @@ def local_rows(n: int) -> int:
 
 
 def _spec(dims) -> P:
-    ctx = _current
+    ctx = _current.get()
     return P(*[ctx.peer_axes if d is PEER else None for d in dims])
 
 
@@ -89,7 +118,7 @@ def shard_kernel(fn, in_specs, out_specs):
     per-array dim tuples using ``PEER`` for the sharded peer dimension and
     None for replicated dims (an all-``None`` tuple replicates the whole
     array — the table inputs). Must only be called with a context active."""
-    ctx = _current
+    ctx = _current.get()
     assert ctx is not None, "shard_kernel outside a kernel_mesh context"
     ins = tuple(_spec(s) for s in in_specs)
     outs = tuple(_spec(s) for s in out_specs)

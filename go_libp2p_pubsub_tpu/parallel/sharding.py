@@ -85,6 +85,7 @@ def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
         msg_ignored=(1, False), msg_publisher=(1, False),
         have=(2, True), deliver_tick=(2, True), deliver_from=(2, True),
         iwant_pending=(2, True), delivered_total=(0, False),
+        halo_overflow=(0, False),
     )
     assert set(layout) == set(SimState._fields), "layout drifted from SimState"
     assert n % mesh.devices.size == 0, \
@@ -130,7 +131,8 @@ def make_sharded_step(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
              in_shardings=(shardings, tp_sh, key_sh), out_shardings=shardings)
     def _step(state: SimState, tp_arg: TopicParams,
               key: jax.Array) -> SimState:
-        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route):
+        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
+                         capacity_factor=cfg.halo_capacity_factor):
             return step(state, cfg, tp_arg, key)
 
     def sharded_step(state: SimState, key: jax.Array) -> SimState:
@@ -138,10 +140,16 @@ def make_sharded_step(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
         # re-sharding an uncommitted PRNG key with a STATE leaf's spec
         return _step(state, tp, jax.device_put(key, key_sh))
 
-    # pin the jit object alive: the dispatch cache keys on function
-    # identity, and a garbage-collected closure's id() can be REUSED by
-    # the next factory call, hitting a stale executable. Bounded so a
-    # config sweep cannot leak executables without limit.
+    # stale-id protection, both directions: the dispatch cache keys on
+    # function identity, and a garbage-collected closure's id() can be
+    # REUSED by the next factory call, hitting a stale executable.
+    # (a) pin _step to the returned wrapper — a STILL-REFERENCED step can
+    #     never be evicted out from under its caller (the old deque's
+    #     65th-call hazard, round-4 advisor finding);
+    # (b) the bounded deque ALSO retains the last 64 steps so a
+    #     drop-and-recreate config sweep (wrapper rebound each iteration)
+    #     cannot recycle a dead closure's id into a live cache entry.
+    sharded_step._step = _step
     _LIVE_STEPS.append(_step)
     sharded_step.lower = lambda st, k: _step.lower(
         st, tp, jax.device_put(k, key_sh))

@@ -110,6 +110,11 @@ class SimConfig:
     # buckets (scales with devices; capacity-factor assumption on random
     # underlays, overflow poisons rather than drops)
     sharded_route: str = "replicated"
+    # halo bucket capacity over the uniform mean (parallel/halo.py
+    # CAPACITY RULE). 4 covers random underlays ~3x over their measured
+    # worst bucket; clustered underlays must set this to
+    # halo.required_capacity_factor(neighbors, reverse_slot, n_dev)
+    halo_capacity_factor: int = 4
 
     # dtype of the per-hop delivery-event count accumulators
     # (ops/propagate.py, PERF_MODEL.md S3): "uint8" minimizes HBM bytes;

@@ -108,6 +108,13 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
         # compute_scores per tick, as the reference reuses its cache within
         # a heartbeat (gossipsub.go:1375-1381)
         state = churn_edges(state, cfg, tp, k_churn, scores_all=hb.scores_all)
+    from ..parallel.kernel_context import drain_halo_overflow
+    notes = drain_halo_overflow()
+    if notes:
+        # halo-route bucket overflows this tick (parallel/halo.py capacity
+        # rule): the counter makes a poisoned run self-identifying
+        state = state._replace(
+            halo_overflow=state.halo_overflow + sum(notes))
     return state._replace(tick=state.tick + 1)
 
 

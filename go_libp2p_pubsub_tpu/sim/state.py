@@ -106,6 +106,12 @@ class SimState(NamedTuple):
 
     # --- stats accumulated per step (observability) ---
     delivered_total: jnp.ndarray      # scalar int64-ish f32 count
+    halo_overflow: jnp.ndarray        # scalar int32: halo-route bucket
+                                      #   overflows observed (parallel/halo.py
+                                      #   capacity rule). > 0 means routed
+                                      #   trajectories are POISONED — raise
+                                      #   SimConfig.halo_capacity_factor to
+                                      #   required_capacity_factor()'s answer
 
 
 def init_state(cfg: SimConfig, topo: Topology,
@@ -192,4 +198,5 @@ def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
         deliver_from=i32(n, m, fill=-1),
         iwant_pending=i32(n, m, fill=-1),
         delivered_total=jnp.float32(0.0),
+        halo_overflow=jnp.int32(0),
     )

@@ -57,7 +57,8 @@ _SLOW = {
                          "test_sharded_pallas_kernels_match_unsharded",
                          "test_sharded_sort_mode_matches_unsharded",
                          "test_sharded_halo_route_matches_unsharded",
-                         "test_sharded_halo_2d_mesh_and_multigroup"),
+                         "test_sharded_halo_2d_mesh_and_multigroup",
+                         "test_halo_overflow_counter_fires_on_starved_capacity"),
     "test_sim_control.py": ("TestFanout", "TestGraftFloodPenalty"),
     "test_sim_engine.py": ("test_negative_score_peer_gets_pruned",
                            "TestBackoff",

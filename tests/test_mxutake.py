@@ -1,0 +1,44 @@
+"""The two-level gather-free table take (ops/mxutake.py) must be exact.
+
+Interpret-mode parity is the CPU-tier contract; native lowering is probed
+by scripts/tpu_kernel_smoke.py on live windows. Exactness matters more
+than usual here: the select rides bf16 one-hot matmuls, legal ONLY because
+u8 chunks (<=255) are exact in bf16 and each dot row has exactly one
+nonzero term — these tests would catch any chunking/padding mistake that
+breaks that argument."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.ops.mxutake import (
+    take_words_twolevel,
+    take_words_twolevel_ref,
+)
+
+
+@pytest.mark.parametrize("n,r,bg", [
+    (256, 512, 512),      # single grid step
+    (1024, 2048, 512),    # multi grid step
+    (1000, 512, 512),     # N not a multiple of 128 (pad path)
+    (128, 128, 128),      # one block exactly
+])
+def test_twolevel_take_exact(n, r, bg):
+    rng = np.random.default_rng(n + r)
+    x = jnp.asarray(rng.integers(0, 2**32, (2, n), dtype=np.uint64),
+                    jnp.uint32)
+    idx = jnp.asarray(rng.integers(0, n, (r,)), jnp.int32)
+    got = np.asarray(take_words_twolevel(x, idx, block_g=bg, interpret=True))
+    np.testing.assert_array_equal(got, np.asarray(take_words_twolevel_ref(x, idx)))
+
+
+def test_twolevel_take_extreme_values():
+    """All-ones words and boundary indices: the u8-chunk recombination and
+    the last-block/last-lane selects must be exact."""
+    n = 384
+    x = jnp.stack([jnp.full((n,), 0xFFFFFFFF, jnp.uint32),
+                   jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(0x01010101)])
+    idx = jnp.asarray([0, 127, 128, 255, 256, n - 1, n - 1, 0], jnp.int32)
+    got = np.asarray(take_words_twolevel(x, idx, block_g=8, interpret=True))
+    np.testing.assert_array_equal(got, np.asarray(take_words_twolevel_ref(x, idx)))
