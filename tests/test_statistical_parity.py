@@ -52,8 +52,8 @@ TSP = TopicScoreParams(
     invalid_message_deliveries_decay=0.9)
 
 
-def _run_functional():
-    net = Network()
+def _run_functional(latency=None):
+    net = Network() if latency is None else Network(latency=latency)
     mem = MemoryTracer()
     nodes = []
     for _ in range(N):
@@ -123,7 +123,31 @@ def parity():
     return deg_f, frac_f, lat_f, deg_b, frac_b, lat_b
 
 
+def _assert_parity_bands(deg_f, deg_b, frac_f, lat_f, ctx=""):
+    """The canonical parity bands, shared by the module-fixture run and
+    the ordering-robustness seeds so a band retune cannot silently apply
+    to one site only (bands last retuned in round 4, see
+    test_mesh_degree_distribution_close)."""
+    assert deg_f.min() >= 5 and deg_f.max() <= 12, \
+        f"{ctx}degrees [{deg_f.min()}, {deg_f.max()}]"
+    assert abs(deg_f.mean() - deg_b.mean()) <= 1.0, \
+        f"{ctx}means {deg_f.mean():.2f} vs {deg_b.mean():.2f}"
+    grid = np.arange(0, 14)
+    cdf_f = np.searchsorted(np.sort(deg_f), grid, side="right") / N
+    cdf_b = np.searchsorted(np.sort(deg_b), grid, side="right") / N
+    ks = np.abs(cdf_f - cdf_b).max()
+    assert ks <= 0.15, f"{ctx}KS {ks:.3f}"
+    assert frac_f >= 0.995, f"{ctx}delivery {frac_f:.4f}"
+    assert float(lat_f.mean()) <= 0.25, f"{ctx}latency {lat_f.mean():.3f}"
+
+
 class TestStatisticalParity:
+    def test_canonical_run_passes_shared_bands(self, parity):
+        """The canonical run must satisfy the SAME shared band helper the
+        ordering-robustness seeds use — one band definition, two users."""
+        deg_f, frac_f, lat_f, deg_b, _, _ = parity
+        _assert_parity_bands(deg_f, deg_b, frac_f, lat_f)
+
     def test_mesh_degree_bounds(self, parity):
         deg_f, _, _, deg_b, _, _ = parity
         cfg_d, cfg_dlo, cfg_dhi = 6, 5, 12
@@ -166,3 +190,30 @@ class TestStatisticalParity:
         assert mean_f_ticks <= 0.25, f"functional latency {mean_f_ticks:.3f}"
         assert lat_b <= 0.25, f"batched latency {lat_b:.3f}"
         assert abs(mean_f_ticks - lat_b) <= 0.25
+
+
+class TestOrderingRobustness:
+    """The reference explores many same-tick event orderings per run — a
+    reader goroutine per stream (comm.go:44-99) and deliberate
+    map-iteration shuffles (gossipsub.go:1954-1973) — while the functional
+    runtime serializes every event on one (time, seq) heap. These runs
+    perturb same-tick RPC arrival order with seeded random PER-SEND
+    latency jitter (each send samples its own delay, so concurrent RPCs
+    interleave differently per seed) and assert the statistical-parity
+    bands still hold: the parity conclusions are properties of the
+    protocol, not artifacts of one canonical event order the Go router
+    never guarantees (VERDICT r4 item 7)."""
+
+    @pytest.mark.parametrize("seed", [7, 23])
+    def test_bands_hold_under_shuffled_arrival_order(self, seed, parity):
+        _, _, _, deg_b, frac_b, _ = parity
+        rng = np.random.default_rng(seed)
+
+        def jitter(a, b):
+            # sub-tick spread around the default 1 ms wire latency:
+            # reorders every same-tick burst without crossing heartbeats
+            return 0.0005 + float(rng.random()) * 0.0015
+
+        _, deg_f, frac_f, lat_f = _run_functional(latency=jitter)
+        _assert_parity_bands(deg_f, deg_b, frac_f, lat_f,
+                             ctx=f"seed {seed}: ")

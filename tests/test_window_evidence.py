@@ -1,0 +1,129 @@
+"""Machine-check the TPU window-evidence chain (VERDICT r4 item 8).
+
+The repo's on-chip numbers live in committed logs under
+``tpu_watch_results/`` and are QUOTED in BASELINE.md's config table. Two
+things may not drift silently:
+
+1. every promoted bench log line must actually say ``"platform": "tpu"``
+   (the watcher's promotion rule — a CPU-fallback log must never pass as
+   chip evidence; directories carrying a PLATFORM_UNVERIFIED marker are
+   exempt because they are explicitly quarantined);
+2. every bold ``**X hb/s**`` figure in BASELINE.md's table must match a
+   promoted log line for its config, to the quoted precision.
+"""
+
+import json
+import os
+import re
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "tpu_watch_results")
+
+# BASELINE.md table row label fragments -> bench config metric names
+ROW_CONFIGS = {
+    "1k-peer single-topic": ["1k_single_topic"],
+    "Ethereum beacon": ["10k_beacon"],
+    "peer_gater + churn": ["50k_churn_gater_px"],
+    "20% sybils": ["100k_sybil20"],
+    "floodsub / randomsub / gossipsub sweep":
+        ["100k_floodsub", "100k_randomsub", "100k_gossipsub_sweep"],
+    "default gossipsub (headline)": ["100k_default"],
+}
+
+
+def _promoted_logs():
+    logs = []
+    if not os.path.isdir(RESULTS):
+        return logs
+    for d in sorted(os.listdir(RESULTS)):
+        full = os.path.join(RESULTS, d)
+        if not os.path.isdir(full) or \
+                os.path.exists(os.path.join(full, "PLATFORM_UNVERIFIED")):
+            continue
+        for f in sorted(os.listdir(full)):
+            if f.startswith("bench") and f.endswith(".log"):
+                logs.append(os.path.join(full, f))
+    return logs
+
+
+def _metric_lines(path):
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "metric" in rec and "value" in rec:
+                out.append(rec)
+    return out
+
+
+def test_promoted_bench_logs_are_all_tpu():
+    logs = _promoted_logs()
+    assert logs, "no promoted bench logs under tpu_watch_results/"
+    for path in logs:
+        recs = _metric_lines(path)
+        assert recs, f"{path}: no metric lines"
+        for rec in recs:
+            assert rec.get("platform") == "tpu", \
+                f"{path}: non-TPU metric line promoted as chip evidence: " \
+                f"{rec['metric']}"
+
+
+def _log_values():
+    """config name -> set of promoted values across all window logs."""
+    vals = {}
+    for path in _promoted_logs():
+        for rec in _metric_lines(path):
+            m = re.match(r"network_heartbeats_per_sec@(\w+?)\[", rec["metric"])
+            if m:
+                vals.setdefault(m.group(1), set()).add(float(rec["value"]))
+    return vals
+
+
+def _quoted_matches(quoted: float, measured: set) -> bool:
+    """A quoted figure matches if some measured value rounds to it at the
+    quoted precision (29.9 quotes 29.88; 1.81 quotes 1.81)."""
+    digits = len(str(quoted).split(".")[1]) if "." in str(quoted) else 0
+    return any(round(v, digits) == quoted for v in measured)
+
+
+def test_baseline_table_numbers_come_from_promoted_logs():
+    vals = _log_values()
+    assert vals, "no promoted metric values found"
+    table = open(os.path.join(REPO, "BASELINE.md")).read()
+    checked = 0
+    for line in table.splitlines():
+        for frag, configs in ROW_CONFIGS.items():
+            if frag not in line:
+                continue
+            # bold chip figures: **a hb/s**, **a / b / c hb/s**, **a–b hb/s**
+            for bold in re.findall(r"\*\*([^*]+?)\s*hb/s\*\*", line):
+                nums = [float(x) for x in re.findall(r"\d+(?:\.\d+)?", bold)]
+                if "–" in bold or "-" in bold.strip("0123456789. "):
+                    # a measured range: evidence must EXIST and every
+                    # config value must fall inside it
+                    lo, hi = min(nums), max(nums)
+                    for cfgname in configs:
+                        assert vals.get(cfgname), \
+                            f"range row quotes {cfgname} with no promoted log"
+                        for v in vals[cfgname]:
+                            assert lo <= round(v, 1) <= hi, \
+                                f"{cfgname}: {v} outside quoted {bold!r}"
+                    checked += 1
+                    continue
+                assert len(nums) == len(configs), (line, nums, configs)
+                for cfgname, q in zip(configs, nums):
+                    assert cfgname in vals, f"no promoted log for {cfgname}"
+                    assert _quoted_matches(q, vals[cfgname]), \
+                        f"{cfgname}: quoted {q} not in promoted logs " \
+                        f"{sorted(vals[cfgname])}"
+                    checked += 1
+    assert checked >= 6, f"only {checked} BASELINE figures cross-checked — " \
+        "table format drifted from what this test parses"
