@@ -15,10 +15,20 @@ Configs (BASELINE.json `configs`, built in sim/scenarios.py):
   4. 100k-peer mesh with 20% sybil attackers
   5. 100k-peer floodsub / randomsub / gossipsub propagation sweep
 
+The record is structurally un-losable (VERDICT r5 item 1): the headline
+config runs FIRST, so its number is banked before anything else can time
+out, and its JSON line is RE-EMITTED last to preserve the driver's
+single-line stdout parse; BENCH_TOTAL_BUDGET (seconds, default 1200)
+degrades repeats 3->1 on configs running behind the per-config schedule
+rather than ever dropping a config.
+
 Env overrides: BENCH_N (peers for the headline config, default 100_000),
+BENCH_MAX_N (cap on EVERY scenario's peer count — reduced-N CPU contract
+runs; keep >= 128 so degree/k_slots defaults stay valid),
 BENCH_TICKS (in-graph window length; default per scenario, TICKS_DEFAULT),
 BENCH_REPEATS (measured windows per config, median reported; default 3),
-BENCH_SCENARIOS (comma list to filter; "headline" names the final line).
+BENCH_TOTAL_BUDGET (whole-suite seconds budget, default 1200),
+BENCH_SCENARIOS (comma list to filter; "headline" names the 100k default).
 """
 
 import json
@@ -53,7 +63,9 @@ def _fetch_rtt():
     return statistics.median(samples)
 
 
-def bench_one(name, cfg, tp, st, ticks, repeats):
+def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
+    """Run one config and print its JSON metric line; returns the line so
+    callers can re-emit the headline last (the one-line-parse contract)."""
     import jax
     import numpy as np
     from go_libp2p_pubsub_tpu.sim.engine import (
@@ -85,7 +97,7 @@ def bench_one(name, cfg, tp, st, ticks, repeats):
 
     hbps = statistics.median(rates)
     platform = jax.devices()[0].platform
-    print(json.dumps({
+    line = json.dumps({
         "metric": f"network_heartbeats_per_sec@{name}[{platform}]",
         "value": round(hbps, 2),
         "unit": "heartbeats/s",
@@ -100,13 +112,18 @@ def bench_one(name, cfg, tp, st, ticks, repeats):
         "mean_delivery_latency_ticks": round(
             float(delivery_latency_ticks(st, cfg)), 3),
         "n_peers": cfg.n_peers,
-    }), flush=True)
+    })
+    print(line, flush=True)
+    return line
 
 
 NAMES = ["1k_single_topic", "10k_beacon", "50k_churn_gater_px",
          "100k_sybil20", "100k_floodsub", "100k_randomsub",
-         "100k_gossipsub_sweep", "headline"]  # headline last: a single-line
-                                              # parse of stdout picks it up
+         "100k_gossipsub_sweep", "headline"]
+# execution order puts headline FIRST (banked before anything can time
+# out — losing it cost round 5 its record, VERDICT r5 weak #2) and its
+# line is re-emitted LAST so the driver's single-line stdout parse still
+# picks it up
 
 
 # in-graph window length per scenario when BENCH_TICKS is unset: the whole
@@ -118,13 +135,18 @@ NAMES = ["1k_single_topic", "10k_beacon", "50k_churn_gater_px",
 TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60}
 
 
-def run_scenario(name: str) -> None:
+def run_scenario(name: str) -> str | None:
     from go_libp2p_pubsub_tpu.sim import scenarios
 
-    n = int(os.environ.get("BENCH_N", 100_000))
     env_ticks = os.environ.get("BENCH_TICKS")
     ticks = int(env_ticks) if env_ticks else TICKS_DEFAULT.get(name, 10)
     repeats = max(1, int(os.environ.get("BENCH_REPEATS", 3)))
+
+    def _cap_n(default_n: int) -> int:
+        # BENCH_MAX_N: reduced-N contract runs exercise the WHOLE 8-config
+        # suite on CPU within the total budget (tests/test_bench_contract)
+        cap = os.environ.get("BENCH_MAX_N")
+        return min(default_n, int(cap)) if cap else default_n
 
     def headline():
         from __graft_entry__ import _build
@@ -132,18 +154,23 @@ def run_scenario(name: str) -> None:
         # needs k > Dhi=12 headroom, and every edge-slot op (sorts,
         # selections, accumulators) scales with N*K — k=16 is the same
         # simulated network at 2x less padding than the historical k=32
-        return _build(n_peers=n,
+        return _build(n_peers=_headline_n(),
                       k_slots=int(os.environ.get("BENCH_K", 32)),
                       degree=12, msg_window=64, publishers=8)
 
     builders = {
-        "1k_single_topic": scenarios.single_topic_1k,
-        "10k_beacon": scenarios.beacon_10k,
-        "50k_churn_gater_px": scenarios.churn_50k,
-        "100k_sybil20": scenarios.sybil_100k,
-        "100k_floodsub": lambda: scenarios.router_sweep_100k("floodsub"),
-        "100k_randomsub": lambda: scenarios.router_sweep_100k("randomsub"),
-        "100k_gossipsub_sweep": lambda: scenarios.router_sweep_100k("gossipsub"),
+        "1k_single_topic":
+            lambda: scenarios.single_topic_1k(n_peers=_cap_n(1024)),
+        "10k_beacon": lambda: scenarios.beacon_10k(n_peers=_cap_n(10_000)),
+        "50k_churn_gater_px":
+            lambda: scenarios.churn_50k(n_peers=_cap_n(50_000)),
+        "100k_sybil20": lambda: scenarios.sybil_100k(n_peers=_cap_n(100_000)),
+        "100k_floodsub": lambda: scenarios.router_sweep_100k(
+            "floodsub", n_peers=_cap_n(100_000)),
+        "100k_randomsub": lambda: scenarios.router_sweep_100k(
+            "randomsub", n_peers=_cap_n(100_000)),
+        "100k_gossipsub_sweep": lambda: scenarios.router_sweep_100k(
+            "gossipsub", n_peers=_cap_n(100_000)),
         "headline": headline,
     }
     assert set(builders) == set(NAMES), "scenario registry drifted from NAMES"
@@ -153,12 +180,29 @@ def run_scenario(name: str) -> None:
         # formulation sweep knob for scripts/tpu_recheck.sh (ops/permgather)
         import dataclasses
         import jax.numpy as jnp
-        from go_libp2p_pubsub_tpu.ops.permgather import resolve_mode
+        from go_libp2p_pubsub_tpu.ops.permgather import (
+            resolve_mode, resolve_words_mode)
         cfg = dataclasses.replace(cfg, edge_gather_mode=mode)
         print(json.dumps({
             "info": "edge_gather sweep", "requested": mode,
             "resolved": resolve_mode(mode, jnp.uint32, cfg.n_peers,
-                                     cfg.k_slots)}), flush=True)
+                                     cfg.k_slots),
+            # the word-table gathers resolve separately — "mxu" rides them
+            # while the generic payload permute degrades to scalar
+            "resolved_words": resolve_words_mode(
+                mode, (cfg.msg_window + 31) // 32, cfg.n_peers,
+                cfg.k_slots)}), flush=True)
+    hm = os.environ.get("GRAFT_HOP_MODE")
+    if hm:
+        # fused-hop sweep knob (ops/hopkernel.py): xla | pallas | pallas-mxu
+        import dataclasses
+        from go_libp2p_pubsub_tpu.ops.hopkernel import resolve_hop_mode
+        cfg = dataclasses.replace(cfg, hop_mode=hm)
+        print(json.dumps({
+            "info": "hop mode sweep", "requested": hm,
+            "resolved": resolve_hop_mode(
+                hm, cfg, (cfg.msg_window + 31) // 32, cfg.n_peers,
+                cfg.k_slots)}), flush=True)
     sel = os.environ.get("GRAFT_SELECTION")
     if sel:
         # selection-kernel sweep knob (ops/selection.py)
@@ -173,12 +217,22 @@ def run_scenario(name: str) -> None:
         cfg = dataclasses.replace(cfg, count_dtype=cdt)
         print(json.dumps({"info": "count dtype sweep", "requested": cdt}),
               flush=True)
-    bench_one(_label(name), cfg, tp, st, ticks, repeats)
+    return bench_one(_label(name), cfg, tp, st, ticks, repeats)
+
+
+def _headline_n() -> int:
+    """The peer count the headline config ACTUALLY builds: BENCH_N under
+    the BENCH_MAX_N cap. Shared by the builder and _label so a capped
+    reduced-N headline can never be banked (or cited by the
+    window-evidence chain) under the full-N label."""
+    n = int(os.environ.get("BENCH_N", 100_000))
+    cap = os.environ.get("BENCH_MAX_N")
+    return min(n, int(cap)) if cap else n
 
 
 def _label(name: str) -> str:
     if name == "headline":
-        return f"{int(os.environ.get('BENCH_N', 100_000)) // 1000}k_default"
+        return f"{_headline_n() // 1000}k_default"
     return name
 
 
@@ -191,12 +245,36 @@ def _probe_default_platform() -> bool:
     return probe_default_platform()[0]
 
 
+def _ordered(names: list) -> list:
+    """Headline FIRST — banked before any later config can eat the budget
+    (VERDICT r5: headline-last made the north-star number the timeout's
+    first casualty); the re-emit below restores the headline-last parse."""
+    return [s for s in names if s == "headline"] + \
+        [s for s in names if s != "headline"]
+
+
+def _is_headline_line(line: str) -> bool:
+    prefix = f"network_heartbeats_per_sec@{_label('headline')}"
+    try:
+        return str(json.loads(line).get("metric", "")).startswith(prefix)
+    except json.JSONDecodeError:
+        return False
+
+
 def main() -> None:
     only = os.environ.get("BENCH_SCENARIOS")
-    names = [s for s in NAMES if not only or s in set(only.split(","))]
+    names = _ordered([s for s in NAMES
+                      if not only or s in set(only.split(","))])
+    budget = float(os.environ.get("BENCH_TOTAL_BUDGET", 1200))
+    t_start = time.perf_counter()
+    headline_line = None
     if os.environ.get("BENCH_IN_PROC"):
         for name in names:
-            run_scenario(name)
+            line = run_scenario(name)
+            if name == "headline" and line and len(names) > 1:
+                headline_line = line
+        if headline_line:
+            print(headline_line, flush=True)
         return
     def cpu_fallback_env():
         from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
@@ -215,21 +293,44 @@ def main() -> None:
         fallback_env = cpu_fallback_env()
     # one subprocess per scenario: a platform slowdown or OOM in one config
     # cannot taint the others' measurements
-    for name in names:
+    for i, name in enumerate(names):
+        elapsed = time.perf_counter() - t_start
+        remaining = budget - elapsed
+        # budget pressure: when the remaining budget per remaining config
+        # drops below HALF the uniform share, degrade repeats 3 -> 1 for
+        # this config rather than dropping it (a config is NEVER skipped —
+        # every scenario emits a line, metric or error). Half-share, not a
+        # cumulative linear schedule: the deliberately-expensive headline
+        # runs first and must not push the cheap configs behind it down to
+        # 1 repeat while plenty of budget remains for them.
+        degrade = i > 0 and \
+            remaining < (len(names) - i) * budget / (2 * len(names))
+        budget_env = {}
+        if degrade and int(os.environ.get("BENCH_REPEATS", 3)) > 1:
+            budget_env["BENCH_REPEATS"] = "1"
+            print(json.dumps({
+                "info": "budget degrade", "scenario": _label(name),
+                "elapsed_s": round(elapsed, 1), "budget_s": budget,
+                "repeats": 1}), flush=True)
+        scenario_timeout = int(min(
+            float(os.environ.get("BENCH_TIMEOUT", 900)),
+            max(60.0, remaining)))
         attempts = 0
         while True:
             attempts += 1
             env = dict(os.environ, BENCH_SCENARIOS=name, BENCH_IN_PROC="1",
-                       **fallback_env)
+                       **fallback_env, **budget_env)
             err = ""
             try:
                 res = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)], env=env,
                     capture_output=True, text=True,
-                    timeout=int(os.environ.get("BENCH_TIMEOUT", 900)))
+                    timeout=scenario_timeout)
                 for line in res.stdout.splitlines():
                     if line.startswith("{"):
                         print(line, flush=True)
+                        if name == "headline" and _is_headline_line(line):
+                            headline_line = line
                 if res.returncode != 0:
                     err = res.stderr.strip()[-300:] or f"rc={res.returncode}"
             except subprocess.TimeoutExpired:
@@ -245,10 +346,20 @@ def main() -> None:
                 continue
             break
         if err:
-            print(json.dumps({
+            err_line = json.dumps({
                 "metric": f"network_heartbeats_per_sec@{_label(name)}",
                 "value": 0.0, "unit": "heartbeats/s",
-                "vs_baseline": 0.0, "error": err}), flush=True)
+                "vs_baseline": 0.0, "error": err})
+            print(err_line, flush=True)
+            if name == "headline" and headline_line is None:
+                # even a FAILED headline re-emits last: the driver's
+                # single-line parse must land on the headline's own line
+                # (error and all), never on another config's metric
+                headline_line = err_line
+    if headline_line and len(names) > 1:
+        # re-emit the banked headline line LAST: the driver's single-line
+        # stdout parse still lands on the north-star number
+        print(headline_line, flush=True)
 
 
 if __name__ == "__main__":

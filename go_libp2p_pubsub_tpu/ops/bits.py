@@ -123,7 +123,12 @@ def prefix_count_words(packed: jnp.ndarray, k: int,
     anyway (the budgeted-IWANT scan masks packed offer words per step;
     re-packing its unpacked view would pay an O(N*M) pack per scan step)."""
     w = n_words(k)
-    assert packed.shape[-1] == w, (packed.shape, k)
+    if packed.shape[-1] != w:
+        # not assert: -O must not strip the packed-width contract guard —
+        # a wrong-width caller would get silently wrong prefix counts
+        raise ValueError(
+            f"prefix_count_words: packed shape {packed.shape} does not "
+            f"carry ceil({k}/32)={w} words on the last axis")
     kidx = jnp.arange(k)
     word_of = kidx // 32
     nbits = (kidx % 32).astype(U32) + (U32(0) if exclusive else U32(1))

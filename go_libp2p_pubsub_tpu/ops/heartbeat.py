@@ -70,7 +70,10 @@ def edge_gather_packed(masks: list, state: SimState,
     the edge involution (permgather.edge_sort_key — fastest measured on
     real TPU); ``pallas`` packs all B planes x K slots into a
     [N, ceil(B*K/32)] u32 bit-table pinned in VMEM (PERF_MODEL.md S2 —
-    blocked from auto by the Mosaic gather wall); the others build
+    blocked from auto by the Mosaic gather wall); ``mxu`` routes the same
+    bit-table through the gather-free two-level MXU take
+    (permgather._edge_table_mxu — the one formulation with no gather op at
+    all, so the Mosaic wall cannot block it); the others build
     per-32-plane [N, K] u32 payloads routed through
     ops/permgather.permutation_gather.
 
@@ -85,7 +88,11 @@ def edge_gather_packed(masks: list, state: SimState,
     garbage the consumers mask, exactly like gather_words' sort path."""
     from ..parallel.kernel_context import current_kernel_mesh
     from .permgather import (
-        _edge_table_pallas, edge_sort_key, resolve_edge_packed_mode)
+        _edge_table_mxu,
+        _edge_table_pallas,
+        edge_sort_key,
+        resolve_edge_packed_mode,
+    )
 
     n, t, k = masks[0].shape
     planes = jnp.concatenate(masks, axis=1)                    # [N, B, K]
@@ -107,7 +114,12 @@ def edge_gather_packed(masks: list, state: SimState,
     # with neighbors[n, k] == j — the receiver view, [N, K] per row
     extra_lanes = [jnp.broadcast_to(tab[i][:, None], (n, k))
                    for tab in extra_words for i in range(tab.shape[0])]
-    if mode == "pallas":
+    if mode == "mxu":
+        from .bits import pack_bool
+        table = pack_bool(planes.reshape(n, b * k))        # [N, ceil(BK/32)]
+        groups = _edge_table_mxu(table, jn, rk, b,
+                                 interpret=jax.default_backend() != "tpu")
+    elif mode == "pallas":
         from functools import partial
 
         from ..parallel.kernel_context import (
@@ -161,10 +173,17 @@ def edge_gather_packed(masks: list, state: SimState,
     results = [flat[:, i * t:(i + 1) * t, :] for i in range(len(masks))]
     if not has_extras:
         return results
+    # invalid slots carry sort garbage on the extra lanes exactly like the
+    # mask groups did before their '& valid' above — zero them with a
+    # word-AND so no consumer can ever read a down edge's garbage words
+    # (ADVICE r5: the old contract leaned on churn clearing iwant_pending
+    # for downed edges, an implicit cross-module invariant)
+    vmask = jnp.where(valid[:, 0, :].T, U32(0xFFFFFFFF), U32(0))   # [K, N]
     extras, ofs = [], 0
     for tab in extra_words:
         wt = tab.shape[0]
-        extras.append(jnp.stack([extra_out[ofs + i].T for i in range(wt)]))
+        extras.append(jnp.stack(
+            [extra_out[ofs + i].T for i in range(wt)]) & vmask[None])
         ofs += wt                                     # [W_i, K, N] each
     return results, extras
 
@@ -186,7 +205,10 @@ class HeartbeatOut(NamedTuple):
                              # extra_words tables, routed on the final
                              # exchange's variadic sort (engine.step merges
                              # forward_tick's IWANT answer gather here — one
-                             # fewer serially-dependent sort per tick)
+                             # fewer serially-dependent sort per tick).
+                             # Invalid slots are word-ANDed to 0 by
+                             # edge_gather_packed, so consumers read zeros —
+                             # never routing garbage — on down edges
 
 
 def heartbeat(state: SimState, cfg: SimConfig, tp: TopicParams,

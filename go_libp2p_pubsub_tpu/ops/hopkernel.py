@@ -41,9 +41,18 @@ from .bits import U32, pack_words, prefix_count_words, unpack_words
 from .permgather import _PALLAS_VMEM_PAYLOAD_BYTES, _block_rows
 
 
-def _take_rows(tab, nbrb, w, k):
-    """In-kernel neighbor gather of a VMEM-pinned [W, N] table -> [W, BN, K]."""
-    g = jnp.take(tab, nbrb.reshape(-1), axis=1)
+def _take_rows(tab, nbrb, w, k, gather="take"):
+    """In-kernel neighbor gather of a VMEM-pinned [W, N] table -> [W, BN, K].
+
+    ``gather="take"`` is the jnp.take lowering (Mosaic refuses it above 128
+    lanes — the gather wall); ``"mxu"`` is the gather-free two-level
+    one-hot select (ops/mxutake.take_words_onehot), the formulation the
+    ``pallas-mxu`` hop mode exists to A/B on a live window."""
+    if gather == "mxu":
+        from .mxutake import take_words_onehot
+        g = take_words_onehot(tab, nbrb.reshape(-1))
+    else:
+        g = jnp.take(tab, nbrb.reshape(-1), axis=1)
     return g.reshape(w, nbrb.shape[0], k)
 
 
@@ -76,12 +85,17 @@ def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
     vector register only), so the VMEM-table design is not compilable on
     real v5e today. Explicit ``pallas`` stays available for interpret-mode
     tests, the virtual-mesh sharded path, and future Mosaic versions;
-    config eligibility still applies to it."""
-    if mode not in ("auto", "xla", "pallas"):
+    ``pallas-mxu`` is the same fused design with every in-kernel gather
+    rewritten as the gather-free two-level one-hot select (mxutake.py) —
+    the wall-dodging variant the next live window A/Bs natively. Config
+    eligibility applies to both Pallas variants; ``pallas-mxu``
+    additionally needs a lane-aligned peer count (the in-kernel chunk
+    reshape, take_words_onehot)."""
+    if mode not in ("auto", "xla", "pallas", "pallas-mxu"):
         raise ValueError(f"unknown hop_mode {mode!r}")
     if mode == "auto":
         mode = "xla"
-    if mode == "pallas":
+    if mode in ("pallas", "pallas-mxu"):
         if (cfg.gater_enabled or cfg.record_provenance
                 or cfg.edge_queue_cap > 0 or cfg.validation_queue_cap > 0
                 or (cfg.flood_publish and cfg.router == "gossipsub")
@@ -92,27 +106,33 @@ def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
         if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 4 * w * k * 4) is None):
             return "xla"
+        if mode == "pallas-mxu" and n % 128 != 0:
+            return "xla"
     return mode
 
 
 def resolve_emit_mode(mode: str, w: int, n: int, k: int) -> str:
     """Gossip-emit formulation: the fused kernel has no config
     restrictions (the emit step has no cap/gater/provenance interaction) —
-    only backend and VMEM-feasibility gates."""
-    if mode not in ("auto", "xla", "pallas"):
+    only backend and VMEM-feasibility gates (plus lane alignment for
+    ``pallas-mxu``, as in resolve_hop_mode)."""
+    if mode not in ("auto", "xla", "pallas", "pallas-mxu"):
         raise ValueError(f"unknown hop_mode {mode!r}")
     if mode == "auto":
         mode = "xla"               # see resolve_hop_mode: Mosaic gather wall
-    if mode == "pallas":
+    if mode in ("pallas", "pallas-mxu"):
         if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 4 * w * k * 4) is None):
+            return "xla"
+        if mode == "pallas-mxu" and n % 128 != 0:
             return "xla"
     return mode
 
 
-@functools.partial(jax.jit, static_argnames=("m", "budget", "interpret"))
+@functools.partial(jax.jit,
+                   static_argnames=("m", "budget", "gather", "interpret"))
 def emit_pallas(window, have, gossip_u8, topic_bits, nbr, m, budget,
-                interpret=False) -> jnp.ndarray:
+                gather="take", interpret=False) -> jnp.ndarray:
     """Fused IHAVE->IWANT chooser (PERF_MODEL.md S7): gossipsub.go:654-676.
 
     window: [W, N] u32 sender gossip-window table (VMEM-pinned);
@@ -138,7 +158,7 @@ def emit_pallas(window, have, gossip_u8, topic_bits, nbr, m, budget,
     def kernel(win_ref, have_ref, gos_ref, tb_ref, nbr_ref, out_ref):
         tab = win_ref[:]                                  # [W, N] in VMEM
         nbrb = nbr_ref[:]                                 # [BN, K]
-        g = _take_rows(tab, nbrb, w, k)                   # [W, BN, K]
+        g = _take_rows(tab, nbrb, w, k, gather)           # [W, BN, K]
         tb = tb_ref[:]
         off = g & _expand_topic(gos_ref[:], tb, g)
 
@@ -185,9 +205,10 @@ class ResolveOut(NamedTuple):
     broken: jnp.ndarray       # [K, N] uint8 broken-promise counts (P7)
 
 
-@functools.partial(jax.jit, static_argnames=("m", "interpret"))
+@functools.partial(jax.jit, static_argnames=("m", "gather", "interpret"))
 def iwant_resolve_pallas(pend, answers, have, vm, inv_n, alive, data_ok_u8,
-                         topic_bits, nbr, m, interpret=False) -> ResolveOut:
+                         topic_bits, nbr, m, gather="take",
+                         interpret=False) -> ResolveOut:
     """Fused IWANT resolution (PERF_MODEL.md S6): gossipsub.go:698-739 +
     the broken-promise accounting of gossip_tracer.go:79-115.
 
@@ -229,7 +250,11 @@ def iwant_resolve_pallas(pend, answers, have, vm, inv_n, alive, data_ok_u8,
         got_valid_any = jnp.zeros_like(have_b)
         for ki in range(k):
             asked = pack(pend_b == ki) & alive_b          # [W, BN]
-            ans_k = jnp.take(tab, nbrb[:, ki], axis=1)    # [W, BN]
+            if gather == "mxu":
+                from .mxutake import take_words_onehot
+                ans_k = take_words_onehot(tab, nbrb[:, ki])   # [W, BN]
+            else:
+                ans_k = jnp.take(tab, nbrb[:, ki], axis=1)    # [W, BN]
             adm = jnp.where((ok_b[:, ki] != 0)[None, :],
                             U32(0xFFFFFFFF), U32(0))
             got = asked & ans_k & ~have_b & adm
@@ -284,10 +309,10 @@ def iwant_resolve_pallas(pend, answers, have, vm, inv_n, alive, data_ok_u8,
     return ResolveOut(*outs)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("gather", "interpret"))
 def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
                valid_msg, nbr, fwd_mask_u8, mesh_u8, topic_bits,
-               nv, ni, dup, interpret=False) -> HopOut:
+               nv, ni, dup, gather="take", interpret=False) -> HopOut:
     """One fused forwarding hop.
 
     frontier/have/dlv/dlv_new/vm/inv_n/window_old: [W, N] u32 packed tables
@@ -312,7 +337,7 @@ def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
                out_nv, out_ni, out_dup):
         tab = fro_ref[:]                                  # [W, N] in VMEM
         nbrb = nbr_ref[:]                                 # [BN, K]
-        g = _take_rows(tab, nbrb, w, k)                   # [W, BN, K] offered
+        g = _take_rows(tab, nbrb, w, k, gather)           # [W, BN, K] offered
         tb = tb_ref[:]                                    # [T, W]
         allowed = _expand_topic(fwd_ref[:], tb, g)
         mesh_eb = _expand_topic(mesh_ref[:], tb, g)
@@ -426,9 +451,9 @@ _REPL2 = (None, None)       # replicated 2-D (tables, topic bits)
 
 
 def emit_dispatch(window, have, gossip_u8, topic_bits, nbr, m, budget,
-                  interpret=False):
+                  gather="take", interpret=False):
     """emit_pallas, shard_map-wrapped when a kernel mesh is active."""
-    fn = functools.partial(emit_pallas, m=m, budget=budget,
+    fn = functools.partial(emit_pallas, m=m, budget=budget, gather=gather,
                            interpret=interpret)
     if current_kernel_mesh() is None:
         return fn(window, have, gossip_u8, topic_bits, nbr)
@@ -441,9 +466,10 @@ def emit_dispatch(window, have, gossip_u8, topic_bits, nbr, m, budget,
 
 def iwant_resolve_dispatch(pend, answers, have, vm, inv_n, alive,
                            data_ok_u8, topic_bits, nbr, m,
-                           interpret=False) -> ResolveOut:
+                           gather="take", interpret=False) -> ResolveOut:
     """iwant_resolve_pallas, shard_map-wrapped when a kernel mesh is active."""
-    fn = functools.partial(iwant_resolve_pallas, m=m, interpret=interpret)
+    fn = functools.partial(iwant_resolve_pallas, m=m, gather=gather,
+                           interpret=interpret)
     if current_kernel_mesh() is None:
         return fn(pend, answers, have, vm, inv_n, alive, data_ok_u8,
                   topic_bits, nbr)
@@ -458,11 +484,11 @@ def iwant_resolve_dispatch(pend, answers, have, vm, inv_n, alive,
 
 def hop_dispatch(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
                  valid_msg, nbr, fwd_mask_u8, mesh_u8, topic_bits,
-                 nv, ni, dup, interpret=False) -> HopOut:
+                 nv, ni, dup, gather="take", interpret=False) -> HopOut:
     """hop_pallas, shard_map-wrapped when a kernel mesh is active. The
     frontier is the one sender-indexed table; its replication is the whole
     per-hop cross-device exchange (0.8 MB at the 100k headline shape)."""
-    fn = functools.partial(hop_pallas, interpret=interpret)
+    fn = functools.partial(hop_pallas, gather=gather, interpret=interpret)
     if current_kernel_mesh() is None:
         return fn(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
                   valid_msg, nbr, fwd_mask_u8, mesh_u8, topic_bits,

@@ -22,6 +22,13 @@ TPU recheck can measure them head-to-head (scripts/microbench_kernels.py):
   permutation never round-trips HBM at all. Only eligible while the payload
   fits VMEM (N*K*4B <= ~8MB, i.e. <= ~60k peers at K=32); falls back to
   ``rows`` above that.
+- ``mxu``: the gather-free two-level MXU take (ops/mxutake.py) for the
+  WORD-TABLE gathers — one-hot bf16 matmul block select + lane select, no
+  gather op of any width, so it sidesteps the Mosaic 128-lane wall that
+  blocks every ``pallas`` table kernel on current chips. Word-table call
+  sites (gather_words, the packed edge exchange via its bit-table) route
+  through it; the generic [N, K] payload permute degrades to ``scalar``
+  (an N*K-wide one-hot tile would blow VMEM at bench shapes).
 
 ``auto`` resolves to ``scalar`` on CPU and ``rows`` on TPU (the
 measured-safe default until the chip recheck promotes ``pallas``).
@@ -273,11 +280,58 @@ def _edge_table_pallas(table, jn, rk, b_planes, interpret=False):
     )(tab_t, jn_t, rk_t)
 
 
+def _mxu_take_feasible(w: int, n: int) -> bool:
+    """VMEM feasibility of one two-level take over a [w, n] u32 word table:
+    the bf16 chunk planes (8·w·n_pad bytes) + the one-hot tile + the f32
+    rows scratch must fit the payload budget. Layout constants come from
+    ops/mxutake.py so the gate prices exactly what the kernel allocates.
+    Unsharded only — the take is a whole-table kernel, and the sharded
+    step's halo/replicated routes already cover the kernel-mesh case."""
+    from .mxutake import DEFAULT_BLOCK_G, LANES
+    nb = -(-n // LANES)
+    vmem = (w * 4 * nb * LANES * 2          # chunk planes, bf16
+            + DEFAULT_BLOCK_G * nb * 2      # one-hot tile
+            + DEFAULT_BLOCK_G * LANES * 4)  # MXU rows, f32
+    return vmem <= _PALLAS_VMEM_PAYLOAD_BYTES and current_kernel_mesh() is None
+
+
+def _edge_table_mxu(table, jn, rk, b_planes, interpret=False):
+    """Bit-table edge exchange routed through the gather-free two-level MXU
+    take: same [N, ceil(B*K/32)] u32 b-major/slot-minor bit-table contract
+    as ``_edge_table_pallas``, but the per-edge row fetch is
+    ``take_words_twolevel`` (one-hot matmul block select — no gather op of
+    any width, mxutake.py) and the bit extraction runs as plain XLA
+    word-selects. Returns one [N, K] u32 payload per 32-plane group,
+    bit-compatible with every other formulation."""
+    from .mxutake import take_words_twolevel
+
+    n, wb = table.shape
+    nr, k = jn.shape
+    n_groups = (b_planes + 31) // 32
+    u32 = jnp.uint32
+    idx = jn.reshape(-1).astype(jnp.int32)                 # n-major [NR*K]
+    rows = take_words_twolevel(table.T, idx, interpret=interpret)
+    rows = rows.reshape(wb, nr, k)                         # [WB, N, K]
+    pos0 = rk.astype(u32)                                  # bit positions
+    accs = [jnp.zeros((nr, k), u32) for _ in range(n_groups)]
+    for b in range(b_planes):
+        pos = pos0 + u32(b * k)
+        wsel = pos // u32(32)
+        word = jnp.zeros((nr, k), u32)
+        for wi in range(wb):                               # wb is tiny and
+            word = jnp.where(wsel == wi, rows[wi], word)   # static: select
+        bit = (word >> (pos % u32(32))) & u32(1)
+        accs[b // 32] = accs[b // 32] | (bit << u32(b % 32))
+    return accs
+
+
 def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
     """Resolve the packed-edge-exchange formulation (heartbeat
-    edge_gather_packed). ``pallas`` is the bit-table kernel above; TPU
-    ``auto`` picks it (PERF_MODEL.md S2), CPU ``auto`` keeps the scalar
-    per-group gather. Ineligible shapes degrade pallas -> rows."""
+    edge_gather_packed). ``pallas`` is the bit-table kernel above; ``mxu``
+    is the same bit-table routed through the two-level MXU take
+    (_edge_table_mxu); TPU ``auto`` picks sort (PERF_MODEL.md), CPU
+    ``auto`` keeps the scalar per-group gather. Ineligible shapes degrade
+    pallas/mxu -> rows."""
     backend = jax.default_backend()
     if mode == "auto":
         # TPU auto is the sort-permute apply (edge_sort_key docstring:
@@ -285,6 +339,10 @@ def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
         # live-window numbers); Mosaic cannot lower the bit-table kernel's
         # >128-wide VMEM gather (see hopkernel.resolve_hop_mode)
         mode = {"cpu": "scalar", "tpu": "sort"}.get(backend, "rows")
+    if mode == "mxu":
+        wb = (b_planes * k + 31) // 32
+        if not _mxu_take_feasible(wb, n):
+            return "rows"
     if mode == "pallas":
         # table feasibility is GLOBAL n (the whole bit-table pins in VMEM);
         # block feasibility is the per-shard row count under a kernel mesh
@@ -321,6 +379,10 @@ def resolve_words_mode(mode: str, w: int, n: int, k: int,
             mode = "scalar"
     if mode == "sort" and not have_sort_key:
         return "rows"
+    if mode == "mxu":
+        # the two-level take recombines exactly 4 u8 chunk planes per word
+        if itemsize != 4 or not _mxu_take_feasible(w, n):
+            return "rows"
     if mode == "pallas":
         # table + _mosaic_take's table-width index/result temporaries
         if (w * n * (2 * itemsize + 4) > _PALLAS_VMEM_PAYLOAD_BYTES
@@ -359,6 +421,15 @@ def gather_words(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
         planes = unpack_words(x_w, m)                     # [N, M] bool
         rows = planes[nbr]                                # [N, K, M]
         return jnp.transpose(pack_bool(rows), (2, 1, 0))  # [W, K, N]
+    if mode == "mxu":
+        # gather-free two-level MXU take (ops/mxutake.py): k-major flat
+        # indices so the [W, R] take reshapes straight to the [W, K, N]
+        # receiver view
+        from .mxutake import take_words_twolevel
+        idx = nbr.T.reshape(-1).astype(jnp.int32)
+        out = take_words_twolevel(x_w, idx,
+                                  interpret=jax.default_backend() != "tpu")
+        return out.reshape(w, k, nbr.shape[0])
     if mode == "pallas":
         fn = functools.partial(_gather_words_pallas,
                                interpret=jax.default_backend() != "tpu")
@@ -384,6 +455,13 @@ def resolve_mode(mode: str, payload_dtype, n: int, k: int,
     backend = jax.default_backend()
     if mode == "auto":
         mode = "sort" if (backend == "tpu" and have_sort_key) else "scalar"
+    if mode == "mxu":
+        # the two-level take is a WORD-TABLE formulation: flattening the
+        # [N, K] payload into an N*K-wide table would need a block_g x
+        # ceil(NK/128) one-hot tile (~50 MB at the 100k headline) — VMEM
+        # infeasible, so the generic payload permute rides scalar while
+        # the word-table call sites carry the mxu exchange
+        return "scalar"
     if mode == "sort" and not have_sort_key:
         return "scalar"
     if mode == "pallas":

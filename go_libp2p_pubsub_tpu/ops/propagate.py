@@ -336,18 +336,23 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         resolve_hop_mode,
     )
     hop_mode = resolve_hop_mode(cfg.hop_mode, cfg, w, n, k)
+    fused_hop = hop_mode in ("pallas", "pallas-mxu")
+    # pallas-mxu: the fused kernels with in-kernel gathers rewritten as the
+    # gather-free two-level one-hot select (hopkernel._take_rows)
+    hop_gather = "mxu" if hop_mode == "pallas-mxu" else "take"
     # malicious sources never answer IWANTs (the iwantEverything-style actor
     # holds its promises open, gossipsub_spam_test.go:23-133); honest sources
     # answer from their mcache, which rejected/ignored messages never enter
     # (deliver_tick stays NEVER on rejection — validation.go:293-370)
     answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)             # [W,N]
-    if hop_mode == "pallas":
+    if fused_hop:
         # fused resolve (PERF_MODEL.md S6): eligibility (resolve_hop_mode)
         # guarantees the cap/throttle plumbing below is dead here
         r = iwant_resolve_dispatch(
             state.iwant_pending, answer_bits, have_bits, vm, inv_n,
             alive_bits[:, None],
             data_ok.astype(jnp.uint8), topic_bits, nbr, m=m,
+            gather=hop_gather,
             interpret=jax.default_backend() != "tpu")
         got_any, got_valid_any = r.got_any, r.got_valid_any
         behaviour_penalty = state.behaviour_penalty \
@@ -412,7 +417,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # -- step 2: eager forwarding, prop_substeps hops, fully bit-packed --
     fwd_mask = _edge_forward_mask(state, cfg, k_fwd, fwd_send)
     fwd_mask = fwd_mask & data_ok[:, None, :]
-    if hop_mode == "pallas":
+    if fused_hop:
         # the fused kernel expands allowed/mesh planes in VMEM from the
         # uint8 bool planes — no [W,K,N] materialization at all
         fwd_u8 = fwd_mask.astype(jnp.uint8)
@@ -523,7 +528,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         carry0["nv_acc"] = got_valid
 
     def hop(c):
-        if hop_mode == "pallas":
+        if fused_hop:
             # fused kernel (PERF_MODEL.md S4): gather + allowed/mesh
             # expansion + K-prefix winner attribution + uint8 event counts
             # in one VMEM pass; eligibility (resolve_hop_mode) guarantees
@@ -532,6 +537,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                              vm, inv_n, window_old, valid_msg_bits[:, None],
                              nbr, fwd_u8, mesh_u8, topic_bits,
                              c["nv"], c["ni"], c["dup"],
+                             gather=hop_gather,
                              interpret=jax.default_backend() != "tpu")
             out = dict(c)
             out.update(i=c["i"] + 1, frontier=h.new_valid, have=h.have,
@@ -710,7 +716,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         & alive_bits[:, None]
     # malicious peers advertise everything alive (IHAVE flood)
     window_bits = jnp.where(mal[None, :], alive_bits[:, None], window_bits)
-    if resolve_emit_mode(cfg.hop_mode, w, n, k) == "pallas":
+    emit_mode = resolve_emit_mode(cfg.hop_mode, w, n, k)
+    if emit_mode in ("pallas", "pallas-mxu"):
         # fused chooser (PERF_MODEL.md S7): window table in VMEM, budget
         # scan per receiver block; covers budgeted and unbudgeted paths
         # (budget >= M reduces to the lowest-offering-slot choice)
@@ -718,6 +725,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
             window_bits, have_bits, inc_gossip.astype(jnp.uint8),
             topic_bits, nbr, m=m,
             budget=min(cfg.max_iwant_per_tick, m),
+            gather="mxu" if emit_mode == "pallas-mxu" else "take",
             interpret=jax.default_backend() != "tpu")
         return state._replace(iwant_pending=iwant_pending)
     gossip_allowed = _edge_topic_bits(inc_gossip, topic_bits, w)        # [W,K,N]

@@ -80,6 +80,13 @@ def required_capacity_factor(neighbors, reverse_slot, n_dev: int) -> int:
     nbr = np.asarray(neighbors)
     rks = np.asarray(reverse_slot)
     n, k = nbr.shape
+    if n_dev <= 0 or n % n_dev:
+        # fail loudly like the sharded step does: with n % n_dev != 0 the
+        # src/dest device attribution below is wrong and the returned
+        # factor would be silently misleading (ADVICE r5)
+        raise ValueError(
+            f"required_capacity_factor: n_peers={n} must divide evenly "
+            f"over n_dev={n_dev} (the peer sharding asserts the same)")
     nl = n // n_dev
     valid = (nbr >= 0) & (rks >= 0)
     src_dev = np.repeat(np.arange(n) // nl, k).reshape(n, k)

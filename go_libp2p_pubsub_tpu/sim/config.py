@@ -91,7 +91,12 @@ class SimConfig:
     scoring_enabled: bool = True
 
     # reverse-edge permutation gather formulation (ops/permgather.py):
-    # "auto" (backend default) | "scalar" | "rows" | "pallas"
+    # "auto" (backend default) | "scalar" | "rows" | "sort" | "pallas" |
+    # "mxu" — "mxu" routes every word-table gather (hop gathers, IWANT
+    # answer table, the packed edge exchange via its bit-table) through
+    # the gather-free two-level MXU take (ops/mxutake.py), the one
+    # formulation the Mosaic 128-lane gather wall cannot block; the
+    # next TPU window A/Bs sort-vs-mxu with GRAFT_EDGE_GATHER=mxu
     edge_gather_mode: str = "auto"
 
     # masked selection formulation (ops/selection.py):
@@ -99,9 +104,13 @@ class SimConfig:
     selection_mode: str = "auto"
 
     # forwarding-hop formulation (ops/hopkernel.py): "auto" | "xla" |
-    # "pallas" — the fused Pallas hop needs cap-free/gater-free/
-    # provenance-free configs and falls back to the XLA hop otherwise
-    # (auto is xla everywhere: the Mosaic gather wall, resolve_hop_mode)
+    # "pallas" | "pallas-mxu" — the fused Pallas hop needs cap-free/
+    # gater-free/provenance-free configs and falls back to the XLA hop
+    # otherwise (auto is xla everywhere: the Mosaic gather wall,
+    # resolve_hop_mode); "pallas-mxu" is the same fused design with the
+    # in-kernel gathers rewritten as the gather-free two-level one-hot
+    # select (ops/mxutake.py) — the S1-S7 resurrection candidate the next
+    # live window probes natively (GRAFT_HOP_MODE sweep knob in bench.py)
     hop_mode: str = "auto"
 
     # sort-mode routing under a sharded step (parallel/halo.py):

@@ -59,7 +59,9 @@ def _iwant_answer_extras(state: SimState, cfg: SimConfig) -> list | None:
     exchange's variadic sort instead of paying its own serially-dependent
     comparator pass (~13 serial sorts bound the sort-era tick; VERDICT r4
     item 1). Returns the [W, N] answer table to ride along, or None when
-    the formulations don't line up (non-sort modes, fused resolve kernel)."""
+    the formulations don't line up (non-sort modes — mxu included: the
+    two-level take gathers its own answer table — or the fused resolve
+    kernel)."""
     from ..ops.bits import pack_words
     from ..ops.hopkernel import resolve_hop_mode
     from ..ops.permgather import resolve_edge_packed_mode
@@ -67,7 +69,8 @@ def _iwant_answer_extras(state: SimState, cfg: SimConfig) -> list | None:
 
     n, t, k = state.mesh.shape
     w = (cfg.msg_window + 31) // 32
-    if resolve_hop_mode(cfg.hop_mode, cfg, w, n, k) == "pallas":
+    if resolve_hop_mode(cfg.hop_mode, cfg, w, n, k) in ("pallas",
+                                                        "pallas-mxu"):
         return None                  # fused resolve kernel gathers in VMEM
     if resolve_edge_packed_mode(cfg.edge_gather_mode, n, k, 2 * t) != "sort":
         return None

@@ -192,7 +192,15 @@ def main():
     def gw_pallas(wds):
         return gather_words(wds, nbr, m, "pallas")
 
+    # the gather-free two-level MXU take (ops/mxutake.py) — the sort-vs-mxu
+    # A/B datapoint the engine-level GRAFT_EDGE_GATHER=mxu sweep banks
+    mxu_resolved = resolve_words_mode("mxu", w, n, k)
+
+    def gw_mxu(wds):
+        return gather_words(wds, nbr, m, "mxu")
+
     assert bool(jnp.all(gw_pallas(words) == gw_words(words)))
+    assert bool(jnp.all(gw_mxu(words) == gw_words(words)))
     scan_time(gw_words, (gw_words(words), words),
               "msg gather: per-word scalar [W,K,N]")
     scan_time(gw_rows_i8, (gw_rows_i8(planes), planes),
@@ -201,6 +209,8 @@ def main():
               "msg gather: row-major u32 [N,K,W]")
     scan_time(gw_pallas, (gw_pallas(words), words),
               f"msg gather: pallas (resolved: {words_resolved})")
+    scan_time(gw_mxu, (gw_mxu(words), words),
+              f"msg gather: mxu two-level take (resolved: {mxu_resolved})")
 
     # ---------- OR-reduce over K after row gather ----------
     rows_i8 = gw_rows_i8(planes)

@@ -90,6 +90,27 @@ def main() -> int:
               tab_wn & U32(0x0F0F0F0F), jnp.zeros_like(tab_wn),
               jnp.full((w, 1), U32(0xFFFFFFFF)), nbr, planes_u8, planes_u8,
               topic_bits, acc, acc, acc, interpret=i))
+    # --- the pallas-mxu variants: in-kernel gathers rewritten as the
+    # gather-free two-level one-hot select (mxutake.take_words_onehot).
+    # These are the S1-S7 resurrection candidates — if they lower while
+    # the wall repro below still fails, hop_mode="pallas-mxu" is live.
+    check("hop_pallas (gather=mxu)",
+          lambda i: hk.hop_pallas(
+              tab_wn, tab_wn ^ U32(0x55AA55AA), tab_wn & U32(0xFF00FF00),
+              jnp.zeros_like(tab_wn), tab_wn | U32(3),
+              tab_wn & U32(0x0F0F0F0F), jnp.zeros_like(tab_wn),
+              jnp.full((w, 1), U32(0xFFFFFFFF)), nbr, planes_u8, planes_u8,
+              topic_bits, acc, acc, acc, gather="mxu", interpret=i))
+    check("emit_pallas (gather=mxu)",
+          lambda i: hk.emit_pallas(tab_wn, tab_wn ^ U32(0xA5A5A5A5),
+                                   planes_u8, topic_bits, nbr, m=m,
+                                   budget=3, gather="mxu", interpret=i))
+    check("iwant_resolve_pallas (gather=mxu)",
+          lambda i: hk.iwant_resolve_pallas(
+              pend, tab_wn, tab_wn ^ U32(0x33CC33CC), tab_wn | U32(1),
+              tab_wn & U32(0xF0F0F0F0), jnp.full((w, 1), U32(0xFFFFFFFF)),
+              planes_u8[:, 0, :], topic_bits, nbr, m=m, gather="mxu",
+              interpret=i))
     # --- the Mosaic gather wall, distilled (VERDICT r4 item 3) ---------
     # The exact failure that killed the S1-S7 fused kernels: a table
     # lookup wider than one vreg. Re-tested every window; if it ever

@@ -10,6 +10,8 @@ cd "$(dirname "$0")/.."
 mkdir -p /tmp/tpu_recheck
 for step in "bench:python bench.py" \
             "modes_sort:env GRAFT_EDGE_GATHER=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "modes_mxu:env GRAFT_EDGE_GATHER=mxu BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "hop_pallas_mxu:env GRAFT_HOP_MODE=pallas-mxu BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "acc_i32:env GRAFT_COUNT_DTYPE=int32 BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "headline_k16:env BENCH_K=16 BENCH_SCENARIOS=headline python bench.py" \
             "headline_k16_i32:env BENCH_K=16 GRAFT_COUNT_DTYPE=int32 BENCH_SCENARIOS=headline python bench.py" \

@@ -50,6 +50,9 @@ _SLOW = {
     # long engine-trajectory sweeps; op-level parity stays fast
     "test_permgather.py": ("TestEngineTrajectoryParity",
                            "TestShardedStepParity"),
+    # the two acceptance trajectory cases (mxu == sort) stay fast; the
+    # churn+gater+flood degrade-seam sweep is belt-and-braces
+    "test_mxu_mode.py": ("test_mxu_under_churn_and_gater",),
     "test_selection_modes.py": ("TestEngineTrajectoryParity",
                                 "test_count_bound_guard_fires"),
     "test_sharding.py": ("test_sharded_step_matches_unsharded",

@@ -114,3 +114,49 @@ class TestHopKernelParity:
                     dict(flood_publish=True)):
             c = dataclasses.replace(cfg, **bad)
             assert hk.resolve_hop_mode("pallas", c, 2, 102_400, 32) == "xla", bad
+
+    def test_pallas_mxu_resolution_policy(self):
+        import go_libp2p_pubsub_tpu.ops.hopkernel as hk
+        cfg, _, _ = _build()
+        # pallas-mxu resolves at lane-aligned peer counts, config gates
+        # matching pallas; a non-128-multiple N falls back (the in-kernel
+        # chunk-plane reshape, take_words_onehot)
+        assert hk.resolve_hop_mode("pallas-mxu", cfg, 2, 102_400, 32) \
+            == "pallas-mxu"
+        assert hk.resolve_hop_mode("pallas-mxu", cfg, 2, 100_000, 32) == "xla"
+        assert hk.resolve_emit_mode("pallas-mxu", 2, 102_400, 32) \
+            == "pallas-mxu"
+        assert hk.resolve_emit_mode("pallas-mxu", 2, 100_000, 32) == "xla"
+        c = dataclasses.replace(cfg, gater_enabled=True)
+        assert hk.resolve_hop_mode("pallas-mxu", c, 2, 102_400, 32) == "xla"
+        with pytest.raises(ValueError):
+            hk.resolve_hop_mode("mxu", cfg, 2, 1024, 32)
+
+
+class TestPallasMxuParity:
+    """hop_mode="pallas-mxu": the fused kernels with every in-kernel
+    gather rewritten as the gather-free two-level one-hot select
+    (ops/mxutake.take_words_onehot) — the S1-S7 resurrection candidate.
+    Must be bit-identical to the XLA hop at a lane-aligned peer count."""
+
+    def test_full_run_identical(self):
+        cfg, tp, st = _build(n=256)
+        key = jax.random.PRNGKey(7)
+        st_x = run(st, dataclasses.replace(cfg, hop_mode="xla"), tp, key, 8)
+        st_p = run(st, dataclasses.replace(cfg, hop_mode="pallas-mxu"),
+                   tp, key, 8)
+        _states_equal(st_x, st_p)
+        assert float(st_p.delivered_total) > 0
+
+    def test_pull_path_identical(self):
+        """S6/S7 (IWANT resolve + gossip emit) under real pull traffic
+        with a binding budget, gathers via the one-hot select."""
+        cfg, tp, st = _build(n=256, k=16, degree=14, prop_substeps=2,
+                             publishers_per_tick=4, max_iwant_per_tick=2)
+        key = jax.random.PRNGKey(11)
+        st_x = run(st, dataclasses.replace(cfg, hop_mode="xla"), tp, key, 8)
+        st_p = run(st, dataclasses.replace(cfg, hop_mode="pallas-mxu"),
+                   tp, key, 8)
+        _states_equal(st_x, st_p)
+        pulls = int(np.sum(np.asarray(st_p.iwant_pending) >= 0))
+        assert pulls > 100, f"pull path barely exercised: {pulls} pulls"

@@ -1,0 +1,155 @@
+"""edge_gather_mode="mxu" end-to-end: the gather-free two-level MXU take
+(ops/mxutake.py) as a first-class engine gather formulation.
+
+The mode exists so the next TPU window can A/B sort-vs-mxu at the real
+100k×K shapes with one env-var flip (GRAFT_EDGE_GATHER=mxu), so the CPU
+tier must pin: (1) op-level bit-exactness of every word-table call site,
+(2) full engine trajectories bit-identical to the sort mode — including a
+shape whose N*K index count is NOT a multiple of the take's block_g, the
+case the old kernel asserted away (mxutake.py r5) — and (3) the resolve
+policy (word tables ride mxu, the generic payload permute degrades, the
+IWANT answer ride-along steps aside)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.ops.permgather import (
+    resolve_edge_packed_mode,
+    resolve_mode,
+    resolve_words_mode,
+)
+from go_libp2p_pubsub_tpu.sim import (
+    SimConfig,
+    TopicParams,
+    init_state,
+    topology,
+)
+from go_libp2p_pubsub_tpu.sim.engine import run
+
+
+class TestResolvePolicy:
+    def test_word_tables_ride_mxu(self):
+        # the take has no gather op, so no backend/Mosaic gate — only VMEM
+        assert resolve_words_mode("mxu", 2, 100_000, 32) == "mxu"
+        assert resolve_words_mode("mxu", 2, 102_400, 32) == "mxu"
+        # table planes beyond the VMEM budget degrade to rows
+        assert resolve_words_mode("mxu", 64, 10_000_000, 8) == "rows"
+        # the chunk recombination is 4x-u8-exact: non-word dtypes degrade
+        assert resolve_words_mode("mxu", 2, 1024, 8, itemsize=1) == "rows"
+
+    def test_edge_exchange_rides_bit_table(self):
+        assert resolve_edge_packed_mode("mxu", 100_000, 32, 2) == "mxu"
+        assert resolve_edge_packed_mode("mxu", 10_240, 48, 18) == "mxu"
+        # bit-table planes beyond the VMEM budget degrade to rows
+        assert resolve_edge_packed_mode("mxu", 4_000_000, 32, 64) == "rows"
+
+    def test_generic_payload_permute_degrades(self):
+        # the [N, K] payload permute would need an N*K-wide one-hot tile —
+        # VMEM-infeasible, so it rides scalar under the mxu config
+        assert resolve_mode("mxu", jnp.uint32, 100_000, 32) == "scalar"
+        assert resolve_mode("mxu", jnp.float32, 256, 16) == "scalar"
+
+    def test_answer_ride_along_steps_aside(self):
+        """_iwant_answer_extras only merges the IWANT answer gather into
+        the heartbeat's final exchange under the SORT formulation; with
+        mxu carrying the exchange it must return None so forward_tick
+        gathers its own answer table through the take."""
+        from go_libp2p_pubsub_tpu.sim.engine import _iwant_answer_extras
+
+        cfg = SimConfig(n_peers=256, k_slots=16, n_topics=1, msg_window=32,
+                        edge_gather_mode="mxu")
+        st = init_state(cfg, topology.sparse(256, 16, degree=6, seed=1))
+        assert _iwant_answer_extras(st, cfg) is None
+        cfg_s = dataclasses.replace(cfg, edge_gather_mode="sort")
+        assert _iwant_answer_extras(st, cfg_s) is not None
+
+
+class TestOpParity:
+    def test_gather_words_mxu_bit_identical(self):
+        from go_libp2p_pubsub_tpu.ops.bits import (
+            gather_words_rows, pack_words)
+
+        rng = np.random.default_rng(3)
+        for n, k in [(192, 8), (256, 16), (200, 12)]:   # incl. non-128 N
+            m = 64
+            planes = np.asarray(
+                jax.random.uniform(jax.random.PRNGKey(n), (n, m)) < 0.3)
+            x_w = pack_words(jnp.asarray(planes))
+            nbr = jnp.asarray(rng.integers(0, n, (n, k)), jnp.int32)
+            ref = gather_words_rows(x_w, nbr, m, "scalar")
+            out = gather_words_rows(x_w, nbr, m, "mxu")
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(out),
+                                          err_msg=f"n={n} k={k}")
+
+    def test_edge_exchange_mxu_bit_identical(self):
+        from types import SimpleNamespace
+
+        from go_libp2p_pubsub_tpu.ops.heartbeat import edge_gather_packed
+
+        rng = np.random.default_rng(7)
+        n, k = 192, 8
+        topo = topology.sparse(n, k, degree=5)
+        st = SimpleNamespace(neighbors=jnp.asarray(topo.neighbors),
+                             reverse_slot=jnp.asarray(topo.reverse_slot))
+        for t, n_masks in ((3, 2), (12, 3)):   # 6 planes; 36 (2 groups)
+            masks = [jnp.asarray(rng.random((n, t, k)) < 0.35)
+                     for _ in range(n_masks)]
+            ref = edge_gather_packed(masks, st, "scalar")
+            got = edge_gather_packed(masks, st, "mxu")
+            for r, g in zip(ref, got):
+                np.testing.assert_array_equal(
+                    np.asarray(r), np.asarray(g), err_msg=f"mxu t={t}")
+
+
+class TestEngineTrajectory:
+    """run(..., cfg) with the mxu mode must produce bit-identical
+    trajectories to the sort mode — the acceptance bar for wiring the
+    take into the engine (VERDICT r5 item 3)."""
+
+    # two bench-shaped configs: N*K = 4096 divides the take's block_g
+    # (1024); N*K = 2304 does NOT — the pad path the old kernel refused
+    SHAPES = [
+        ("block_aligned", 256, 16),
+        ("block_ragged", 192, 12),
+    ]
+
+    @pytest.mark.parametrize("label,n,k", SHAPES)
+    def test_mxu_equals_sort(self, label, n, k):
+        cfg = SimConfig(n_peers=n, k_slots=k, n_topics=2, msg_window=32,
+                        publishers_per_tick=4, prop_substeps=4,
+                        scoring_enabled=True)
+        tp = TopicParams.disabled(2)
+        st0 = init_state(cfg, topology.sparse(n, k, degree=6, seed=n))
+        key = jax.random.PRNGKey(11)
+        st_sort = run(st0, dataclasses.replace(cfg, edge_gather_mode="sort"),
+                      tp, key, 5)
+        st_mxu = run(st0, dataclasses.replace(cfg, edge_gather_mode="mxu"),
+                     tp, key, 5)
+        for name, a, b in zip(st_sort._fields, st_sort, st_mxu):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{label}: state.{name} diverged")
+
+    def test_mxu_under_churn_and_gater(self):
+        """Churn + gater + flood-publish: every degrade seam fires in one
+        run (payload permute -> scalar, answer ride-along -> None, flood
+        sender-score gather -> scalar) and the trajectory still matches."""
+        cfg = SimConfig(n_peers=192, k_slots=16, n_topics=2, msg_window=32,
+                        publishers_per_tick=4, prop_substeps=4,
+                        scoring_enabled=True, gater_enabled=True,
+                        flood_publish=True,
+                        churn_disconnect_prob=0.05, churn_reconnect_prob=0.3)
+        tp = TopicParams.disabled(2)
+        st0 = init_state(cfg, topology.sparse(192, 16, degree=6, seed=21))
+        key = jax.random.PRNGKey(31)
+        st_a = run(st0, dataclasses.replace(cfg, edge_gather_mode="scalar"),
+                   tp, key, 6)
+        st_b = run(st0, dataclasses.replace(cfg, edge_gather_mode="mxu"),
+                   tp, key, 6)
+        for name, a, b in zip(st_a._fields, st_a, st_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=name)

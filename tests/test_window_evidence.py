@@ -94,6 +94,61 @@ def _quoted_matches(quoted: float, measured: set) -> bool:
     return any(round(v, digits) == quoted for v in measured)
 
 
+def _quarantined_logs():
+    """Bench logs under directories carrying a PLATFORM_UNVERIFIED marker
+    — exempt from the platform=tpu promotion rule, and therefore NEVER
+    allowed to back a BASELINE.md figure."""
+    logs = []
+    if not os.path.isdir(RESULTS):
+        return logs
+    for d in sorted(os.listdir(RESULTS)):
+        full = os.path.join(RESULTS, d)
+        if not os.path.isdir(full) or \
+                not os.path.exists(os.path.join(full, "PLATFORM_UNVERIFIED")):
+            continue
+        for f in sorted(os.listdir(full)):
+            if f.startswith("bench") and f.endswith(".log"):
+                logs.append(os.path.join(full, f))
+    return logs
+
+
+def test_quarantined_logs_are_never_cited_by_baseline():
+    """Close the PLATFORM_UNVERIFIED escape hatch (VERDICT r5 weak #6):
+    the marker exempts a directory from the platform=tpu check, but a
+    quarantined log must then be invisible to BASELINE.md — any bold
+    figure that matches a quarantined value without a promoted log also
+    carrying it means the quarantine laundered un-verified evidence into
+    the table."""
+    q_vals = {}
+    for path in _quarantined_logs():
+        for rec in _metric_lines(path):
+            m = re.match(r"network_heartbeats_per_sec@(\w+?)\[", rec["metric"])
+            if m:
+                q_vals.setdefault(m.group(1), set()).add(float(rec["value"]))
+    if not q_vals:
+        return          # no quarantined evidence exists — nothing to launder
+    p_vals = _log_values()
+    table = open(os.path.join(REPO, "BASELINE.md")).read()
+    for line in table.splitlines():
+        for frag, configs in ROW_CONFIGS.items():
+            if frag not in line:
+                continue
+            for bold in re.findall(r"\*\*([^*]+?)\s*hb/s\*\*", line):
+                nums = [float(x) for x in re.findall(r"\d+(?:\.\d+)?", bold)]
+                if len(nums) != len(configs):
+                    continue    # range rows: the promoted-evidence test
+                                # above already requires promoted logs
+                # positional pairing, as the promoted-log test does: a
+                # multi-figure row maps figure i -> config i
+                for cfgname, q in zip(configs, nums):
+                    laundered = _quoted_matches(
+                        q, q_vals.get(cfgname, set())) and \
+                        not _quoted_matches(q, p_vals.get(cfgname, set()))
+                    assert not laundered, \
+                        f"{cfgname}: quoted {q} is backed ONLY by a " \
+                        f"quarantined (PLATFORM_UNVERIFIED) log"
+
+
 def test_baseline_table_numbers_come_from_promoted_logs():
     vals = _log_values()
     assert vals, "no promoted metric values found"
