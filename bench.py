@@ -97,6 +97,12 @@ def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
 
     hbps = statistics.median(rates)
     platform = jax.devices()[0].platform
+    # the health word travels with the number (sim/invariants.py): a
+    # poisoned or fault-injected run can never be cited silently —
+    # violation bits (bits 8+) mean the rate above measured a suspect
+    # trajectory
+    from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+    flags = int(np.asarray(st.fault_flags))
     line = json.dumps({
         "metric": f"network_heartbeats_per_sec@{name}[{platform}]",
         "value": round(hbps, 2),
@@ -112,6 +118,8 @@ def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
         "mean_delivery_latency_ticks": round(
             float(delivery_latency_ticks(st, cfg)), 3),
         "n_peers": cfg.n_peers,
+        "fault_flags": flags,
+        "fault_flag_names": decode_flags(flags),
     })
     print(line, flush=True)
     return line
@@ -216,6 +224,32 @@ def run_scenario(name: str) -> str | None:
         import dataclasses
         cfg = dataclasses.replace(cfg, count_dtype=cdt)
         print(json.dumps({"info": "count dtype sweep", "requested": cdt}),
+              flush=True)
+    fp = os.environ.get("GRAFT_FAULT_PLAN")
+    if fp:
+        # one-flag degraded-mode sweep (sim/faults.py FaultPlan.parse):
+        # e.g. GRAFT_FAULT_PLAN=partition=2@3:8,drop=0.02 — the emitted
+        # fault_flags then name exactly which faults fired
+        import dataclasses
+        from go_libp2p_pubsub_tpu.sim.faults import FaultPlan
+        cfg = dataclasses.replace(cfg, fault_plan=FaultPlan.parse(fp))
+        print(json.dumps({"info": "fault plan sweep", "requested": fp}),
+              flush=True)
+    im = os.environ.get("GRAFT_INVARIANT_MODE")
+    if im:
+        # invariant-sentinel overhead sweep (sim/invariants.py): off |
+        # record — measures the record-mode cost logged in PERF_MODEL.md.
+        # "raise" is rejected up front: its checkify.check only
+        # functionalizes under engine.run_checked, and bench's plain
+        # run_donated would die deep in tracing with an opaque error
+        if im not in ("off", "record"):
+            raise SystemExit(
+                f"GRAFT_INVARIANT_MODE={im!r}: bench supports 'off' or "
+                "'record' ('raise' needs the checkify-transformed "
+                "engine.run_checked, a debugging path, not a benchmark)")
+        import dataclasses
+        cfg = dataclasses.replace(cfg, invariant_mode=im)
+        print(json.dumps({"info": "invariant mode sweep", "requested": im}),
               flush=True)
     return bench_one(_label(name), cfg, tp, st, ticks, repeats)
 

@@ -23,11 +23,18 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # minimal images: record encode/decode stays available,
+    # only seal/consume (the ed25519 envelope paths) are gated below
+    _HAVE_CRYPTOGRAPHY = False
+    InvalidSignature = None
+    Ed25519PrivateKey = Ed25519PublicKey = None
 
 from ..core.types import PeerID
 from ..pb.codec import (
@@ -90,6 +97,9 @@ def _unsigned_bytes(domain: str, payload_type: bytes, payload: bytes) -> bytes:
 
 def seal_record(rec: PeerRecord, key: Ed25519PrivateKey) -> bytes:
     """Sign ``rec`` into an envelope over the peer-record domain."""
+    if not _HAVE_CRYPTOGRAPHY:
+        raise RecordError("the 'cryptography' package is not installed: "
+                          "cannot seal peer-record envelopes")
     from cryptography.hazmat.primitives.serialization import (
         Encoding, PublicFormat)
 
@@ -132,6 +142,9 @@ def consume_peer_record(envelope: bytes) -> PeerRecord:
         raise RecordError("envelope missing key, payload, or signature")
     if payload_type != PEER_RECORD_PAYLOAD_TYPE:
         raise RecordError("envelope payload is not a peer record")
+    if not _HAVE_CRYPTOGRAPHY:
+        raise RecordError("the 'cryptography' package is not installed: "
+                          "cannot verify peer-record envelopes")
     try:
         pub = Ed25519PublicKey.from_public_bytes(bytes(pub_raw))
     except ValueError as e:

@@ -14,13 +14,29 @@ from __future__ import annotations
 import enum
 import hashlib
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # minimal images: the package must stay importable
+    # (LAX_NO_SIGN swarms, the batched engine, and trace tooling need no
+    # signing at all); only the ed25519 entry points below are gated
+    HAVE_CRYPTOGRAPHY = False
+    InvalidSignature = None
+    Ed25519PrivateKey = Ed25519PublicKey = None
 
 from ..core.types import Message, PeerID
+
+
+def _require_crypto() -> None:
+    if not HAVE_CRYPTOGRAPHY:
+        raise SignError(
+            "the 'cryptography' package is not installed: Ed25519 "
+            "signing/verification is unavailable (use LAX_NO_SIGN, or "
+            "install cryptography for strict policies)")
 
 SIGN_PREFIX = b"libp2p-pubsub:"
 
@@ -52,6 +68,7 @@ class SignError(ValueError):
 
 def generate_keypair(seed: bytes | None = None) -> tuple[Ed25519PrivateKey, PeerID]:
     """New Ed25519 key + its self-certifying peer id."""
+    _require_crypto()
     if seed is not None:
         priv = Ed25519PrivateKey.from_private_bytes(hashlib.sha256(seed).digest())
     else:
@@ -60,6 +77,7 @@ def generate_keypair(seed: bytes | None = None) -> tuple[Ed25519PrivateKey, Peer
 
 
 def peer_id_from_key(pub: Ed25519PublicKey) -> PeerID:
+    _require_crypto()
     from cryptography.hazmat.primitives.serialization import (
         Encoding, PublicFormat)
     raw = pub.public_bytes(Encoding.Raw, PublicFormat.Raw)
@@ -68,6 +86,7 @@ def peer_id_from_key(pub: Ed25519PublicKey) -> PeerID:
 
 def _pubkey_from_peer_id(pid: PeerID) -> Ed25519PublicKey | None:
     if pid.startswith("ed25519:"):
+        _require_crypto()
         try:
             return Ed25519PublicKey.from_public_bytes(bytes.fromhex(pid[8:]))
         except ValueError:
@@ -92,6 +111,7 @@ def signable_bytes(m: Message) -> bytes:
 def sign_message(pid: PeerID, key: Ed25519PrivateKey, m: Message) -> None:
     """Sign in place; attaches the pubkey when the id is not self-certifying
     (sign.go:109-134)."""
+    _require_crypto()
     m.signature = key.sign(signable_bytes(m))
     if _pubkey_from_peer_id(pid) is None:
         from cryptography.hazmat.primitives.serialization import (
@@ -101,6 +121,7 @@ def sign_message(pid: PeerID, key: Ed25519PrivateKey, m: Message) -> None:
 
 def verify_message_signature(m: Message) -> None:
     """Raises SignError when the signature doesn't verify (sign.go:49-75)."""
+    _require_crypto()
     pid = m.from_peer or ""
     pub = _pubkey_from_peer_id(pid)
     if pub is None:

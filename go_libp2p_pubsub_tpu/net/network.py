@@ -100,6 +100,7 @@ class Host:
         self._inflight: dict[PeerID, int] = {}
         self.outbound_queue_size = DEFAULT_PEER_OUTBOUND_QUEUE_SIZE
         self.dropped_rpcs = 0
+        self.faulted_rpcs = 0        # RPCs lost to an injected link fault
         # certified-addr-book analogue (peerstore.GetCertifiedAddrBook):
         # this host's own sealed record + validated records learned from
         # peers (identify exchange on connect, ConsumePeerRecord after PX)
@@ -214,14 +215,44 @@ class Host:
     def send(self, peer: PeerID, rpc: RPC) -> bool:
         """Queue an RPC to ``peer``. Models the bounded per-peer writer: at
         most ``outbound_queue_size`` RPCs in flight; overflow is dropped and
-        reported to the caller (who traces it, gossipsub.go:1195-1202)."""
+        reported to the caller (who traces it, gossipsub.go:1195-1202).
+
+        The network's ``link_fault`` hook (sim/faults.py HostFaultInjector)
+        is consulted per send: ``"drop"`` loses the RPC in flight — the
+        sender believes it sent (True), nothing arrives, ``faulted_rpcs``
+        counts it; ``"drop_data"`` strips the publish payload and lets the
+        control/subscription planes through (the batched half's link drop
+        masks only the DATA admission — ops/propagate.forward_tick — so a
+        lossy-link plan must not eat GRAFT/PRUNE/IHAVE here either; same
+        shape as the gater's RED drop, peer_gater.go:320-363); ``"dup"``
+        delivers the RPC twice (a retransmitting link)."""
         if peer not in self.conns:
             return False
+        copies = 1
+        if self.network.link_fault is not None:
+            action = self.network.link_fault(self.peer_id, peer,
+                                             bool(rpc.publish))
+            if action == "drop":
+                self.faulted_rpcs += 1
+                return True           # lost in flight, not queue overflow
+            if action == "drop_data":
+                self.faulted_rpcs += 1
+                if rpc.control is None and not rpc.subscriptions:
+                    return True       # data-only frame: fully eaten
+                from ..core.types import RPC as _RPC
+                rpc = _RPC(subscriptions=list(rpc.subscriptions),
+                           publish=[], control=rpc.control)
+            if action == "dup":
+                copies = 2
         inflight = self._inflight.get(peer, 0)
         if inflight >= self.outbound_queue_size:
             self.dropped_rpcs += 1
             return False
-        self._inflight[peer] = inflight + 1
+        # a duplicating link still honors the bounded writer: the second
+        # copy is shed when only one slot remains (the cap is the
+        # invariant, comm.go's 32-slot queue; duplication is best-effort)
+        copies = min(copies, self.outbound_queue_size - inflight)
+        self._inflight[peer] = inflight + copies
         rpc.from_peer = self.peer_id
         sched = self.network.scheduler
         delay = self.network.latency(self.peer_id, peer)
@@ -234,7 +265,8 @@ class Host:
                     and other.rpc_handler is not None:
                 other.rpc_handler(self.peer_id, rpc)
 
-        sched.call_later(delay, deliver)
+        for _ in range(copies):
+            sched.call_later(delay, deliver)
         return True
 
 
@@ -246,6 +278,12 @@ class Network:
         self.scheduler = Scheduler()
         self.hosts: dict[PeerID, Host] = {}
         self._latency = latency
+        # per-send fault hook (sim/faults.py HostFaultInjector installs
+        # it): (src, dst, has_data) -> "ok" | "drop" | "drop_data" |
+        # "dup", consulted by Host.send. "drop" loses the whole frame
+        # (cut/dark links); "drop_data" models a lossy link that sheds
+        # the data plane but passes control (batched-half parity)
+        self.link_fault: Callable[[PeerID, PeerID, bool], str] | None = None
 
     def latency(self, a: PeerID, b: PeerID) -> float:
         if callable(self._latency):

@@ -123,15 +123,75 @@ def churn_subscriptions(state: SimState, cfg: SimConfig, tp: TopicParams,
         mesh_active=state.mesh_active & ~(promoted & ~state.mesh))
 
 
+def take_edges_down(state: SimState, cfg: SimConfig, tp: TopicParams,
+                    go_down: jnp.ndarray) -> SimState:
+    """RemovePeer semantics for an arbitrary [N, K] edge-down mask
+    (gossipsub.go:575-596): prune penalty, mesh/fanout eviction, pending
+    gossip-pull cancellation, disconnect-tick stamp. ``go_down`` must be
+    edge-symmetric (both directions down together, like a dying TCP
+    stream) — churn_edges symmetrizes its draws, sim/faults.py cut masks
+    are symmetric by construction."""
+    n, t, k = state.mesh.shape
+    down3 = go_down[:, None, :]
+    removed_mesh = state.mesh & down3
+    state = apply_prune_penalty(state, removed_mesh, tp)
+    # a dead peer's pending gossip pulls never resolve; drop them rather
+    # than charging a broken promise (the reference cancels promises on
+    # peer removal, gossip_tracer.go:154-162). The slot-id lookup is a
+    # per-lane word shift against go_down packed along K — not a [N, M]
+    # scalar gather.
+    gd_words = pack_bool(go_down)                   # [N, ceil(K/32)] u32
+    pend = state.iwant_pending
+    pc = jnp.clip(pend, 0, k - 1)
+    sel = jnp.broadcast_to(gd_words[:, 0][:, None], pend.shape)
+    for wi in range(1, gd_words.shape[1]):
+        sel = jnp.where(pc // 32 == wi, gd_words[:, wi][:, None], sel)
+    pend_down = (((sel >> (pc % 32).astype(U32)) & U32(1)) != 0) & (pend >= 0)
+    return state._replace(
+        mesh=state.mesh & ~down3,
+        fanout=state.fanout & ~down3,
+        iwant_pending=jnp.where(pend_down, -1, pend),
+        connected=state.connected & ~go_down,
+        disconnect_tick=jnp.where(go_down, state.tick, state.disconnect_tick))
+
+
+def bring_edges_up(state: SimState, cfg: SimConfig,
+                   come_up: jnp.ndarray) -> SimState:
+    """Reconnect an arbitrary [N, K] down-edge mask with score-retention
+    semantics (notify.go:11-75 connect + score.go:611-644 RetainScore):
+    an edge down longer than ``cfg.retain_score_ticks`` resets its
+    per-slot counters (the reference deletes peerStats after retention);
+    a faster reconnect sees its old score."""
+    n, t, k = state.mesh.shape
+    down_age = state.tick - state.disconnect_tick
+    expired = come_up & (down_age > cfg.retain_score_ticks)
+    exp3 = expired[:, None, :]
+    z3 = jnp.zeros((n, t, k), jnp.float32)
+    return state._replace(
+        first_message_deliveries=jnp.where(exp3, z3, state.first_message_deliveries),
+        mesh_message_deliveries=jnp.where(exp3, z3, state.mesh_message_deliveries),
+        mesh_failure_penalty=jnp.where(exp3, z3, state.mesh_failure_penalty),
+        invalid_message_deliveries=jnp.where(exp3, z3, state.invalid_message_deliveries),
+        behaviour_penalty=jnp.where(expired, 0.0, state.behaviour_penalty),
+        graft_tick=jnp.where(exp3, NEVER, state.graft_tick),
+        mesh_active=state.mesh_active & ~exp3,
+        connected=state.connected | come_up,
+        disconnect_tick=jnp.where(come_up, NEVER, state.disconnect_tick))
+
+
 def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
                 key: jax.Array,
-                scores_all: jnp.ndarray | None = None) -> SimState:
+                scores_all: jnp.ndarray | None = None,
+                forbid_up: jnp.ndarray | None = None) -> SimState:
     """One churn round: take down a random fraction of live edges, bring back
     a random fraction of down edges, with RemovePeer/retention semantics.
 
     ``scores_all`` is the heartbeat's unmasked score cache (HeartbeatOut
     .scores_all) when the engine drives churn; direct callers may omit it
-    and pay for a fresh compute.
+    and pay for a fresh compute. ``forbid_up`` masks edges a FaultPlan is
+    holding down (sim/faults.py partitions/outages) out of the reconnect
+    draw — without it, churn's random redials would flap cut edges back
+    up for a tick until the next fault pass re-cut them.
     """
     n, t, k = state.mesh.shape
     kd, ku = jax.random.split(key)
@@ -178,42 +238,11 @@ def churn_edges(state: SimState, cfg: SimConfig, tp: TopicParams,
     # even if a scenario marks direct on one side only.
     redial = (state.tick % cfg.direct_connect_ticks) == 0
     come_up = come_up | (down & direct_low & redial)
+    if forbid_up is not None:
+        # plan-cut edges stay down (symmetric mask, so symmetry holds)
+        come_up = come_up & ~forbid_up
 
     # --- RemovePeer on edges going down (gossipsub.go:575-596) ---
-    down3 = go_down[:, None, :]
-    removed_mesh = state.mesh & down3
-    state = apply_prune_penalty(state, removed_mesh, tp)
-    # a dead peer's pending gossip pulls never resolve; drop them rather
-    # than charging a broken promise (the reference cancels promises on
-    # peer removal, gossip_tracer.go:154-162). The slot-id lookup is a
-    # per-lane word shift against go_down packed along K — not a [N, M]
-    # scalar gather.
-    gd_words = pack_bool(go_down)                   # [N, ceil(K/32)] u32
-    pend = state.iwant_pending
-    pc = jnp.clip(pend, 0, k - 1)
-    sel = jnp.broadcast_to(gd_words[:, 0][:, None], pend.shape)
-    for wi in range(1, gd_words.shape[1]):
-        sel = jnp.where(pc // 32 == wi, gd_words[:, wi][:, None], sel)
-    pend_down = (((sel >> (pc % 32).astype(U32)) & U32(1)) != 0) & (pend >= 0)
-    state = state._replace(
-        mesh=state.mesh & ~down3,
-        fanout=state.fanout & ~down3,
-        iwant_pending=jnp.where(pend_down, -1, pend),
-        disconnect_tick=jnp.where(go_down, state.tick, state.disconnect_tick))
-
+    state = take_edges_down(state, cfg, tp, go_down)
     # --- reconnect: expire retention, then flip the edge up ---
-    down_age = state.tick - state.disconnect_tick
-    expired = come_up & (down_age > cfg.retain_score_ticks)
-    exp3 = expired[:, None, :]
-    z3 = jnp.zeros((n, t, k), jnp.float32)
-    state = state._replace(
-        first_message_deliveries=jnp.where(exp3, z3, state.first_message_deliveries),
-        mesh_message_deliveries=jnp.where(exp3, z3, state.mesh_message_deliveries),
-        mesh_failure_penalty=jnp.where(exp3, z3, state.mesh_failure_penalty),
-        invalid_message_deliveries=jnp.where(exp3, z3, state.invalid_message_deliveries),
-        behaviour_penalty=jnp.where(expired, 0.0, state.behaviour_penalty),
-        graft_tick=jnp.where(exp3, NEVER, state.graft_tick),
-        mesh_active=state.mesh_active & ~exp3,
-        connected=(state.connected & ~go_down) | come_up,
-        disconnect_tick=jnp.where(come_up, NEVER, state.disconnect_tick))
-    return state
+    return bring_edges_up(state, cfg, come_up)

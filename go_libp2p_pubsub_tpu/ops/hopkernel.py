@@ -99,7 +99,16 @@ def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
         if (cfg.gater_enabled or cfg.record_provenance
                 or cfg.edge_queue_cap > 0 or cfg.validation_queue_cap > 0
                 or (cfg.flood_publish and cfg.router == "gossipsub")
-                or cfg.count_dtype != "uint8"):
+                or cfg.count_dtype != "uint8"
+                # link duplication ORs an extra hop-0 offer table the
+                # fused kernel has no input for; link drop needs the
+                # split broken-promise accounting (a link-eaten answer IS
+                # broken, a graylist/gater drop is not — propagate.py
+                # resolve step), which the fused resolve kernel's single
+                # data_ok plane cannot express
+                or (cfg.fault_plan is not None
+                    and (cfg.fault_plan.link_dup_prob > 0
+                         or cfg.fault_plan.link_drop_prob > 0))):
             return "xla"
         # table feasibility is GLOBAL n; block feasibility is the
         # per-shard row count under a kernel mesh
@@ -115,7 +124,17 @@ def resolve_emit_mode(mode: str, w: int, n: int, k: int) -> str:
     """Gossip-emit formulation: the fused kernel has no config
     restrictions (the emit step has no cap/gater/provenance interaction) —
     only backend and VMEM-feasibility gates (plus lane alignment for
-    ``pallas-mxu``, as in resolve_hop_mode)."""
+    ``pallas-mxu``, as in resolve_hop_mode).
+
+    NATIVE-LOWERING RISK (ADVICE r5): ``emit_pallas`` mixes
+    ``prefix_count_words`` and ``pack_words`` inside the kernel body —
+    1-D iota, a ``masked.T`` transpose, per-word shifts — an op class
+    Mosaic has historically refused to lower even where interpret mode
+    (the CI tier) is exact. ``auto`` therefore stays ``xla``; before
+    promoting an explicit ``pallas``/``pallas-mxu`` emit on real TPU,
+    confirm the dedicated native probes in scripts/tpu_kernel_smoke.py
+    ("emit_pallas*" and "emit resolve path (engine-shaped)") pass on a
+    live window."""
     if mode not in ("auto", "xla", "pallas", "pallas-mxu"):
         raise ValueError(f"unknown hop_mode {mode!r}")
     if mode == "auto":

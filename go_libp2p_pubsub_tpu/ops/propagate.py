@@ -68,7 +68,8 @@ from .selection import select_random
 
 
 def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
-            topics: jnp.ndarray, key: jax.Array | None = None) -> SimState:
+            topics: jnp.ndarray, key: jax.Array | None = None,
+            corrupt: jnp.ndarray | None = None) -> SimState:
     """Start ``P`` new messages this tick, rotating through message slots.
 
     publishers: [P] int32 peer ids; topics: [P] int32 topic ids. Slot reuse
@@ -77,15 +78,25 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
     their topic stamp ``fanout_lastpub`` (gossipsub.go:1007-1018: publish to
     fanout, record lastpub). Malicious publishers emit invalid messages;
     a ``cfg.ignore_fraction`` of honest messages draw validation verdict
-    IGNORE (validation.go:344-370 ValidationIgnore).
+    IGNORE (validation.go:344-370 ValidationIgnore). ``corrupt`` ([P] bool,
+    sim/faults.py) marks honest publishes corrupted in flight: honest
+    receivers REJECT them and charge P4 (score.go:899-918), exactly like a
+    sybil's invalid publish — but originating from an honest peer.
     """
     p = publishers.shape[0]
     m = cfg.msg_window
     slots = (state.tick * p + jnp.arange(p)) % m
 
+    invalid_pub = state.malicious[publishers]
+    if corrupt is not None:
+        # OR is exact: a malicious publish is invalid already, so whether
+        # the caller pre-masked corrupt draws against malicious publishers
+        # (engine.step does, for honest FAULT_CORRUPT flag accounting)
+        # cannot change message validity
+        invalid_pub = invalid_pub | corrupt
     msg_topic = state.msg_topic.at[slots].set(topics)
     msg_publish_tick = state.msg_publish_tick.at[slots].set(state.tick)
-    msg_invalid = state.msg_invalid.at[slots].set(state.malicious[publishers])
+    msg_invalid = state.msg_invalid.at[slots].set(invalid_pub)
     if cfg.ignore_fraction > 0.0 and key is not None:
         ign = (jax.random.uniform(key, (p,)) < cfg.ignore_fraction) \
             & ~state.malicious[publishers]
@@ -204,7 +215,9 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                  inc_gossip: jnp.ndarray, scores: jnp.ndarray,
                  key: jax.Array, *,
                  fwd_send: jnp.ndarray | None = None,
-                 answers_k: jnp.ndarray | None = None) -> SimState:
+                 answers_k: jnp.ndarray | None = None,
+                 link_ok: jnp.ndarray | None = None,
+                 dup_edges: jnp.ndarray | None = None) -> SimState:
     """One tick of data-plane traffic: resolve last tick's IWANTs, run
     ``prop_substeps`` forwarding hops, then emit this tick's IHAVE/IWANT.
 
@@ -229,6 +242,15 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     Validation verdicts: ACCEPT delivers + forwards; REJECT marks seen +
     counts P4 + gater reject; IGNORE marks seen only + gater ignore
     (validation.go:344-370).
+
+    Fault injection (sim/faults.py): ``link_ok`` ([N, K] bool) is the
+    tick's lossy-link draw, ANDed into the data admission like a gater RED
+    drop — eager forwards, flood publishes, and pull answers on a dropped
+    edge vanish in flight, control still flows, and no P7 broken promise
+    is charged (the answer existed; the link ate it). ``dup_edges``
+    ([N, K] bool) makes mesh edges re-offer their recent deliveries on hop
+    0, landing as seen-cache hits in the mesh-duplicate (P3 credit) and
+    gater-duplicate stats — a re-transmitted RPC, not new traffic.
     """
     n, t, k = state.mesh.shape
     m = cfg.msg_window
@@ -295,6 +317,10 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                                | mal[:, None])
     else:
         data_ok = accept_ok
+    if link_ok is not None:
+        # lossy links drop the edge's DATA plane for the tick (faults
+        # docstring above); receiver-side like every admission layer
+        data_ok = data_ok & link_ok
 
     # Delivery-event accumulators are per-topic COUNTS, not [W,K,N] bit
     # sets (PERF_MODEL.md S3): frontier semantics make each
@@ -381,6 +407,17 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         adm_kn = jnp.where(data_ok.T[None, :, :], U32(0xFFFFFFFF), U32(0))
         got_k = asked_k & answers_k & ~have_bits[:, None, :] & adm_kn
         broken_k = asked_k & ~answers_k
+        if link_ok is not None:
+            # a link-eaten answer is STILL a broken promise: the reference
+            # tracer charges on non-delivery at expiry whatever the cause
+            # (gossip_tracer.go:79-115; the repo's host tracer mirrors
+            # that), so the batched half charges P7 when the lossy link
+            # ate an answer that existed — cross-half scoring parity
+            # under a drop plan. Receiver-side admission drops (graylist/
+            # gater/queue) keep their pre-existing not-broken treatment.
+            link_kn = jnp.where(link_ok.T[None, :, :],
+                                U32(0xFFFFFFFF), U32(0))
+            broken_k = asked_k & ~(answers_k & link_kn)
         throttled = jnp.zeros((n,), jnp.int32)
         if cfg.edge_queue_cap > 0:
             pull_sz = popcount_sum(got_k, axis=0, dtype=jnp.int32)          # [K,N]
@@ -478,6 +515,22 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     else:
         flood_offer = None
 
+    if dup_edges is not None:
+        # link duplication (sim/faults.py): a duplicating mesh edge
+        # re-offers the sender's recent deliveries (its mcache gossip
+        # slice) on hop 0 — mostly seen-cache hits that land in the
+        # mesh-duplicate/gater-duplicate stats; a receiver that missed the
+        # original genuinely gets it from the retransmission. Admission
+        # (graylist/gater/lossy-link) applies like any other data.
+        age_d = state.tick - state.deliver_tick
+        dup_window = pack_words((age_d >= 0) & (age_d < cfg.history_gossip)) \
+            & alive_bits[:, None]
+        dup_kn = jnp.where((dup_edges & data_ok).T[None, :, :],
+                           U32(0xFFFFFFFF), U32(0))
+        dup_offer = gw(dup_window) & mesh_eb & dup_kn
+    else:
+        dup_offer = None
+
     # P3 duplicate-credit window (score.go:949-981): past deliveries stay
     # creditable for mesh_message_deliveries_window_ticks (default 0 = this
     # tick only; the reference default window is 10ms << 1 heartbeat)
@@ -554,6 +607,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         offered = gw(frontier) & allowed                                # [W,K,N]
         if flood_offer is not None:
             offered = offered | jnp.where(is_first, flood_offer, U32(0))
+        if dup_offer is not None:
+            offered = offered | jnp.where(is_first, dup_offer, U32(0))
         if cfg.edge_queue_cap > 0:
             # drop-on-full, whole-RPC granularity (comm.go:156-191): the
             # hop's RPC on an edge either fits the remaining budget or drops

@@ -59,7 +59,10 @@ from .kernel_context import (
 # trajectory tests fail loudly rather than dropping edges silently) AND
 # the per-tick overflow count is surfaced in SimState.halo_overflow via
 # the kernel-context notes (engine.step drains them) — a production run
-# can alarm on halo_overflow > 0 without diffing trajectories.
+# can alarm on halo_overflow > 0 without diffing trajectories. The
+# counter also folds into the SimState.fault_flags health word
+# (sim/invariants.py FLAG_HALO_OVERFLOW), so every bench metric line and
+# trace export carries the poison marker alongside the count.
 _BIG = jnp.int32(2_147_483_647)
 
 

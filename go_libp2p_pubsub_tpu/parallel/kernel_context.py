@@ -127,5 +127,11 @@ def shard_kernel(fn, in_specs, out_specs):
     # check_vma off: pallas_call carries no varying-manual-axes rule, and
     # the specs above are exactly the partitioning the kernels are written
     # for (tables whole, rows local)
-    return jax.shard_map(fn, mesh=ctx.mesh, in_specs=ins, out_specs=outs,
-                         check_vma=False)
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=ctx.mesh, in_specs=ins,
+                             out_specs=outs, check_vma=False)
+    # jax < 0.5: the API lives in jax.experimental and the replication
+    # check is named check_rep — same semantics, off for the same reason
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(fn, mesh=ctx.mesh, in_specs=ins, out_specs=outs,
+                      check_rep=False)

@@ -85,7 +85,7 @@ def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
         msg_ignored=(1, False), msg_publisher=(1, False),
         have=(2, True), deliver_tick=(2, True), deliver_from=(2, True),
         iwant_pending=(2, True), delivered_total=(0, False),
-        halo_overflow=(0, False),
+        halo_overflow=(0, False), fault_flags=(0, False),
     )
     assert set(layout) == set(SimState._fields), "layout drifted from SimState"
     assert n % mesh.devices.size == 0, \

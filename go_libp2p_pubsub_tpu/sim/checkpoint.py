@@ -10,10 +10,23 @@ trajectory equality).
 
 orbax is the primary backend; a .npz fallback keeps the feature alive in
 minimal environments.
+
+Validation contract: ``restore`` checks every restored array against the
+``like`` pytree and raises ``ValueError`` naming the offending field on a
+shape/dtype mismatch — a checkpoint from a different config silently
+resuming (wrong N/K/T/msg_window broadcasting or crashing deep inside the
+step) was the round-5 class of failure this guards. Fields genuinely
+MISSING from an old checkpoint still restore from ``like`` (the documented
+forward-compat path for fields added later, e.g. provenance buffers or
+``fault_flags``). ``save(path, state, cfg=...)`` additionally stamps a
+config fingerprint in a ``<path>.fingerprint`` sidecar; ``restore(...,
+cfg=...)`` compares and raises on mismatch (a missing sidecar — an older
+checkpoint — is tolerated).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 import jax
@@ -29,28 +42,85 @@ except Exception:  # pragma: no cover - orbax is baked into the image
     _HAVE_ORBAX = False
 
 
-def save(path: str, state: SimState) -> None:
-    """Write a checkpoint directory (orbax) or .npz file (fallback)."""
+def config_fingerprint(cfg) -> str:
+    """Deterministic digest of a SimConfig: the frozen dataclass repr
+    enumerates every field in definition order (including the fault plan),
+    so any knob drift changes the digest."""
+    return hashlib.sha256(repr(cfg).encode()).hexdigest()
+
+
+def _sidecar(path: str) -> str:
+    return path + ".fingerprint"
+
+
+def save(path: str, state: SimState, cfg=None) -> None:
+    """Write a checkpoint directory (orbax) or .npz file (fallback); with
+    ``cfg``, stamp its fingerprint in a sidecar for restore to verify."""
     path = os.path.abspath(path)
     if _HAVE_ORBAX and not path.endswith(".npz"):
         with ocp.StandardCheckpointer() as ckpt:
             ckpt.save(path, jax.device_get(state))
-        return
-    arrs = {f: np.asarray(v) for f, v in zip(SimState._fields, state)}
-    np.savez_compressed(path if path.endswith(".npz") else path + ".npz",
-                        **arrs)
+    else:
+        arrs = {f: np.asarray(v) for f, v in zip(SimState._fields, state)}
+        np.savez_compressed(path if path.endswith(".npz") else path + ".npz",
+                            **arrs)
+    if cfg is not None:
+        with open(_sidecar(path), "w") as f:
+            f.write(config_fingerprint(cfg) + "\n")
 
 
-def restore(path: str, like: SimState) -> SimState:
+def _validate(field: str, got, want) -> None:
+    g_shape, g_dtype = tuple(np.shape(got)), np.asarray(got).dtype
+    w_shape, w_dtype = tuple(np.shape(want)), np.asarray(want).dtype
+    if g_shape != w_shape or g_dtype != w_dtype:
+        raise ValueError(
+            f"checkpoint field {field!r}: restored {g_dtype}{list(g_shape)} "
+            f"does not match expected {w_dtype}{list(w_shape)} — the "
+            "checkpoint was written under a different config (peer count / "
+            "slot capacity / topic count / msg window); pass the matching "
+            "`like` state or re-run from scratch")
+
+
+def restore(path: str, like: SimState, cfg=None) -> SimState:
     """Load a checkpoint; ``like`` supplies the shapes/dtypes (and, for
-    sharded states, the target shardings via its arrays)."""
+    sharded states, the target shardings via its arrays). Every restored
+    array is validated against ``like`` (module docstring); with ``cfg``,
+    the saved config fingerprint is verified too."""
     path = os.path.abspath(path)
+    if cfg is not None and os.path.exists(_sidecar(path)):
+        with open(_sidecar(path)) as f:
+            stamped = f.read().strip()
+        want = config_fingerprint(cfg)
+        if stamped != want:
+            raise ValueError(
+                f"checkpoint {path!r} was saved under a different config "
+                f"(fingerprint {stamped[:12]}… != {want[:12]}…); restoring "
+                "it under this config would silently mis-resume")
     if _HAVE_ORBAX and os.path.isdir(path):
         with ocp.StandardCheckpointer() as ckpt:
-            out = ckpt.restore(path, jax.device_get(like))
+            try:
+                out = ckpt.restore(path, jax.device_get(like))
+            except ValueError:
+                # a checkpoint written before a SimState field existed
+                # fails the full-target structure match ("Dict key
+                # mismatch") — restore as-saved (orbax stores the
+                # namedtuple as a field-keyed dict) and fill the missing
+                # fields from ``like``, exactly like the npz branch
+                raw = ckpt.restore(path)
+                out = SimState(*[raw[f] if f in raw else getattr(like, f)
+                                 for f in SimState._fields])
+        for f, got, want in zip(SimState._fields, out, like):
+            _validate(f, got, want)
         return SimState(*[jnp.asarray(x) for x in out])
     npz = np.load(path if path.endswith(".npz") else path + ".npz")
     # fields added after a checkpoint was written restore from ``like``
-    # (new fields carry inert defaults, e.g. provenance buffers at -1)
-    return SimState(*[jnp.asarray(npz[f]) if f in npz.files else getattr(like, f)
-                      for f in SimState._fields])
+    # (new fields carry inert defaults, e.g. provenance buffers at -1);
+    # fields PRESENT must match ``like`` exactly — no silent acceptance
+    vals = []
+    for f in SimState._fields:
+        if f in npz.files:
+            _validate(f, npz[f], getattr(like, f))
+            vals.append(jnp.asarray(npz[f]))
+        else:
+            vals.append(getattr(like, f))
+    return SimState(*vals)

@@ -183,6 +183,21 @@ class SimConfig:
     sub_leave_prob: float = 0.0
     sub_join_prob: float = 0.0
 
+    # declarative fault injection (sim/faults.py FaultPlan): link drop/
+    # duplication, partition + outage tick schedules, honest-publish
+    # corruption — applied by engine.step each tick. None (default)
+    # compiles the identical plan-free program with the identical RNG
+    # stream; the plan is frozen/hashable, so it rides the jit-static
+    # config like every other knob
+    fault_plan: object | None = None
+    # invariant sentinel escalation (sim/invariants.py): "record" ORs
+    # injected-fault + violation bits into SimState.fault_flags each tick
+    # (default — the flags travel with every bench line); "raise"
+    # additionally escalates violations via jax.experimental.checkify
+    # (callers must use engine.run_checked); "off" skips checks and flag
+    # writes entirely
+    invariant_mode: str = "record"
+
     @staticmethod
     def from_params(n_peers: int, k_slots: int, n_topics: int = 1,
                     params: GossipSubParams | None = None,

@@ -81,11 +81,33 @@ def _iwant_answer_extras(state: SimState, cfg: SimConfig) -> list | None:
 
 def step(state: SimState, cfg: SimConfig, tp: TopicParams,
          key: jax.Array) -> SimState:
+    if cfg.fault_plan is not None:
+        # the fault pass opens the tick: partition/outage transitions
+        # (RemovePeer down, reconnect up) plus this tick's link/corruption
+        # draws (sim/faults.py). The pre-split keeps plan-free configs on
+        # the exact historical RNG stream.
+        from .faults import apply_faults
+        key, k_fault = jax.random.split(key)
+        state, fault = apply_faults(state, cfg, tp, k_fault)
+    else:
+        fault = None
     k_pub, k_hb, k_fwd, k_churn, k_ign, k_sub = jax.random.split(key, 6)
     if cfg.sub_leave_prob > 0.0 or cfg.sub_join_prob > 0.0:
         state = churn_subscriptions(state, cfg, tp, k_sub)
     peers, topics = choose_publishers(state, cfg, k_pub)
-    state = publish(state, cfg, peers, topics, k_ign)
+    if fault is not None and fault.corrupt is not None:
+        # effective corruption: draws landing on malicious publishers
+        # corrupt nothing (their messages are invalid already), so the
+        # FAULT_CORRUPT bit reflects what actually fired
+        from .invariants import FAULT_CORRUPT
+        corrupt_eff = fault.corrupt & ~state.malicious[peers]
+        fault = fault._replace(
+            corrupt=corrupt_eff,
+            injected=fault.injected | jnp.where(
+                jnp.any(corrupt_eff), jnp.uint32(FAULT_CORRUPT),
+                jnp.uint32(0)))
+    state = publish(state, cfg, peers, topics, k_ign,
+                    corrupt=fault.corrupt if fault is not None else None)
     if cfg.gater_enabled:
         state = gater_decay(state, cfg)
     if cfg.router == "gossipsub":
@@ -104,13 +126,18 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
                          fwd_send=hb.fwd_send if cfg.router == "gossipsub"
                          else None,
                          answers_k=hb.extra_routed[0]
-                         if hb.extra_routed else None)
+                         if hb.extra_routed else None,
+                         link_ok=fault.link_ok if fault is not None else None,
+                         dup_edges=fault.dup_edges
+                         if fault is not None else None)
     if cfg.churn_disconnect_prob > 0.0:
         # connection churn closes the tick, reusing the heartbeat's score
         # cache (its unmasked variant) for the PX reconnect gate — one
         # compute_scores per tick, as the reference reuses its cache within
         # a heartbeat (gossipsub.go:1375-1381)
-        state = churn_edges(state, cfg, tp, k_churn, scores_all=hb.scores_all)
+        state = churn_edges(state, cfg, tp, k_churn, scores_all=hb.scores_all,
+                            forbid_up=fault.want_down
+                            if fault is not None else None)
     from ..parallel.kernel_context import drain_halo_overflow
     notes = drain_halo_overflow()
     if notes:
@@ -118,6 +145,14 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
         # rule): the counter makes a poisoned run self-identifying
         state = state._replace(
             halo_overflow=state.halo_overflow + sum(notes))
+    if cfg.invariant_mode != "off":
+        # the sentinel closes the tick: injected-fault bits + invariant
+        # violations OR into the sticky flag word (sim/invariants.py);
+        # "raise" additionally escalates via checkify (run_checked)
+        from .invariants import record_flags
+        state = record_flags(state, cfg,
+                             injected=fault.injected
+                             if fault is not None else None)
     return state._replace(tick=state.tick + 1)
 
 
@@ -142,16 +177,52 @@ run_donated = jax.jit(_run_impl, static_argnames=("cfg", "n_ticks"),
 step_jit = jax.jit(step, static_argnames=("cfg",))
 
 
+def run_checked(state: SimState, cfg: SimConfig, tp: TopicParams,
+                key: jax.Array, n_ticks: int) -> SimState:
+    """``run`` with the invariant sentinel escalated to host exceptions:
+    the whole scan is checkify-transformed, so ``invariant_mode="raise"``
+    checks (sim/invariants.py) surface as a thrown ``JaxRuntimeError``
+    naming the violation flags — the debugging mode for a poisoned run.
+    Works (as a plain run) under ``"record"`` too; prefer ``run`` there."""
+    from jax.experimental import checkify
+
+    def f(state, tp, key):
+        return _run_impl(state, cfg, tp, key, n_ticks)
+
+    err, out = jax.jit(checkify.checkify(f, errors=checkify.user_checks))(
+        state, tp, key)
+    err.throw()
+    return out
+
+
 def mesh_degrees(state: SimState) -> jnp.ndarray:
     """[N, T] current mesh degree (for convergence checks)."""
     return jnp.sum(state.mesh, axis=-1)
 
 
-def delivery_fraction(state: SimState, cfg: SimConfig) -> jnp.ndarray:
-    """Fraction of (subscribed peer, alive message) pairs delivered."""
-    alive = (state.tick - state.msg_publish_tick) < cfg.history_length
+def delivery_fraction(state: SimState, cfg: SimConfig,
+                      min_age_ticks: int = 0,
+                      topic: int | None = None) -> jnp.ndarray:
+    """Fraction of (subscribed peer, alive message) pairs delivered.
+
+    ``min_age_ticks`` restricts the census to messages at least that many
+    ticks old — the SETTLED window. The engine publishes every tick up to
+    the end of a scan, and a message published on the final tick still has
+    its gossip IHAVE->IWANT pull pending (a structural 1-tick delay,
+    gossipsub.go:698-739), so saturation checks against a host-runtime run
+    that got a settle period should pass min_age_ticks>=2 for a fair
+    comparison. ``topic`` restricts the census to one topic: gossipsub can
+    only deliver over edges BETWEEN subscribers, so a sparsely-subscribed
+    topic whose induced subscriber subgraph is disconnected has a
+    structural loss floor (tests/test_delivery_structural.py reachability
+    oracle) that a saturation assert on a connected topic must not
+    inherit (tests/test_cross_half_fuzz.py)."""
+    age = state.tick - state.msg_publish_tick
+    alive = (age < cfg.history_length) & (age >= min_age_ticks)
     t_m = jnp.clip(state.msg_topic, 0, cfg.n_topics - 1)
     should = state.subscribed[:, t_m] & alive[None, :] & (state.msg_topic >= 0)[None, :]
+    if topic is not None:
+        should = should & (state.msg_topic == topic)[None, :]
     got = state.have & should
     return jnp.sum(got) / jnp.maximum(jnp.sum(should), 1)
 

@@ -1,0 +1,445 @@
+"""Declarative fault injection for both halves of the framework.
+
+The reference router's whole reason for existing (gossipsub v1.1, SURVEY.md
+§2 scoring P1-P7) is behavior under hostile and DEGRADED networks, yet the
+engine could only exercise the failure modes baked into the five BASELINE
+scenarios. A ``FaultPlan`` is a jit-static description of what goes wrong
+and when, applied every tick by ``sim/engine.step`` (batched half) or
+installed on the discrete-event scheduler by :class:`HostFaultInjector`
+(functional-runtime half, via the ``Network.link_fault`` hook in
+net/network.py) — the SAME plan runs against both halves, so recovery
+behavior (partition heal, outage return, mesh self-healing time) can be
+parity-checked between them.
+
+Fault classes:
+
+- **link drop** (``link_drop_prob``): each tick, each directed edge loses
+  its DATA plane with this probability — eager forwards, flood publishes,
+  and IWANT-pull answers on the edge vanish in flight. Control traffic
+  (GRAFT/PRUNE/IHAVE) still flows, like the peer gater's RED drops
+  (peer_gater.go:320-363 strips data, keeps control): the batched
+  exchange's edge symmetry must hold, and real links drop big data frames
+  long before tiny control frames. A link-eaten pull answer IS charged as
+  a broken promise: the promise tracker fires on non-delivery at expiry
+  whatever the cause (gossip_tracer.go:79-115; the host half's tracer
+  behaves the same), so P7 scoring stays parity-comparable between
+  halves under a drop plan.
+- **link duplication** (``link_dup_prob``): each tick, a duplicating mesh
+  edge re-offers its recent deliveries (the mcache gossip slice) alongside
+  the frontier — seen-cache hits count as mesh duplicates (P3 credit,
+  score.go:949-981) and gater duplicates, exactly where a re-transmitted
+  RPC would land in the reference.
+- **partitions** (``partitions``): on a tick schedule, peers split into
+  ``components`` by ``peer_id % components``; cross-component edges go
+  DOWN with full RemovePeer semantics (ops/churn.take_edges_down —
+  pubsub.go:711-757 dead-peer path, score retention per score.go:611-644)
+  and come back at the window's ``end`` tick through the reconnect path
+  (retention expiry included), so mesh self-healing and backoff are
+  genuinely exercised, not simulated around.
+- **regional outages** (``outages``): a deterministic pseudo-random
+  ``fraction`` of peers goes completely dark for the window (all their
+  edges down, RemovePeer semantics), then returns through the same
+  churn/backoff/retention path. Peer choice uses a shared integer hash
+  (:func:`outage_peers`) so the batched and host halves pick the SAME
+  peers.
+- **corruption** (``corrupt_prob``): each honest publish draws this
+  probability of being corrupted in flight — honest receivers REJECT it
+  and charge P4 invalid-message deliveries (score.go:899-918), feeding the
+  scoring pipeline invalid traffic that no sybil actor sent.
+
+Every random draw is keyed off the step key (batched) or a
+``random.Random(plan.seed)`` stream (host), so runs are reproducible; the
+plan itself is a frozen dataclass, hashable, and lives on ``SimConfig`` as
+a jit-static field — a plan change recompiles, a key change replays.
+
+Which faults fired is recorded per tick into ``SimState.fault_flags``
+(sim/invariants.py bit layout), making every degraded run self-identifying
+in bench lines and trace exports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import SimConfig, TopicParams
+from .state import SimState
+
+U32 = jnp.uint32
+
+
+# ---------------------------------------------------------------------------
+# the plan
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionWindow:
+    """Split the network into ``components`` (peer_id % components) for
+    ticks ``start <= tick < end``; heal (redial the cut edges) at
+    ``end``."""
+
+    start: int
+    end: int
+    components: int = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class OutageWindow:
+    """A ``fraction`` of peers goes completely dark for ticks
+    ``start <= tick < end``, returning at ``end`` through the reconnect
+    path. Peer choice is :func:`outage_peers` (shared across halves)."""
+
+    start: int
+    end: int
+    fraction: float = 0.1
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Jit-static fault schedule (module docstring). All-defaults is the
+    null plan; ``SimConfig.fault_plan=None`` skips the fault pass
+    entirely (identical compiled program AND identical RNG stream to a
+    plan-free build)."""
+
+    link_drop_prob: float = 0.0
+    link_dup_prob: float = 0.0
+    corrupt_prob: float = 0.0
+    partitions: tuple = ()          # tuple[PartitionWindow, ...]
+    outages: tuple = ()             # tuple[OutageWindow, ...]
+    seed: int = 0
+
+    def active(self) -> bool:
+        return (self.link_drop_prob > 0.0 or self.link_dup_prob > 0.0
+                or self.corrupt_prob > 0.0 or bool(self.partitions)
+                or bool(self.outages))
+
+    @staticmethod
+    def parse(spec: str) -> "FaultPlan":
+        """Parse the ``GRAFT_FAULT_PLAN`` env-knob syntax: comma-separated
+        ``key=value`` items, repeatable for windows.
+
+            drop=0.05,dup=0.01,corrupt=0.1,seed=7
+            partition=2@10:30          # 2 components, ticks [10, 30)
+            outage=0.2@10:30           # 20% of peers dark, ticks [10, 30)
+        """
+        kw: dict = {"partitions": [], "outages": []}
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            k, _, v = item.partition("=")
+            if k == "partition":
+                amt, _, win = v.partition("@")
+                s, _, e = win.partition(":")
+                kw["partitions"].append(
+                    PartitionWindow(int(s), int(e), components=int(amt)))
+            elif k == "outage":
+                amt, _, win = v.partition("@")
+                s, _, e = win.partition(":")
+                kw["outages"].append(
+                    OutageWindow(int(s), int(e), fraction=float(amt)))
+            elif k == "drop":
+                kw["link_drop_prob"] = float(v)
+            elif k == "dup":
+                kw["link_dup_prob"] = float(v)
+            elif k == "corrupt":
+                kw["corrupt_prob"] = float(v)
+            elif k == "seed":
+                kw["seed"] = int(v)
+            else:
+                raise ValueError(f"unknown fault-plan item {item!r}")
+        kw["partitions"] = tuple(kw["partitions"])
+        kw["outages"] = tuple(kw["outages"])
+        return FaultPlan(**kw)
+
+
+# ---------------------------------------------------------------------------
+# deterministic peer choice shared by both halves
+
+
+def _mix32_host(x: int) -> int:
+    """32-bit integer finalizer (murmur3-style), host ints."""
+    x &= 0xFFFFFFFF
+    x = ((x ^ (x >> 16)) * 0x45D9F3B) & 0xFFFFFFFF
+    x = ((x ^ (x >> 16)) * 0x45D9F3B) & 0xFFFFFFFF
+    return x ^ (x >> 16)
+
+
+def _outage_salt(plan_seed: int, widx: int) -> int:
+    return (plan_seed * 0x9E3779B9 + widx * 0x85EBCA6B) & 0xFFFFFFFF
+
+
+def outage_peers_host(n: int, widx: int, plan: FaultPlan) -> list[bool]:
+    """Host-side twin of the in-graph outage choice: peer i is dark in
+    outage window ``widx`` iff hash(i, seed, widx) < fraction * 2^32."""
+    w = plan.outages[widx]
+    thr = min(int(w.fraction * 4294967296.0), 0xFFFFFFFF)
+    salt = _outage_salt(plan.seed, widx)
+    return [_mix32_host(i ^ salt) < thr for i in range(n)]
+
+
+def _outage_peers_jax(n: int, widx: int, plan: FaultPlan) -> jnp.ndarray:
+    w = plan.outages[widx]
+    thr = U32(min(int(w.fraction * 4294967296.0), 0xFFFFFFFF))
+    x = jnp.arange(n, dtype=U32) ^ U32(_outage_salt(plan.seed, widx))
+    x = (x ^ (x >> 16)) * U32(0x45D9F3B)
+    x = (x ^ (x >> 16)) * U32(0x45D9F3B)
+    x = x ^ (x >> 16)
+    return x < thr
+
+
+# ---------------------------------------------------------------------------
+# batched half: the per-tick fault pass
+
+
+class FaultTick(NamedTuple):
+    """What engine.step threads through the rest of the tick."""
+
+    want_down: jnp.ndarray          # [N, K] bool: edges the plan holds down
+    link_ok: jnp.ndarray | None     # [N, K] bool data admission (drop), or None
+    dup_edges: jnp.ndarray | None   # [N, K] bool duplicating edges, or None
+    corrupt: jnp.ndarray | None     # [P] bool corrupted publishes, or None
+    injected: jnp.ndarray           # uint32 scalar: fault bits fired this tick
+
+
+def edge_cut_mask(plan: FaultPlan, tick: jnp.ndarray,
+                  neighbors: jnp.ndarray, reverse_slot: jnp.ndarray,
+                  disconnect_tick: jnp.ndarray | None = None
+                  ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(want_down [N,K], heal_mask [N,K], injected uint32) for this tick's
+    partition/outage schedule. ``heal_mask`` covers exactly the edges the
+    PLAN took down: each window's cut set is a pure function of peer ids,
+    and an edge counts as plan-downed iff SOME window covering it was
+    active at its ``disconnect_tick`` (take_edges_down stamps the cut
+    tick; an edge already down before every covering window opened was
+    downed by ordinary churn and stays on the churn/PX reconnect path).
+    The any-covering-window formulation matters for back-to-back or
+    overlapping windows over the same edges: the later window inherits
+    the earlier window's cut (the edge is already down, so its stamp
+    predates the later start) and must still heal it at its own end —
+    the host injector's keep-severed-until-no-window-cuts-it bookkeeping
+    (``HostFaultInjector._reknit``), mirrored. Symmetric by construction
+    (component membership, peer-outage, and the disconnect stamp are all
+    edge-symmetric), so RemovePeer semantics stay edge-symmetric."""
+    from .invariants import FAULT_OUTAGE, FAULT_PARTITION
+
+    n, k = neighbors.shape
+    known = (neighbors >= 0) & (reverse_slot >= 0)
+    nbr = jnp.clip(neighbors, 0, n - 1)
+
+    wins = []                   # (start, end, cut set, injected bit)
+    for w in plan.partitions:
+        comp = jnp.arange(n, dtype=jnp.int32) % w.components
+        cross = (comp[:, None] != comp[nbr]) & known
+        wins.append((w.start, w.end, cross, FAULT_PARTITION))
+    for i, w in enumerate(plan.outages):
+        dark = _outage_peers_jax(n, i, plan)
+        wins.append((w.start, w.end,
+                     (dark[:, None] | dark[nbr]) & known, FAULT_OUTAGE))
+
+    cut = jnp.zeros((n, k), bool)
+    heal = jnp.zeros((n, k), bool)
+    inj = U32(0)
+    # plan-downed: the edge's disconnect stamp falls inside SOME window
+    # that cuts it (true everywhere when no stamps are supplied)
+    if disconnect_tick is None:
+        plan_downed = jnp.ones((n, k), bool)
+    else:
+        plan_downed = jnp.zeros((n, k), bool)
+        for s, e, cs, _ in wins:
+            plan_downed = plan_downed | \
+                (cs & (disconnect_tick >= s) & (disconnect_tick < e))
+    for s, e, cs, bit in wins:
+        act = (tick >= s) & (tick < e)
+        cut = cut | (act & cs)
+        heal = heal | ((tick == e) & cs & plan_downed)
+        inj = inj | jnp.where(act, U32(bit), U32(0))
+    return cut, heal, inj
+
+
+def apply_faults(state: SimState, cfg: SimConfig, tp: TopicParams,
+                 key: jax.Array) -> tuple[SimState, FaultTick]:
+    """The start-of-tick fault pass: apply partition/outage transitions
+    (down with RemovePeer semantics, up through the reconnect/retention
+    path) and draw this tick's link/corruption faults."""
+    from ..ops.churn import bring_edges_up, take_edges_down
+    from .invariants import FAULT_LINK_DROP, FAULT_LINK_DUP
+
+    plan = cfg.fault_plan
+    n, k = state.neighbors.shape
+    kd, kdup, kc = jax.random.split(key, 3)
+
+    if plan.partitions or plan.outages:
+        # want_down from PRE-take-down state; heal_mask consults the
+        # disconnect stamps as they stand at the window's end (the cut
+        # itself stamped them >= window.start)
+        want_down, heal_mask, inj = edge_cut_mask(
+            plan, state.tick, state.neighbors, state.reverse_slot,
+            disconnect_tick=state.disconnect_tick)
+        go_down = state.connected & want_down
+        state = take_edges_down(state, cfg, tp, go_down)
+        # heal redials exactly the ending windows' own cuts (edges a
+        # still-active window wants down stay down); down edges outside
+        # any cut set remain on the ordinary churn/PX reconnect path
+        come_up = heal_mask & ~state.connected & ~want_down
+        state = bring_edges_up(state, cfg, come_up)
+    else:
+        want_down, _, inj = edge_cut_mask(
+            plan, state.tick, state.neighbors, state.reverse_slot)
+
+    valid = state.connected
+    link_ok = dup_edges = corrupt = None
+    if plan.link_drop_prob > 0.0:
+        link_ok = jax.random.uniform(kd, (n, k)) >= plan.link_drop_prob
+        inj = inj | jnp.where(jnp.any(~link_ok & valid),
+                              U32(FAULT_LINK_DROP), U32(0))
+    if plan.link_dup_prob > 0.0:
+        dup_edges = (jax.random.uniform(kdup, (n, k)) < plan.link_dup_prob) \
+            & valid
+        inj = inj | jnp.where(jnp.any(dup_edges), U32(FAULT_LINK_DUP), U32(0))
+    if plan.corrupt_prob > 0.0:
+        corrupt = jax.random.uniform(
+            kc, (cfg.publishers_per_tick,)) < plan.corrupt_prob
+        # FAULT_CORRUPT is NOT set here: whether a draw corrupts anything
+        # depends on who publishes (malicious publishers are already
+        # invalid) — engine.step sets the bit from the EFFECTIVE
+        # corruption after choose_publishers
+    return state, FaultTick(want_down=want_down, link_ok=link_ok,
+                            dup_edges=dup_edges, corrupt=corrupt,
+                            injected=inj)
+
+
+# ---------------------------------------------------------------------------
+# host half: the same plan on the discrete-event runtime
+
+
+class HostFaultInjector:
+    """Install a :class:`FaultPlan` on a functional-runtime swarm.
+
+    Mirrors the batched semantics on net/network.py primitives: partitions
+    and outages DISCONNECT the affected host pairs at window start
+    (notifiee fan-out fires RemovePeer in every PubSub, pubsub.go:711-757)
+    and re-``connect`` them at window end; link drop/duplication ride the
+    ``Network.link_fault`` hook consulted by ``Host.send``. One tick of
+    the batched engine corresponds to one second of scheduler time (the
+    1 tick == 1 s == 1 heartbeat quantization, SURVEY.md §7 "Time").
+
+    ``corrupt_prob`` has no host-side hook here: on the runtime, corrupt
+    traffic is expressed through topic validators (the reference's own
+    mechanism) — see tests/test_adversarial_runtime.py.
+
+    ORDERING CONTRACT: ``hosts`` must be in engine row order — list
+    position i IS peer row i of the batched half (partition components
+    are ``i % components`` and outage peers hash the row id on both
+    sides). Build the swarm the way topology.from_hosts expects and pass
+    the same list; any other order silently picks different cut/dark
+    sets than the batched run of the same plan.
+    """
+
+    def __init__(self, network, hosts, plan: FaultPlan):
+        import random as _random
+
+        self.network = network
+        self.hosts = list(hosts)
+        self.plan = plan
+        self.rng = _random.Random(plan.seed)
+        self.index = {h.peer_id: i for i, h in enumerate(self.hosts)}
+        self._partitions_live: list[PartitionWindow] = []
+        self._dark: dict = {}                          # widx -> set(peer ids)
+        self._severed: list = []                       # [(host_a, host_b)]
+        network.link_fault = self._link_fault
+        sched = network.scheduler
+        now = sched.now()
+        for w in plan.partitions:
+            sched.call_at(max(now, float(w.start)),
+                          lambda w=w: self._partition_start(w))
+            sched.call_at(max(now, float(w.end)),
+                          lambda w=w: self._partition_end(w))
+        for i, w in enumerate(plan.outages):
+            sched.call_at(max(now, float(w.start)),
+                          lambda i=i, w=w: self._outage_start(i, w))
+            sched.call_at(max(now, float(w.end)),
+                          lambda i=i: self._outage_end(i))
+
+    # -- the one cut predicate (all transitions and the link hook agree) --
+
+    def _is_dark(self, pid) -> bool:
+        return any(pid in dark for dark in self._dark.values())
+
+    def _is_cut(self, i: int, j: int) -> bool:
+        for w in self._partitions_live:
+            if i % w.components != j % w.components:
+                return True
+        return self._is_dark(self.hosts[i].peer_id) \
+            or self._is_dark(self.hosts[j].peer_id)
+
+    # -- link hook (Host.send) --
+
+    def _link_fault(self, src, dst, has_data: bool = True) -> str:
+        i, j = self.index.get(src), self.index.get(dst)
+        if i is None or j is None:
+            return "ok"
+        if self._is_cut(i, j):
+            return "drop"             # cut/dark link: nothing crosses
+        # lossy links shed the DATA plane only (batched-half parity:
+        # forward_tick masks link_ok into data_ok, control still flows),
+        # so the drop draw is only spent on data-bearing frames
+        if self.plan.link_drop_prob > 0.0 and has_data \
+                and self.rng.random() < self.plan.link_drop_prob:
+            return "drop_data"
+        # duplication likewise only models retransmitted DATA frames (the
+        # batched dup_offer re-offers recent deliveries on mesh edges);
+        # doubling a control frame (GRAFT handled twice) would be a fault
+        # class the batched half cannot mirror
+        if self.plan.link_dup_prob > 0.0 and has_data \
+                and self.rng.random() < self.plan.link_dup_prob:
+            return "dup"
+        return "ok"
+
+    # -- window transitions --
+
+    def _sever_cut(self) -> None:
+        """Disconnect every currently-connected pair the cut predicate now
+        covers (called after a window opens)."""
+        for a in self.hosts:
+            ia = self.index[a.peer_id]
+            for pid in list(a.conns):
+                ib = self.index.get(pid)
+                if ib is not None and self._is_cut(ia, ib):
+                    a.disconnect(pid)
+                    self._severed.append((a, self.hosts[ib]))
+
+    def _reknit(self) -> None:
+        """Reconnect severed pairs no longer covered by ANY active window
+        (called after a window closes); pairs another window still cuts
+        stay severed until that window too ends — matching the batched
+        half's per-window heal_mask & ~want_down semantics."""
+        keep = []
+        for a, b in self._severed:
+            if self._is_cut(self.index[a.peer_id], self.index[b.peer_id]):
+                keep.append((a, b))
+            else:
+                a.connect(b)
+        self._severed = keep
+
+    def _partition_start(self, w: PartitionWindow) -> None:
+        self._partitions_live.append(w)
+        self._sever_cut()
+
+    def _partition_end(self, w: PartitionWindow) -> None:
+        if w in self._partitions_live:
+            self._partitions_live.remove(w)
+        self._reknit()
+
+    def _outage_start(self, widx: int, w: OutageWindow) -> None:
+        dark_mask = outage_peers_host(len(self.hosts), widx, self.plan)
+        self._dark[widx] = {h.peer_id
+                           for h, d in zip(self.hosts, dark_mask) if d}
+        self._sever_cut()
+
+    def _outage_end(self, widx: int) -> None:
+        self._dark.pop(widx, None)
+        self._reknit()

@@ -1,0 +1,174 @@
+"""In-scan invariant sentinel: the ``SimState.fault_flags`` bit word.
+
+Generalizes the ad-hoc ``halo_overflow`` counter (the engine's only
+runtime health signal before this module) into one named uint32 flag word
+carried through the scan and surfaced with every metric line (bench.py)
+and trace export (sim/trace_export.py run_traced) — a poisoned number can
+never be cited silently, and every degraded run is self-identifying.
+
+Two bit classes share the word:
+
+- **injected-fault bits** (low byte): which :class:`sim.faults.FaultPlan`
+  faults actually fired during the run. Expected nonzero under a plan;
+  their exact set is checkable against the plan (tests/test_faults.py).
+- **invariant-violation bits** (bits 8+): conditions that must NEVER hold
+  in a healthy run, plan or no plan. Any of these set means the
+  trajectory is suspect.
+
+``SimConfig.invariant_mode`` picks the escalation:
+
+- ``"record"`` (default): OR the flags into ``state.fault_flags`` each
+  tick — a handful of fused min/max/any reductions over arrays the tick
+  already touched (measured overhead in PERF_MODEL.md "Invariant
+  sentinel").
+- ``"raise"``: additionally ``jax.experimental.checkify.check`` that no
+  violation bit is set; callers must run through
+  :func:`sim.engine.run_checked` (or checkify the step themselves) and
+  get a host-side exception naming the flags — the debugging mode.
+- ``"off"``: no checks, no flag writes (the pre-sentinel program).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .config import SimConfig
+from .state import NEVER, SimState
+
+U32 = jnp.uint32
+
+# --- injected-fault bits (sim/faults.py sets these) ---
+FAULT_LINK_DROP = 1 << 0     # >=1 link dropped a data plane this run
+FAULT_LINK_DUP = 1 << 1      # >=1 link duplicated traffic
+FAULT_PARTITION = 1 << 2     # a partition window was active
+FAULT_OUTAGE = 1 << 3        # an outage window was active
+FAULT_CORRUPT = 1 << 4       # >=1 honest publish was corrupted
+
+# --- invariant-violation bits ---
+FLAG_NONFINITE = 1 << 8      # NaN/Inf in a score counter / app score
+FLAG_NEG_COUNTER = 1 << 9    # a monotone/decayed counter went negative
+FLAG_MESH_DEAD_EDGE = 1 << 10  # mesh slot points at a down/absent edge
+FLAG_GRAFT_IN_BACKOFF = 1 << 11  # edge grafted while its backoff was live
+FLAG_SLOT_GARBAGE = 1 << 12  # slot/topic index out of range (packed-word
+#                              tail-bit garbage decodes into this class)
+FLAG_DELIVER_FUTURE = 1 << 13  # deliver_tick > tick, negative, or
+#                                delivered-but-not-seen
+FLAG_HALO_OVERFLOW = 1 << 14  # halo-route bucket overflow (counter > 0)
+
+VIOLATION_MASK = 0xFFFFFF00
+INJECTED_MASK = 0x000000FF
+
+_NAMES = {
+    FAULT_LINK_DROP: "link_drop",
+    FAULT_LINK_DUP: "link_dup",
+    FAULT_PARTITION: "partition",
+    FAULT_OUTAGE: "outage",
+    FAULT_CORRUPT: "corrupt",
+    FLAG_NONFINITE: "VIOLATION:nonfinite_counter",
+    FLAG_NEG_COUNTER: "VIOLATION:negative_counter",
+    FLAG_MESH_DEAD_EDGE: "VIOLATION:mesh_dead_edge",
+    FLAG_GRAFT_IN_BACKOFF: "VIOLATION:graft_in_backoff",
+    FLAG_SLOT_GARBAGE: "VIOLATION:slot_garbage",
+    FLAG_DELIVER_FUTURE: "VIOLATION:deliver_future",
+    FLAG_HALO_OVERFLOW: "VIOLATION:halo_overflow",
+}
+
+
+def decode_flags(flags: int) -> list[str]:
+    """Human-readable names of the set bits (bench lines, trace exports)."""
+    out = [name for bit, name in sorted(_NAMES.items()) if flags & bit]
+    unknown = flags & ~sum(_NAMES)
+    if unknown:
+        out.append(f"unknown:0x{unknown:x}")
+    return out
+
+
+def _bit(cond, bit) -> jnp.ndarray:
+    return jnp.where(cond, U32(bit), U32(0))
+
+
+def violation_flags(state: SimState, cfg: SimConfig) -> jnp.ndarray:
+    """uint32 scalar of violation bits for the END-OF-TICK state (called by
+    engine.step after churn closes the tick, before the tick increments).
+
+    Cost shape: one fused elementwise+reduce pass per array; the big
+    [N,T,K] f32 counters dominate (~4 reads of what the tick's attribution
+    pass already wrote). NaN is caught by comparison semantics: NaN >= 0
+    is False, so the ``>= 0`` check covers NaN and the ``< inf`` check
+    covers +Inf — no separate isnan pass."""
+    n, t, k = state.mesh.shape
+    tick = state.tick
+    f = U32(0)
+
+    # NaN/Inf + negativity over the f32 counter planes in one read each
+    nonneg = [state.first_message_deliveries, state.mesh_message_deliveries,
+              state.mesh_failure_penalty, state.invalid_message_deliveries,
+              state.behaviour_penalty, state.gater_validate,
+              state.gater_throttle, state.gater_deliver,
+              state.gater_duplicate, state.gater_ignore, state.gater_reject]
+    bad_neg = jnp.zeros((), bool)
+    bad_fin = jnp.zeros((), bool)
+    for a in nonneg:
+        # both reductions fuse over ONE read of the array; NaN compares
+        # False everywhere, so it lands (only) in the nonfinite bit
+        bad_neg = bad_neg | jnp.any(a < 0)
+        bad_fin = bad_fin | ~jnp.all(jnp.abs(a) < jnp.inf)
+    bad_neg = bad_neg | (state.delivered_total < 0) | (state.halo_overflow < 0)
+    # app_score may be legitimately negative; only finiteness is invariant
+    bad_fin = bad_fin | ~jnp.all(jnp.abs(state.app_score) < jnp.inf) \
+        | ~(state.delivered_total < jnp.inf)
+    f = f | _bit(bad_fin, FLAG_NONFINITE) | _bit(bad_neg, FLAG_NEG_COUNTER)
+
+    # mesh slots must point at live, known edges (churn/faults clear mesh
+    # on RemovePeer — a survivor here means an exchange leaked an edge)
+    live = (state.connected & (state.neighbors >= 0))[:, None, :]
+    f = f | _bit(jnp.any(state.mesh & ~live), FLAG_MESH_DEAD_EDGE)
+
+    # an edge grafted THIS tick while its backoff was still running: the
+    # heartbeat's accept vetting and churn's promote both gate on backoff
+    # expiry (gossipsub.go:741-837, 1047-1102), so this firing means a
+    # graft path skipped the gate
+    f = f | _bit(jnp.any(state.mesh & (state.graft_tick == tick)
+                         & (state.backoff > tick)), FLAG_GRAFT_IN_BACKOFF)
+
+    # slot/topic index ranges (bit-plane decodes of packed words land here
+    # when tail bits carry garbage: _bits_to_slot/_slot_bitplanes emit
+    # out-of-range slot ids if a word's pad bits were ever set)
+    bad_rng = jnp.any((state.iwant_pending < -1) | (state.iwant_pending >= k)) \
+        | jnp.any((state.deliver_from < -1) | (state.deliver_from >= k)) \
+        | jnp.any((state.msg_topic < -1) | (state.msg_topic >= t)) \
+        | jnp.any((state.msg_publisher < -1) | (state.msg_publisher >= n))
+    f = f | _bit(bad_rng, FLAG_SLOT_GARBAGE)
+
+    # delivery bookkeeping: no future/negative stamps, delivered => seen
+    dlv = state.deliver_tick < NEVER
+    bad_dlv = jnp.any(dlv & (state.deliver_tick > tick)) \
+        | jnp.any(dlv & (state.deliver_tick < 0)) \
+        | jnp.any(dlv & ~state.have)
+    f = f | _bit(bad_dlv, FLAG_DELIVER_FUTURE)
+
+    # the halo-route overflow counter folds into the flag word: any routed
+    # trajectory with a bucket overflow is poisoned (parallel/halo.py)
+    f = f | _bit(state.halo_overflow > 0, FLAG_HALO_OVERFLOW)
+    return f
+
+
+def record_flags(state: SimState, cfg: SimConfig,
+                 injected=None) -> SimState:
+    """OR this tick's (injected | violation) bits into the state, and in
+    ``"raise"`` mode escalate violations through checkify (callers must be
+    checkify-transformed — sim/engine.run_checked)."""
+    if cfg.invariant_mode not in ("record", "raise"):
+        raise ValueError(
+            f"invariant_mode={cfg.invariant_mode!r}: expected 'off', "
+            "'record', or 'raise'")
+    flags = violation_flags(state, cfg)
+    if injected is not None:
+        flags = flags | injected
+    if cfg.invariant_mode == "raise":
+        from jax.experimental import checkify
+        viol = flags & U32(VIOLATION_MASK)
+        checkify.check(viol == 0,
+                       "invariant violation: fault_flags={flags}",
+                       flags=viol)
+    return state._replace(fault_flags=state.fault_flags | flags)

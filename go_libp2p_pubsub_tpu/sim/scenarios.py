@@ -17,6 +17,14 @@ Each builder returns ``(cfg, tp, state)`` ready for ``engine.run``:
 5. ``router_sweep_100k`` — same 100k network built for each router variant
    (floodsub / randomsub / gossipsub) for the propagation-latency sweep.
 
+Beyond the five BASELINE configs, two FAULT scenarios (sim/faults.py
+plans attached to the config, PR 4):
+
+6. ``partition_50k``  — 50k peers, a scheduled 2-way partition with
+   RemovePeer-semantics cut + heal (delivery must recover >= 0.99).
+7. ``outage_10k``     — 10k peers + churn/PX; 20% of peers go dark for a
+   window and return through the churn/backoff/retention path.
+
 Seeds are fixed (314159, the reference's test seed —
 validation_builtin_test.go:25-27) so every scenario is deterministic.
 """
@@ -188,9 +196,69 @@ def router_sweep_100k(router: str, n_peers: int = 100_000, k_slots: int = 32,
     return cfg, TopicParams.disabled(1), init_state(cfg, topo)
 
 
+def partition_50k(n_peers: int = 50_000, k_slots: int = 32, degree: int = 12,
+                  n_topics: int = 2, start: int = 10, heal: int = 25,
+                  components: int = 2,
+                  ) -> tuple[SimConfig, TopicParams, SimState]:
+    """Fault scenario 6: 50k peers, full scoring, a 2-way network partition
+    on ticks [start, heal) — cross-component edges go down with RemovePeer
+    semantics and redial at ``heal`` (sim/faults.py). Within the window
+    each component's mesh self-heals internally; after the heal, delivery
+    recovers cross-component first through gossip IHAVE/IWANT over the
+    redialed (non-mesh) edges, then the heartbeat re-balances the mesh —
+    the gossipsub.go self-healing contract under the harshest single
+    fault. The acceptance check: delivery_fraction >= 0.99 within a
+    bounded tick budget after ``heal`` (tests/test_faults.py, batched AND
+    host runtime on the same plan shape)."""
+    from .faults import FaultPlan, PartitionWindow
+    rng = np.random.default_rng(SEED)
+    subscribed = rng.random((n_peers, n_topics)) < 0.7
+    subscribed[~subscribed.any(axis=1), 0] = True
+    cfg = SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=n_topics, msg_window=64,
+        publishers_per_tick=16, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
+        publish_threshold=-200.0, graylist_threshold=-300.0,
+        retain_score_ticks=30,
+        fault_plan=FaultPlan(partitions=(
+            PartitionWindow(start, heal, components=components),)))
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    return cfg, default_topic_params(n_topics), \
+        init_state(cfg, topo, subscribed=subscribed)
+
+
+def outage_10k(n_peers: int = 10_000, k_slots: int = 32, degree: int = 12,
+               fraction: float = 0.2, start: int = 10, heal: int = 25,
+               ) -> tuple[SimConfig, TopicParams, SimState]:
+    """Fault scenario 7: 10k peers with background churn + PX; a regional
+    outage takes ``fraction`` of the peers completely dark for ticks
+    [start, heal), then they return through the existing churn/backoff/
+    retention path (sim/faults.py outage semantics + ops/churn
+    bring_edges_up). Survivor meshes must re-knit around the dark region
+    (heartbeat under-subscription grafting) and re-admit the returners
+    without whitewashing their score history (retain_score_ticks covers
+    the window)."""
+    from .faults import FaultPlan, OutageWindow
+    cfg = SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=1, msg_window=64,
+        publishers_per_tick=8, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
+        publish_threshold=-200.0, graylist_threshold=-300.0,
+        churn_disconnect_prob=0.005, churn_reconnect_prob=0.2,
+        px_enabled=True, accept_px_threshold=-50.0, retain_score_ticks=30,
+        fault_plan=FaultPlan(outages=(
+            OutageWindow(start, heal, fraction=fraction),)))
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    return cfg, default_topic_params(1), init_state(cfg, topo)
+
+
 SCENARIOS = {
     "1k_single_topic": single_topic_1k,
     "10k_beacon": beacon_10k,
     "50k_churn": churn_50k,
     "100k_sybil": sybil_100k,
+    "50k_partition": partition_50k,
+    "10k_outage": outage_10k,
 }

@@ -112,6 +112,14 @@ class SimState(NamedTuple):
                                       #   trajectories are POISONED — raise
                                       #   SimConfig.halo_capacity_factor to
                                       #   required_capacity_factor()'s answer
+    fault_flags: jnp.ndarray          # scalar uint32 health word
+                                      #   (sim/invariants.py bit layout):
+                                      #   low byte = which FaultPlan faults
+                                      #   fired; bits 8+ = invariant
+                                      #   violations (any set => trajectory
+                                      #   suspect). Sticky across the scan;
+                                      #   emitted with every bench metric
+                                      #   line and trace export
 
 
 def init_state(cfg: SimConfig, topo: Topology,
@@ -199,4 +207,5 @@ def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
         iwant_pending=i32(n, m, fill=-1),
         delivered_total=jnp.float32(0.0),
         halo_overflow=jnp.int32(0),
+        fault_flags=jnp.uint32(0),
     )

@@ -108,20 +108,34 @@ def export_events(prev: SimState, cur: SimState,
 
 
 def run_traced(state: SimState, cfg: SimConfig, tp: TopicParams, key,
-               n_ticks: int):
+               n_ticks: int, health_out: list | None = None):
     """Host-stepped run collecting the exported event stream.
 
     Returns (final_state, events). Requires cfg.record_provenance. Intended
     for differential testing and trace tooling at diagnostic scale — the
     per-tick host sync makes it unfit for benchmarking.
+
+    ``health_out``: optional list that receives one record per tick —
+    ``{"tick", "fault_flags", "flags"}`` (sim/invariants.py bit layout,
+    decoded names included) — so an exported trace always travels with its
+    health word and a poisoned or fault-injected run can never be analyzed
+    as a clean one. Kept OUT of the event stream itself: the pb/trace wire
+    schema (pb/codec.py) has no health message, and replay consumers must
+    keep round-tripping byte-exact.
     """
     assert cfg.record_provenance, "run_traced needs cfg.record_provenance"
     from .engine import step_jit
+    from .invariants import decode_flags
 
     events: list[dict] = []
     for i in range(n_ticks):
         key, k = jax.random.split(key)
         nxt = step_jit(state, cfg, tp, k)
         events.extend(export_events(state, nxt))
+        if health_out is not None and cfg.invariant_mode != "off":
+            flags = int(np.asarray(nxt.fault_flags))
+            health_out.append({"tick": int(np.asarray(state.tick)),
+                               "fault_flags": flags,
+                               "flags": decode_flags(flags)})
         state = nxt
     return state, events

@@ -111,6 +111,25 @@ def main() -> int:
               tab_wn & U32(0xF0F0F0F0), jnp.full((w, 1), U32(0xFFFFFFFF)),
               planes_u8[:, 0, :], topic_bits, nbr, m=m, gather="mxu",
               interpret=i))
+    # --- engine-shaped emit probe (ADVICE r5): the emit kernel mixes
+    # prefix_count_words + pack_words in-kernel (1-D iota, masked.T
+    # transpose) — the op class Mosaic has historically refused even
+    # where interpret mode is exact. This drives the EXACT path the
+    # engine would take with hop_mode="pallas" at an engine-real shape
+    # (m=128 -> w=4, binding budget): if it FAILS natively while the
+    # small emit checks above pass, the pallas emit promotion stays
+    # blocked (resolve_emit_mode docstring).
+    m_eng, w_eng = 128, 4
+    tab_eng = jnp.asarray(
+        rng.integers(0, 2**32, (w_eng, n), dtype=np.uint64), U32)
+    topic_eng = jnp.asarray(
+        rng.integers(0, 2**32, (t, w_eng), dtype=np.uint64), U32)
+    assert hk.resolve_emit_mode("pallas", w_eng, n, k) == "pallas", \
+        "engine-shaped emit probe no longer matches resolve_emit_mode"
+    check("emit resolve path (engine-shaped)",
+          lambda i: hk.emit_dispatch(
+              tab_eng, tab_eng ^ U32(0xA5A5A5A5), planes_u8, topic_eng,
+              nbr, m=m_eng, budget=min(5000, m_eng), interpret=i))
     # --- the Mosaic gather wall, distilled (VERDICT r4 item 3) ---------
     # The exact failure that killed the S1-S7 fused kernels: a table
     # lookup wider than one vreg. Re-tested every window; if it ever

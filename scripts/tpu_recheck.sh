@@ -15,6 +15,8 @@ for step in "bench:python bench.py" \
             "acc_i32:env GRAFT_COUNT_DTYPE=int32 BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "headline_k16:env BENCH_K=16 BENCH_SCENARIOS=headline python bench.py" \
             "headline_k16_i32:env BENCH_K=16 GRAFT_COUNT_DTYPE=int32 BENCH_SCENARIOS=headline python bench.py" \
+            "faults_degraded:env GRAFT_FAULT_PLAN=partition=2@3:8,drop=0.02 BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "invariants_off:env GRAFT_INVARIANT_MODE=off BENCH_SCENARIOS=1k_single_topic,headline python bench.py" \
             "modes_rows:env GRAFT_EDGE_GATHER=rows BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_scalar:env GRAFT_EDGE_GATHER=scalar BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_iter:env GRAFT_SELECTION=iter BENCH_SCENARIOS=10k_beacon,headline python bench.py" \

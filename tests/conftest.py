@@ -74,10 +74,35 @@ _SLOW = {
 }
 
 
+# ---- optional-dependency gating ---------------------------------------------
+# api/sign.py and api/peer_record.py degrade gracefully without the
+# 'cryptography' package (minimal images; PR 4 robustness): the modules
+# import, LAX_NO_SIGN swarms run, and only the ed25519 entry points raise.
+# Tests that genuinely NEED signing/sealed-record crypto skip instead of
+# failing — full environments run them all.
+
+try:
+    import cryptography  # noqa: F401
+    _HAVE_CRYPTO = True
+except ImportError:
+    _HAVE_CRYPTO = False
+
+_NEEDS_CRYPTO = {
+    "test_px_records.py": ("TestEnvelope", "TestPXDialGate",
+                           "TestPruneAttachesRecords"),
+    "test_functional_runtime.py": ("TestSigning", "TestInvalidAuthor"),
+}
+
+
 def pytest_collection_modifyitems(config, items):
+    skip_crypto = pytest.mark.skip(
+        reason="needs the optional 'cryptography' package (ed25519)")
     for item in items:
         pats = _SLOW.get(item.path.name)
-        if pats is None:
-            continue
-        if pats is ALL or any(p in item.nodeid for p in pats):
+        if pats is not None and (pats is ALL
+                                 or any(p in item.nodeid for p in pats)):
             item.add_marker(pytest.mark.slow)
+        if not _HAVE_CRYPTO:
+            cpats = _NEEDS_CRYPTO.get(item.path.name)
+            if cpats is not None and any(p in item.nodeid for p in cpats):
+                item.add_marker(skip_crypto)

@@ -1,0 +1,450 @@
+"""Fault injection + the invariant sentinel (sim/faults.py, sim/invariants.py).
+
+Acceptance contract of the fault plane (ISSUE 4): clean BASELINE scenarios
+run with ``fault_flags == 0`` over 20+ ticks; a seeded plan sets EXACTLY
+the expected injected-fault bits and no violation bits; a partition heals
+back to ``delivery_fraction >= 0.99`` within a bounded tick budget in BOTH
+the batched engine and the host-side functional runtime driven by the same
+plan shape; seeded state poison trips the sentinel in ``record`` mode and
+throws in ``raise`` mode.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import (
+    SimConfig, TopicParams, init_state, topology,
+)
+from go_libp2p_pubsub_tpu.sim import invariants, scenarios
+from go_libp2p_pubsub_tpu.sim.engine import (
+    delivery_fraction, run, run_checked, step_jit,
+)
+from go_libp2p_pubsub_tpu.sim.faults import (
+    FaultPlan, HostFaultInjector, OutageWindow, PartitionWindow,
+    outage_peers_host,
+)
+
+pytestmark = pytest.mark.faults
+
+
+def _cfg(n=64, k=16, degree=8, plan=None, **kw):
+    base = dict(n_peers=n, k_slots=k, n_topics=1, msg_window=32,
+                publishers_per_tick=4, prop_substeps=6,
+                scoring_enabled=True, fault_plan=plan)
+    base.update(kw)
+    cfg = SimConfig(**base)
+    topo = topology.sparse(n, k, degree=degree, seed=7)
+    return cfg, scenarios.default_topic_params(cfg.n_topics), \
+        init_state(cfg, topo)
+
+
+class TestCleanScenariosZeroFlags:
+    def test_baseline_scenarios_run_clean(self):
+        """fault_flags == 0 across the clean BASELINE builders, 24 ticks
+        each at toy scale — the engine must never trip its own sentinel."""
+        clean = {k: v for k, v in scenarios.SCENARIOS.items()
+                 if k not in ("50k_partition", "10k_outage")}
+        for name, builder in clean.items():
+            cfg, tp, st = builder(n_peers=96, k_slots=16, degree=6)
+            assert cfg.invariant_mode == "record"
+            st = run(st, cfg, tp, jax.random.PRNGKey(0), 24)
+            assert int(st.fault_flags) == 0, \
+                (name, invariants.decode_flags(int(st.fault_flags)))
+
+    def test_router_sweep_runs_clean(self):
+        for r in ("floodsub", "randomsub", "gossipsub"):
+            cfg, tp, st = scenarios.router_sweep_100k(r, n_peers=96,
+                                                      k_slots=16, degree=6)
+            st = run(st, cfg, tp, jax.random.PRNGKey(0), 20)
+            assert int(st.fault_flags) == 0, r
+
+    def test_fault_scenarios_clean_before_window(self):
+        """The two fault scenarios carry plans starting at tick 10: the
+        pre-window prefix must be flag-free, the full run must set exactly
+        the plan's bit and no violations."""
+        for name, bit in (("50k_partition", invariants.FAULT_PARTITION),
+                          ("10k_outage", invariants.FAULT_OUTAGE)):
+            cfg, tp, st = scenarios.SCENARIOS[name](n_peers=96, k_slots=16,
+                                                    degree=6)
+            pre = run(st, cfg, tp, jax.random.PRNGKey(0), 8)
+            assert int(pre.fault_flags) == 0, name
+            full = run(st, cfg, tp, jax.random.PRNGKey(0), 30)
+            assert int(full.fault_flags) == bit, \
+                (name, invariants.decode_flags(int(full.fault_flags)))
+
+
+class TestInjectedBitsExact:
+    def test_partition_sets_exactly_partition_bit(self):
+        plan = FaultPlan(partitions=(PartitionWindow(3, 8, components=2),))
+        cfg, tp, st = _cfg(plan=plan)
+        st = run(st, cfg, tp, jax.random.PRNGKey(1), 12)
+        assert int(st.fault_flags) == invariants.FAULT_PARTITION
+
+    def test_each_fault_class_sets_its_bit(self):
+        for plan, bit in (
+                (FaultPlan(link_drop_prob=0.3), invariants.FAULT_LINK_DROP),
+                (FaultPlan(link_dup_prob=0.3), invariants.FAULT_LINK_DUP),
+                (FaultPlan(corrupt_prob=0.5), invariants.FAULT_CORRUPT),
+                (FaultPlan(outages=(OutageWindow(2, 6, fraction=0.25),)),
+                 invariants.FAULT_OUTAGE)):
+            cfg, tp, st = _cfg(plan=plan)
+            st = run(st, cfg, tp, jax.random.PRNGKey(2), 8)
+            assert int(st.fault_flags) == bit, invariants.decode_flags(
+                int(st.fault_flags))
+
+    def test_combined_plan_sets_union(self):
+        plan = FaultPlan(link_drop_prob=0.2, corrupt_prob=0.5,
+                         partitions=(PartitionWindow(2, 5),))
+        cfg, tp, st = _cfg(plan=plan)
+        st = run(st, cfg, tp, jax.random.PRNGKey(3), 8)
+        want = (invariants.FAULT_LINK_DROP | invariants.FAULT_CORRUPT
+                | invariants.FAULT_PARTITION)
+        assert int(st.fault_flags) == want
+
+    def test_null_plan_is_flag_free(self):
+        cfg, tp, st = _cfg(plan=FaultPlan())
+        st = run(st, cfg, tp, jax.random.PRNGKey(4), 8)
+        assert int(st.fault_flags) == 0
+
+
+class TestPartitionSemantics:
+    def test_cut_heal_connectivity(self):
+        plan = FaultPlan(partitions=(PartitionWindow(2, 6, components=2),))
+        cfg, tp, st = _cfg(plan=plan)
+        nbr = np.asarray(st.neighbors)
+        known = (nbr >= 0) & (np.asarray(st.reverse_slot) >= 0)
+        cross = known & ((np.arange(cfg.n_peers)[:, None] % 2)
+                         != (np.clip(nbr, 0, None) % 2))
+        mid = run(st, cfg, tp, jax.random.PRNGKey(5), 4)   # inside window
+        conn_mid = np.asarray(mid.connected)
+        assert not conn_mid[cross].any()                   # cut edges down
+        assert conn_mid[known & ~cross].all()              # others untouched
+        # mesh must not reference the cut edges (RemovePeer semantics)
+        assert not (np.asarray(mid.mesh) & cross[:, None, :]).any()
+        end = run(st, cfg, tp, jax.random.PRNGKey(5), 8)   # past heal
+        assert np.asarray(end.connected)[known].all()      # healed
+
+    def test_partition_recovers_delivery(self):
+        """The acceptance bar, batched half: the partition_50k scenario
+        shape at toy N recovers delivery_fraction >= 0.99 within a bounded
+        budget after heal (window [5, 12), recovery check at tick 25 —
+        the live message window is then entirely post-heal)."""
+        cfg, tp, st = scenarios.partition_50k(
+            n_peers=128, k_slots=16, degree=8, start=5, heal=12)
+        mid = run(st, cfg, tp, jax.random.PRNGKey(6), 11)
+        mid_frac = float(delivery_fraction(mid, cfg))
+        end = run(st, cfg, tp, jax.random.PRNGKey(6), 25)
+        end_frac = float(delivery_fraction(end, cfg))
+        # during the partition, cross-component deliveries are impossible
+        assert mid_frac < 0.95, mid_frac
+        assert end_frac >= 0.99, end_frac
+        assert int(end.fault_flags) == invariants.FAULT_PARTITION
+
+    def test_heal_redials_only_the_plan_cut(self):
+        """A heal must redial exactly the ending window's own cut set —
+        an edge ordinary churn (or a test) took down stays on the normal
+        reconnect path (code-review finding: a blanket heal bypassed the
+        churn_reconnect_prob/PX gates for unrelated down edges)."""
+        plan = FaultPlan(partitions=(PartitionWindow(2, 5, components=2),))
+        cfg, tp, st = _cfg(plan=plan)
+        nbr = np.asarray(st.neighbors)
+        known = (nbr >= 0) & (np.asarray(st.reverse_slot) >= 0)
+        cross = known & ((np.arange(cfg.n_peers)[:, None] % 2)
+                         != (np.clip(nbr, 0, None) % 2))
+        # take one SAME-component and one CROSS-component edge down
+        # OUTSIDE the plan (pre-window, disconnect_tick=0 < start): the
+        # heal must redial neither — the cross one was down before the
+        # window opened, so the window never cut it (disconnect-stamp
+        # gate in edge_cut_mask)
+        conn, dt = st.connected, st.disconnect_tick
+        downed = []
+        for pick in (known & ~cross, known & cross):
+            i, s = map(int, np.argwhere(pick)[0])
+            j, rs = int(nbr[i, s]), int(np.asarray(st.reverse_slot)[i, s])
+            conn = conn.at[i, s].set(False).at[j, rs].set(False)
+            dt = dt.at[i, s].set(0).at[j, rs].set(0)
+            downed.append((i, s, j, rs))
+        st = st._replace(connected=conn, disconnect_tick=dt)
+        end = run(st, cfg, tp, jax.random.PRNGKey(5), 8)   # past heal at 5
+        conn_end = np.asarray(end.connected)
+        pre_downed = np.zeros_like(conn_end)
+        for i, s, j, rs in downed:
+            pre_downed[i, s] = pre_downed[j, rs] = True
+        assert conn_end[cross & ~pre_downed].all()  # the plan's cut healed
+        assert not conn_end[pre_downed].any()       # pre-window downs stay
+
+    def test_back_to_back_windows_still_heal(self):
+        """Back-to-back (and overlapping) windows over the same edges: the
+        later window inherits the earlier cut (the edge's disconnect stamp
+        predates its start) and must heal it at its own end — the batched
+        twin of the host injector's _reknit bookkeeping (code-review
+        finding: the stamp gate alone left shared cuts down forever)."""
+        plan = FaultPlan(partitions=(PartitionWindow(2, 5, components=2),
+                                     PartitionWindow(5, 8, components=2),))
+        cfg, tp, st = _cfg(plan=plan)
+        known = (np.asarray(st.neighbors) >= 0) \
+            & (np.asarray(st.reverse_slot) >= 0)
+        mid = run(st, cfg, tp, jax.random.PRNGKey(5), 7)   # inside window 2
+        assert not np.asarray(mid.connected)[known].all()  # still cut
+        end = run(st, cfg, tp, jax.random.PRNGKey(5), 10)  # past both ends
+        assert np.asarray(end.connected)[known].all(), \
+            "shared cut edges never healed after the window chain ended"
+
+    def test_outage_darkens_and_returns(self):
+        plan = FaultPlan(outages=(OutageWindow(2, 7, fraction=0.3),))
+        cfg, tp, st = _cfg(plan=plan, retain_score_ticks=30)
+        dark = np.asarray(outage_peers_host(cfg.n_peers, 0, plan))
+        assert 0 < dark.sum() < cfg.n_peers
+        known = (np.asarray(st.neighbors) >= 0) \
+            & (np.asarray(st.reverse_slot) >= 0)
+        mid = run(st, cfg, tp, jax.random.PRNGKey(7), 5)
+        conn = np.asarray(mid.connected)
+        assert not conn[dark].any()                     # dark side down
+        nbr_dark = dark[np.clip(np.asarray(st.neighbors), 0, None)]
+        assert not conn[known & nbr_dark].any()         # both directions
+        end = run(st, cfg, tp, jax.random.PRNGKey(7), 10)
+        assert np.asarray(end.connected)[known].all()   # returned
+        # outage_10k scenario shape builds and recovers at toy scale
+        cfg2, tp2, st2 = scenarios.outage_10k(n_peers=96, k_slots=16,
+                                              degree=8, start=3, heal=8)
+        end2 = run(st2, cfg2, tp2, jax.random.PRNGKey(8), 20)
+        assert float(delivery_fraction(end2, cfg2)) > 0.95
+        assert int(end2.fault_flags) & invariants.FAULT_OUTAGE
+
+
+class TestLinkFaults:
+    def test_drop_degrades_delivery(self):
+        clean_cfg, tp, st = _cfg(plan=None)
+        lossy_cfg = dataclasses.replace(clean_cfg,
+                                        fault_plan=FaultPlan(
+                                            link_drop_prob=0.6))
+        clean = run(st, clean_cfg, tp, jax.random.PRNGKey(9), 10)
+        lossy = run(st, lossy_cfg, tp, jax.random.PRNGKey(9), 10)
+        assert float(delivery_fraction(lossy, lossy_cfg)) < \
+            float(delivery_fraction(clean, clean_cfg))
+        # the drop bit and NO violation bits — lossy is degraded, not
+        # poisoned (link-eaten answers do charge P7 broken promises, the
+        # host tracer's expiry-based semantics, but that is scoring, not
+        # an invariant violation)
+        assert int(lossy.fault_flags) == invariants.FAULT_LINK_DROP
+
+    def test_dup_feeds_duplicate_stats(self):
+        # the P3 duplicate-credit window must be open for a re-offer of a
+        # previously-delivered message to earn mesh credit (score.go:949-981
+        # windowed duplicates; window 0 = same-tick only). Both plans are
+        # non-None so the RNG streams match and the dup wiring is the ONLY
+        # difference.
+        plan = FaultPlan(link_dup_prob=1.0)
+        cfg, tp, st = _cfg(plan=plan,
+                           mesh_message_deliveries_window_ticks=2)
+        clean = run(st, dataclasses.replace(cfg, fault_plan=FaultPlan()),
+                    tp, jax.random.PRNGKey(10), 6)
+        dup = run(st, cfg, tp, jax.random.PRNGKey(10), 6)
+        assert float(jnp.sum(dup.mesh_message_deliveries)) > \
+            float(jnp.sum(clean.mesh_message_deliveries))
+        assert int(dup.fault_flags) == invariants.FAULT_LINK_DUP
+        assert int(clean.fault_flags) == 0
+
+    def test_corrupt_feeds_p4(self):
+        plan = FaultPlan(corrupt_prob=0.5)
+        cfg, tp, st = _cfg(plan=plan)
+        clean = run(st, dataclasses.replace(cfg, fault_plan=None), tp,
+                    jax.random.PRNGKey(11), 10)
+        bad = run(st, cfg, tp, jax.random.PRNGKey(11), 10)
+        assert float(jnp.sum(clean.invalid_message_deliveries)) == 0.0
+        assert float(jnp.sum(bad.invalid_message_deliveries)) > 0.0
+        assert int(bad.fault_flags) == invariants.FAULT_CORRUPT
+
+
+class TestSentinel:
+    def test_record_mode_flags_poison(self):
+        cfg, tp, st = _cfg()
+        poisoned = st._replace(first_message_deliveries=(
+            st.first_message_deliveries.at[0, 0, 0].set(jnp.nan)))
+        out = step_jit(poisoned, cfg, tp, jax.random.PRNGKey(0))
+        flags = int(out.fault_flags)
+        assert flags & invariants.FLAG_NONFINITE
+        # negative seed in a counter the tick carries verbatim (the gater
+        # stats are untouched when the gater is off): the zclamp at the
+        # scored counters' write sites would wash a seed there back to 0
+        neg = st._replace(gater_deliver=(
+            st.gater_deliver.at[0, 0].set(-3.0)))
+        out2 = step_jit(neg, cfg, tp, jax.random.PRNGKey(0))
+        assert int(out2.fault_flags) & invariants.FLAG_NEG_COUNTER
+
+    def test_record_mode_flags_dead_mesh_edge(self):
+        cfg, tp, st = _cfg()
+        st = run(st, cfg, tp, jax.random.PRNGKey(1), 5)
+        # point a mesh slot at a disconnected edge behind the engine's back
+        bad = st._replace(connected=st.connected.at[:, :].set(False))
+        out = step_jit(bad, cfg, tp, jax.random.PRNGKey(2))
+        if bool(jnp.any(out.mesh)):
+            assert int(out.fault_flags) & invariants.FLAG_MESH_DEAD_EDGE
+
+    def test_slot_garbage_flagged(self):
+        cfg, tp, st = _cfg()
+        # deliver_from persists through a provenance-free tick (dormant
+        # buffer), so seeded garbage survives to the end-of-tick check —
+        # iwant_pending would be consumed and rewritten by the emit step
+        bad = st._replace(deliver_from=st.deliver_from.at[0, 0].set(99))
+        out = step_jit(bad, cfg, tp, jax.random.PRNGKey(0))
+        assert int(out.fault_flags) & invariants.FLAG_SLOT_GARBAGE
+
+    def test_deliver_future_flagged(self):
+        cfg, tp, st = _cfg()
+        # slot 10 is not recycled at tick 0 (publish rotates slots 0..P-1)
+        bad = st._replace(deliver_tick=st.deliver_tick.at[0, 10].set(500),
+                          have=st.have.at[0, 10].set(True))
+        out = step_jit(bad, cfg, tp, jax.random.PRNGKey(0))
+        assert int(out.fault_flags) & invariants.FLAG_DELIVER_FUTURE
+
+    def test_off_mode_writes_nothing(self):
+        cfg, tp, st = _cfg(invariant_mode="off")
+        bad = st._replace(delivered_total=jnp.float32(-1.0))
+        out = step_jit(bad, cfg, tp, jax.random.PRNGKey(0))
+        assert int(out.fault_flags) == 0
+
+    def test_raise_mode_throws_on_poison_not_on_clean(self):
+        cfg, tp, st = _cfg(invariant_mode="raise")
+        # clean: no throw
+        out = run_checked(st, cfg, tp, jax.random.PRNGKey(0), 4)
+        assert int(out.tick) == 4
+        # mesh_failure_penalty has no cap to wash an Inf back to finite
+        poisoned = st._replace(mesh_failure_penalty=(
+            st.mesh_failure_penalty.at[0, 0, 0].set(jnp.inf)))
+        with pytest.raises(Exception, match="invariant violation"):
+            run_checked(poisoned, cfg, tp, jax.random.PRNGKey(0), 4)
+
+    def test_decode_flags_names(self):
+        names = invariants.decode_flags(
+            invariants.FAULT_PARTITION | invariants.FLAG_NONFINITE)
+        assert names == ["partition", "VIOLATION:nonfinite_counter"]
+        assert invariants.decode_flags(0) == []
+
+
+class TestTraceExportHealth:
+    def test_run_traced_emits_health_records(self):
+        from go_libp2p_pubsub_tpu.sim.trace_export import run_traced
+        plan = FaultPlan(partitions=(PartitionWindow(1, 3),))
+        cfg, tp, st = _cfg(n=24, k=8, degree=4, plan=plan,
+                           record_provenance=True)
+        health = []
+        st, events = run_traced(st, cfg, tp, jax.random.PRNGKey(0), 4,
+                                health_out=health)
+        assert len(health) == 4
+        assert [h["tick"] for h in health] == [0, 1, 2, 3]
+        assert health[0]["fault_flags"] == 0          # pre-window tick
+        assert health[1]["fault_flags"] == invariants.FAULT_PARTITION
+        assert health[1]["flags"] == ["partition"]
+        # the flag word is sticky: later ticks keep the marker
+        assert health[3]["fault_flags"] == invariants.FAULT_PARTITION
+        assert events, "event stream must still export"
+
+
+class TestPlanParse:
+    def test_parse_roundtrip(self):
+        plan = FaultPlan.parse(
+            "drop=0.05,dup=0.01,corrupt=0.1,partition=2@10:30,"
+            "outage=0.2@5:15,seed=7")
+        assert plan == FaultPlan(
+            link_drop_prob=0.05, link_dup_prob=0.01, corrupt_prob=0.1,
+            partitions=(PartitionWindow(10, 30, components=2),),
+            outages=(OutageWindow(5, 15, fraction=0.2),), seed=7)
+
+    def test_parse_rejects_unknown(self):
+        with pytest.raises(ValueError, match="unknown fault-plan item"):
+            FaultPlan.parse("chaos=1")
+
+
+class TestHostRuntimeParity:
+    """The same plan shape against the functional runtime: partition-heal
+    recovery parity with the batched half (>= 0.99 of subscribers get a
+    post-heal publish), and the link hook's drop behavior."""
+
+    def _swarm(self, n):
+        from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+        from go_libp2p_pubsub_tpu.net import Network
+        from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+        net = Network()
+        nodes = [PubSub(net.add_host(), GossipSubRouter(),
+                        sign_policy=LAX_NO_SIGN) for _ in range(n)]
+        net.dense_connect([p.host for p in nodes], degree=8)
+        subs = [p.join("t").subscribe() for p in nodes]
+        return net, nodes, subs
+
+    def test_host_partition_heals(self):
+        net, nodes, subs = self._swarm(20)
+        plan = FaultPlan(partitions=(PartitionWindow(3, 10, components=2),))
+        HostFaultInjector(net, [p.host for p in nodes], plan)
+        net.scheduler.run_for(6.0)       # mesh forms, partition bites
+        # inside the window: a publish from component 0 stays there
+        nodes[0].my_topics["t"].publish(b"partitioned")
+        net.scheduler.run_for(2.0)
+        got_mid = [s.next() is not None for s in subs[1:]]
+        comp = [i % 2 for i in range(1, 20)]
+        cross_got = [g for g, c in zip(got_mid, comp) if c == 1]
+        assert not any(cross_got)        # nothing crossed the cut
+        # past heal + recovery budget: a fresh publish reaches everyone
+        net.scheduler.run_for(8.0)       # heal at t=10, settle to t=16
+        nodes[0].my_topics["t"].publish(b"healed")
+        net.scheduler.run_for(3.0)
+        got = sum(1 for s in subs[1:]
+                  if self._drain_for(s, b"healed"))
+        assert got / (len(subs) - 1) >= 0.99, got
+
+    @staticmethod
+    def _drain_for(sub, payload):
+        while (m := sub.next()) is not None:
+            if m.data == payload:
+                return True
+        return False
+
+    def test_host_outage_matches_batched_peer_choice(self):
+        net, nodes, subs = self._swarm(12)
+        plan = FaultPlan(outages=(OutageWindow(2, 5, fraction=0.3),), seed=3)
+        inj = HostFaultInjector(net, [p.host for p in nodes], plan)
+        dark = outage_peers_host(12, 0, plan)
+        net.scheduler.run_for(3.0)       # inside the outage window
+        for i, p in enumerate(nodes):
+            if dark[i]:
+                assert not p.host.conns, f"dark peer {i} kept connections"
+        net.scheduler.run_for(4.0)       # past the window end at t=5
+        for i, p in enumerate(nodes):
+            assert p.host.conns, f"peer {i} never came back"
+        assert inj.plan is plan
+
+    def test_host_overlapping_windows_reknit_correctly(self):
+        """Overlapping windows (code-review finding): a window's end must
+        restore only pairs no OTHER active window still cuts, and an
+        outage ending must not un-darken another window's peers."""
+        net, nodes, subs = self._swarm(12)
+        plan = FaultPlan(partitions=(PartitionWindow(2, 6, components=2),),
+                         outages=(OutageWindow(4, 9, fraction=0.3),), seed=3)
+        HostFaultInjector(net, [p.host for p in nodes], plan)
+        dark = outage_peers_host(12, 0, plan)
+        net.scheduler.run_for(7.0)    # partition ended at 6, outage live
+        for i, p in enumerate(nodes):
+            if dark[i]:
+                assert not p.host.conns, f"dark peer {i} resurrected by " \
+                    "the partition window's end"
+            else:
+                # lit peers regained their cross-component lit pairs
+                assert p.host.conns, f"lit peer {i} still fully severed"
+        net.scheduler.run_for(3.0)    # outage ends at 9
+        for i, p in enumerate(nodes):
+            assert p.host.conns, f"peer {i} never came back"
+
+    def test_host_link_drop_counts_faulted(self):
+        net, nodes, subs = self._swarm(8)
+        plan = FaultPlan(link_drop_prob=1.0)
+        HostFaultInjector(net, [p.host for p in nodes], plan)
+        net.scheduler.run_for(3.0)
+        nodes[0].my_topics["t"].publish(b"x")
+        net.scheduler.run_for(2.0)
+        # every RPC was eaten by the link: nothing delivered anywhere else
+        assert all(s.next() is None for s in subs[1:])
+        assert sum(p.host.faulted_rpcs for p in nodes) > 0

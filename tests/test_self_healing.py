@@ -1,0 +1,126 @@
+"""Mesh self-healing time (the gossipsub.go heartbeat contract).
+
+After a forced disconnect burst in a churn_50k-style config, the mean mesh
+degree must recover to >= D_lo within a bounded number of ticks — the
+heartbeat's under-subscription grafting plus churn's reconnect path
+(gossipsub.go:1413-1427 grafting, pubsub.go:711-757 dead-peer lifecycle).
+Checked in BOTH halves: the batched engine (ops/churn take_edges_down as
+the burst, churn reconnects as the recovery) and the host-side functional
+runtime (Host.disconnect burst, surviving connections regraft).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.ops.churn import take_edges_down
+from go_libp2p_pubsub_tpu.sim import (
+    SimConfig, init_state, mesh_degrees, run, topology,
+)
+from go_libp2p_pubsub_tpu.sim import scenarios
+
+pytestmark = pytest.mark.faults
+
+RECOVERY_BUDGET_TICKS = 25
+
+
+def _symmetric_burst(topo, fraction, seed=5):
+    """[N, K] symmetric edge mask: the unordered pair's hash decides, so
+    both directions go down together (the TCP-stream contract the batched
+    churn requires)."""
+    nbr = topo.neighbors
+    n, k = nbr.shape
+    rng_vals = {}
+    mask = np.zeros((n, k), bool)
+    rs = np.random.RandomState(seed)
+    for i in range(n):
+        for s in range(k):
+            j = nbr[i, s]
+            if j < 0:
+                continue
+            pair = (min(i, int(j)), max(i, int(j)))
+            if pair not in rng_vals:
+                rng_vals[pair] = rs.rand() < fraction
+            mask[i, s] = rng_vals[pair]
+    return mask
+
+
+class TestBatchedSelfHealing:
+    def test_degree_recovers_after_burst(self):
+        """churn_50k-style config at toy scale: converge, burst 50% of
+        edges down, recover mean mesh degree >= D_lo within the budget."""
+        cfg = SimConfig(
+            n_peers=64, k_slots=16, n_topics=1, msg_window=32,
+            publishers_per_tick=2, prop_substeps=6,
+            scoring_enabled=True, retain_score_ticks=30,
+            churn_disconnect_prob=0.0, churn_reconnect_prob=0.3,
+            px_enabled=True, accept_px_threshold=-50.0)
+        topo = topology.dense(cfg.n_peers, cfg.k_slots, degree=10)
+        tp = scenarios.default_topic_params(1)
+        st = init_state(cfg, topo)
+        st = run(st, cfg, tp, jax.random.PRNGKey(0), 15)
+        deg0 = float(np.asarray(mesh_degrees(st)).mean())
+        assert deg0 >= cfg.dlo, f"mesh never converged: {deg0}"
+
+        burst = jnp.asarray(_symmetric_burst(topo, 0.5)) & st.connected
+        st_b = take_edges_down(st, cfg, tp, burst)
+        deg_b = float(np.asarray(mesh_degrees(st_b)).mean())
+        assert deg_b < deg0, "burst did not dent the mesh"
+
+        st_r = run(st_b, cfg, tp, jax.random.PRNGKey(1),
+                   RECOVERY_BUDGET_TICKS)
+        deg_r = float(np.asarray(mesh_degrees(st_r)).mean())
+        assert deg_r >= cfg.dlo, \
+            f"mesh degree {deg_r} < D_lo {cfg.dlo} after " \
+            f"{RECOVERY_BUDGET_TICKS} ticks (was {deg_b} post-burst)"
+        # the recovery must not have tripped the sentinel
+        assert int(st_r.fault_flags) == 0
+
+    def test_burst_stamps_disconnect_and_clears_mesh(self):
+        cfg = SimConfig(n_peers=32, k_slots=8, n_topics=1, msg_window=32,
+                        publishers_per_tick=2, prop_substeps=4)
+        topo = topology.dense(cfg.n_peers, cfg.k_slots, degree=6)
+        tp = scenarios.default_topic_params(1)
+        st = run(init_state(cfg, topo), cfg, tp, jax.random.PRNGKey(0), 5)
+        burst = jnp.asarray(_symmetric_burst(topo, 0.5)) & st.connected
+        st_b = take_edges_down(st, cfg, tp, burst)
+        b = np.asarray(burst)
+        assert not np.asarray(st_b.connected)[b].any()
+        assert not (np.asarray(st_b.mesh) & b[:, None, :]).any()
+        assert (np.asarray(st_b.disconnect_tick)[b] == int(st.tick)).all()
+
+
+class TestHostSelfHealing:
+    def test_degree_recovers_after_burst(self):
+        """Functional-runtime twin: disconnect ~1/3 of each node's
+        connections, let the heartbeat regraft among the survivors, and
+        require mean mesh degree >= D_lo within the same tick budget
+        (1 tick == 1 s == 1 heartbeat)."""
+        from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+        from go_libp2p_pubsub_tpu.net import Network
+        from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+
+        net = Network()
+        nodes = [PubSub(net.add_host(), GossipSubRouter(),
+                        sign_policy=LAX_NO_SIGN) for _ in range(20)]
+        net.dense_connect([p.host for p in nodes], degree=12)
+        [p.join("t").subscribe() for p in nodes]
+        net.scheduler.run_for(5.0)
+        dlo = nodes[0].rt.params.dlo
+        deg0 = np.mean([len(p.rt.mesh.get("t", ())) for p in nodes])
+        assert deg0 >= dlo, f"mesh never converged: {deg0}"
+
+        rs = np.random.RandomState(11)
+        for i, p in enumerate(nodes):
+            for pid in list(p.host.conns):
+                if rs.rand() < 0.33:
+                    p.host.disconnect(pid)
+        deg_b = np.mean([len(p.rt.mesh.get("t", ())) for p in nodes])
+        assert deg_b < deg0, "burst did not dent the mesh"
+
+        net.scheduler.run_for(float(RECOVERY_BUDGET_TICKS))
+        deg_r = np.mean([len(p.rt.mesh.get("t", ())) for p in nodes])
+        assert deg_r >= dlo, \
+            f"host mesh degree {deg_r} < D_lo {dlo} after " \
+            f"{RECOVERY_BUDGET_TICKS} heartbeats (was {deg_b} post-burst)"
