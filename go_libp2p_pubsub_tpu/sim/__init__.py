@@ -4,6 +4,8 @@ from . import topology  # noqa: F401
 
 _ENGINE_EXPORTS = ("delivery_fraction", "delivery_latency_ticks", "mesh_degrees", "run", "step", "step_jit",
                    "choose_publishers")
+_SUPERVISOR_EXPORTS = ("supervised_run", "SupervisorConfig",
+                       "SupervisorReport", "SupervisorCrash")
 
 
 def __getattr__(name):
@@ -12,4 +14,7 @@ def __getattr__(name):
     if name in _ENGINE_EXPORTS:
         from . import engine
         return getattr(engine, name)
+    if name in _SUPERVISOR_EXPORTS:
+        from . import supervisor
+        return getattr(supervisor, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

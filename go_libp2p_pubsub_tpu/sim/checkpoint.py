@@ -22,18 +22,37 @@ forward-compat path for fields added later, e.g. provenance buffers or
 config fingerprint in a ``<path>.fingerprint`` sidecar; ``restore(...,
 cfg=...)`` compares and raises on mismatch (a missing sidecar — an older
 checkpoint — is tolerated).
+
+Crash atomicity: ``save`` writes BOTH artifacts (orbax dir / .npz payload
+and the fingerprint sidecar) to temp paths and renames them into place,
+payload first — a kill mid-save leaves either the previous checkpoint
+intact or nothing at the target path, never a torn payload that
+``restore`` half-accepts. A checkpoint that IS torn some other way
+(truncated file, gutted orbax dir) raises :class:`CheckpointCorrupt`
+(a ``ValueError``) rather than surfacing a backend internal — the
+supervisor (sim/supervisor.py) catches it and falls back to the previous
+checkpoint.
 """
 
 from __future__ import annotations
 
+import glob
 import hashlib
 import os
+import shutil
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .state import SimState
+
+
+class CheckpointCorrupt(ValueError):
+    """The checkpoint payload is unreadable (torn write, truncation,
+    missing files) — distinct from a *mismatched* checkpoint (plain
+    ``ValueError``), though both are ValueErrors so existing callers'
+    handling is unchanged."""
 
 try:
     import orbax.checkpoint as ocp
@@ -53,20 +72,59 @@ def _sidecar(path: str) -> str:
     return path + ".fingerprint"
 
 
+def _replace_path(tmp: str, final: str) -> None:
+    """Atomically move ``tmp`` into place at ``final`` (file or dir)."""
+    if os.path.isdir(final) and not os.path.islink(final):
+        shutil.rmtree(final)
+    elif os.path.lexists(final):
+        os.remove(final)
+    os.replace(tmp, final)
+
+
 def save(path: str, state: SimState, cfg=None) -> None:
     """Write a checkpoint directory (orbax) or .npz file (fallback); with
-    ``cfg``, stamp its fingerprint in a sidecar for restore to verify."""
+    ``cfg``, stamp its fingerprint in a sidecar for restore to verify.
+
+    Crash-atomic (module docstring): payload and sidecar each land via
+    temp-path + rename, payload before sidecar, so an interrupted save
+    can never leave a torn checkpoint at ``path``."""
     path = os.path.abspath(path)
+    tmp = f"{path}.tmp{os.getpid()}"
+    # sweep stale temps from killed saves — ANY pid's, not just ours: a
+    # kill-resume cycle runs under a fresh pid each time, and orphaned
+    # full-state payloads would otherwise accumulate unboundedly across a
+    # long unattended session (one checkpoint path has one writer at a
+    # time, so the sweep cannot race a live save)
+    for stale in glob.glob(f"{path}.tmp*") + \
+            glob.glob(f"{_sidecar(path)}.tmp*"):
+        if os.path.isdir(stale):
+            shutil.rmtree(stale, ignore_errors=True)
+        else:
+            try:
+                os.remove(stale)
+            except OSError:
+                pass
     if _HAVE_ORBAX and not path.endswith(".npz"):
         with ocp.StandardCheckpointer() as ckpt:
-            ckpt.save(path, jax.device_get(state))
+            ckpt.save(tmp, jax.device_get(state))
+        # the context exit waits out any async write; only a fully
+        # materialized payload ever reaches the final name
+        _replace_path(tmp, path)
     else:
         arrs = {f: np.asarray(v) for f, v in zip(SimState._fields, state)}
-        np.savez_compressed(path if path.endswith(".npz") else path + ".npz",
-                            **arrs)
+        final = path if path.endswith(".npz") else path + ".npz"
+        with open(tmp, "wb") as fh:      # file handle: savez can't rename it
+            np.savez_compressed(fh, **arrs)
+            fh.flush()
+            os.fsync(fh.fileno())
+        _replace_path(tmp, final)
     if cfg is not None:
-        with open(_sidecar(path), "w") as f:
+        side_tmp = f"{_sidecar(path)}.tmp{os.getpid()}"
+        with open(side_tmp, "w") as f:
             f.write(config_fingerprint(cfg) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        _replace_path(side_tmp, _sidecar(path))
 
 
 def _validate(field: str, got, want) -> None:
@@ -99,28 +157,53 @@ def restore(path: str, like: SimState, cfg=None) -> SimState:
     if _HAVE_ORBAX and os.path.isdir(path):
         with ocp.StandardCheckpointer() as ckpt:
             try:
-                out = ckpt.restore(path, jax.device_get(like))
+                try:
+                    out = ckpt.restore(path, jax.device_get(like))
+                except ValueError:
+                    # a checkpoint written before a SimState field existed
+                    # fails the full-target structure match ("Dict key
+                    # mismatch") — restore as-saved (orbax stores the
+                    # namedtuple as a field-keyed dict) and fill the missing
+                    # fields from ``like``, exactly like the npz branch
+                    raw = ckpt.restore(path)
+                    out = SimState(*[raw[f] if f in raw else getattr(like, f)
+                                     for f in SimState._fields])
             except ValueError:
-                # a checkpoint written before a SimState field existed
-                # fails the full-target structure match ("Dict key
-                # mismatch") — restore as-saved (orbax stores the
-                # namedtuple as a field-keyed dict) and fill the missing
-                # fields from ``like``, exactly like the npz branch
-                raw = ckpt.restore(path)
-                out = SimState(*[raw[f] if f in raw else getattr(like, f)
-                                 for f in SimState._fields])
+                raise                   # mismatch diagnostics pass through
+            except Exception as e:
+                # gutted dir / torn metadata: a clean, catchable error
+                # instead of an orbax internal (supervisor fallback path)
+                raise CheckpointCorrupt(
+                    f"checkpoint {path!r} is unreadable (torn or "
+                    f"incomplete write): {type(e).__name__}: {e}") from e
         for f, got, want in zip(SimState._fields, out, like):
             _validate(f, got, want)
         return SimState(*[jnp.asarray(x) for x in out])
-    npz = np.load(path if path.endswith(".npz") else path + ".npz")
+    npz_path = path if path.endswith(".npz") else path + ".npz"
+    try:
+        npz = np.load(npz_path)
+    except Exception as e:
+        # zipfile.BadZipFile / EOFError / OSError on a truncated or missing
+        # file — normalize to the one catchable corruption error
+        raise CheckpointCorrupt(
+            f"checkpoint {npz_path!r} is unreadable (torn or incomplete "
+            f"write): {type(e).__name__}: {e}") from e
     # fields added after a checkpoint was written restore from ``like``
     # (new fields carry inert defaults, e.g. provenance buffers at -1);
     # fields PRESENT must match ``like`` exactly — no silent acceptance
     vals = []
     for f in SimState._fields:
         if f in npz.files:
-            _validate(f, npz[f], getattr(like, f))
-            vals.append(jnp.asarray(npz[f]))
+            try:
+                arr = npz[f]
+            except ValueError:
+                raise
+            except Exception as e:      # member truncated mid-archive
+                raise CheckpointCorrupt(
+                    f"checkpoint {npz_path!r} field {f!r} is unreadable "
+                    f"(torn write): {type(e).__name__}: {e}") from e
+            _validate(f, arr, getattr(like, f))
+            vals.append(jnp.asarray(arr))
         else:
             vals.append(getattr(like, f))
     return SimState(*vals)

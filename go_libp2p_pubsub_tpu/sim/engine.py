@@ -156,16 +156,27 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
     return state._replace(tick=state.tick + 1)
 
 
-def _run_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
-              key: jax.Array, n_ticks: int) -> SimState:
-    """Advance the whole network ``n_ticks`` heartbeats on device."""
+def _run_keys_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
+                   keys: jax.Array) -> SimState:
+    """Advance one tick per row of ``keys`` on device — the chunkable core
+    of ``run``. ``run`` pre-splits ONE master key into per-tick keys and
+    scans them all; a caller that performs the same split and scans any
+    contiguous windows of the key array (sim/supervisor.py chunked
+    execution) lands on the bit-identical trajectory, because the per-tick
+    key sequence — the only thing the scan consumes besides the carried
+    state — is unchanged."""
 
     def body(carry, k):
         return step(carry, cfg, tp, k), None
 
-    keys = jax.random.split(key, n_ticks)
     state, _ = jax.lax.scan(body, state, keys)
     return state
+
+
+def _run_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
+              key: jax.Array, n_ticks: int) -> SimState:
+    """Advance the whole network ``n_ticks`` heartbeats on device."""
+    return _run_keys_impl(state, cfg, tp, jax.random.split(key, n_ticks))
 
 
 run = jax.jit(_run_impl, static_argnames=("cfg", "n_ticks"))
@@ -173,6 +184,10 @@ run = jax.jit(_run_impl, static_argnames=("cfg", "n_ticks"))
 # memory (in-place XLA aliasing); callers must not reuse the argument
 run_donated = jax.jit(_run_impl, static_argnames=("cfg", "n_ticks"),
                       donate_argnums=(0,))
+
+# explicit per-tick keys (the supervisor's chunk unit; n_ticks is carried
+# by keys.shape[0], a jit shape dimension rather than a static argument)
+run_keys = jax.jit(_run_keys_impl, static_argnames=("cfg",))
 
 step_jit = jax.jit(step, static_argnames=("cfg",))
 
@@ -191,6 +206,24 @@ def run_checked(state: SimState, cfg: SimConfig, tp: TopicParams,
 
     err, out = jax.jit(checkify.checkify(f, errors=checkify.user_checks))(
         state, tp, key)
+    err.throw()
+    return out
+
+
+def run_checked_keys(state: SimState, cfg: SimConfig, tp: TopicParams,
+                     keys: jax.Array) -> SimState:
+    """``run_keys`` with the invariant sentinel escalated to host
+    exceptions (see :func:`run_checked`) — the supervisor's execution path
+    under ``invariant_mode="raise"`` and the replay path of
+    ``scripts/replay_crash.py`` (which re-runs a crash dump's exact
+    failing tick window from its recorded per-tick keys)."""
+    from jax.experimental import checkify
+
+    def f(state, tp, keys):
+        return _run_keys_impl(state, cfg, tp, keys)
+
+    err, out = jax.jit(checkify.checkify(f, errors=checkify.user_checks))(
+        state, tp, keys)
     err.throw()
     return out
 

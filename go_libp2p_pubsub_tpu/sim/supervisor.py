@@ -1,0 +1,479 @@
+"""Supervised execution plane: chunked scans with checkpoints, a
+wall-clock watchdog, retry/backoff down a degraded-mode ladder, and
+replayable crash dumps.
+
+PR 4 made the *simulated network* fault-tolerant (FaultPlan + invariant
+sentinel); this module makes the *runner itself* fault-tolerant — the
+preemption-safe checkpoint/resume + watchdog/backoff shape production
+training stacks depend on, applied to long engine runs on scarce,
+unattended TPU windows (round 5 lost its record of record to an unguarded
+timeout; the protocol itself applies the same shape via PRUNE backoff and
+promise timeouts, gossipsub v1.1 hardening).
+
+:func:`supervised_run` wraps ``engine.run`` (or, with ``traced=True``,
+``trace_export.run_traced``) as a sequence of chunked scans:
+
+- **bit-identical chunking**: ONE master key is pre-split into per-tick
+  keys exactly as ``engine.run`` does internally, and each chunk scans a
+  contiguous window of that key array (``engine.run_keys``) — the chunked
+  trajectory equals the single-scan trajectory bit for bit, checkpoints
+  or not, faults or not (tests/test_supervisor.py, the core correctness
+  claim).
+- **checkpoints**: every ``checkpoint_every_ticks`` (default: every chunk
+  boundary) the state lands in ``checkpoint_dir`` through the
+  crash-atomic ``sim/checkpoint.save`` with the caller's config
+  fingerprint stamped; a re-invocation resumes from the newest checkpoint
+  that restores cleanly, falling back past torn ones
+  (``CheckpointCorrupt``).
+- **watchdog**: each chunk runs under a wall-clock ``deadline_s`` in a
+  worker thread; an overrun abandons the dispatch (device work cannot be
+  cancelled — the result is discarded) and counts as a transient failure.
+- **retry + degraded-mode ladder**: transient failures back off
+  exponentially and escalate — first ``hop_mode``/``edge_gather_mode``
+  fall back to the conservative XLA formulations (bit-identical by the
+  mode-parity suites), then the chunk size halves down to
+  ``min_chunk_ticks`` — before giving up.
+- **crash dumps**: an unrecoverable failure (retries exhausted, or an
+  ``invariant_mode="raise"`` checkify trip, which is never retried —
+  the trajectory itself is poisoned) writes the last-good checkpoint,
+  the failing window's per-tick keys, the config fingerprint, and the
+  decoded ``fault_flags`` to a crash directory, then raises
+  :class:`SupervisorCrash`. ``scripts/replay_crash.py`` re-runs exactly
+  that window from the dump with invariants raised. Registered trace
+  sinks get ``hard_flush()``ed (flush + fsync) on every failure so a
+  crashed traced run leaves a readable partial trace.
+
+Env knobs (``SupervisorConfig.from_env``): ``GRAFT_CHUNK_TICKS``,
+``GRAFT_DEADLINE_S``, ``GRAFT_CRASH_DIR``, ``GRAFT_CHECKPOINT_DIR``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+import shutil
+import threading
+import time
+from typing import Callable
+
+import jax
+import numpy as np
+
+from . import checkpoint
+from .config import SimConfig, TopicParams
+from .state import SimState
+
+_CKPT_RE = re.compile(r"^ckpt_t(\d+)(?:\.npz)?$")
+
+
+class SupervisorCrash(RuntimeError):
+    """Unrecoverable supervised-run failure. ``dump_dir`` holds the crash
+    dump (last-good checkpoint + crash.json), ``report`` the run log up to
+    the failure."""
+
+    def __init__(self, msg: str, dump_dir: str | None = None,
+                 report: "SupervisorReport | None" = None):
+        super().__init__(msg)
+        self.dump_dir = dump_dir
+        self.report = report
+
+
+class ChunkDeadline(RuntimeError):
+    """A chunk overran its wall-clock deadline (transient: retried)."""
+
+
+@dataclasses.dataclass
+class SupervisorConfig:
+    """Host-side supervision knobs (NOT jit-static — execution shape only;
+    none of these can change the trajectory)."""
+
+    chunk_ticks: int = 64             # ticks per scan dispatch
+    deadline_s: float | None = None   # per-chunk wall-clock watchdog
+    # separate bound for first-use compilation of a (config, chunk-shape):
+    # compile time is not execution time — a steady-state deadline tuned to
+    # chunk runtime would otherwise trip on every new shape the ladder
+    # introduces and thrash. None = compilation is unbounded.
+    compile_deadline_s: float | None = None
+    checkpoint_dir: str | None = None
+    checkpoint_every_ticks: int = 0   # 0 = at every chunk boundary
+    keep_checkpoints: int = 2         # newest N kept; older pruned
+    max_retries: int = 4              # consecutive failures before giving up
+    backoff_base_s: float = 0.5
+    backoff_factor: float = 2.0
+    backoff_cap_s: float = 30.0
+    min_chunk_ticks: int = 1          # ladder floor for chunk shrinking
+    crash_dir: str | None = None      # default: $GRAFT_CRASH_DIR or ./graft_crash
+    scenario: str | None = None       # sim.scenarios.SCENARIOS key, stamped
+    scenario_kwargs: dict | None = None   # into crash.json for replay_crash
+    sinks: tuple = ()                 # trace sinks hard_flush()ed on failure
+    # injectable for tests/smoke (real backoff sleeps are pointless there)
+    sleep: Callable[[float], None] = time.sleep
+
+    @staticmethod
+    def from_env(**overrides) -> "SupervisorConfig":
+        kw: dict = {}
+        if os.environ.get("GRAFT_CHUNK_TICKS"):
+            kw["chunk_ticks"] = int(os.environ["GRAFT_CHUNK_TICKS"])
+        if os.environ.get("GRAFT_DEADLINE_S"):
+            kw["deadline_s"] = float(os.environ["GRAFT_DEADLINE_S"])
+        if os.environ.get("GRAFT_CRASH_DIR"):
+            kw["crash_dir"] = os.environ["GRAFT_CRASH_DIR"]
+        if os.environ.get("GRAFT_CHECKPOINT_DIR"):
+            kw["checkpoint_dir"] = os.environ["GRAFT_CHECKPOINT_DIR"]
+        kw.update(overrides)
+        return SupervisorConfig(**kw)
+
+
+@dataclasses.dataclass
+class SupervisorReport:
+    """What the supervised run did — chunk counts, the retry/degrade
+    trail, checkpoint/resume provenance, and the crash dump path (set only
+    when :class:`SupervisorCrash` was raised; reach it via the
+    exception's ``report``)."""
+
+    chunks_run: int = 0
+    ticks_run: int = 0
+    retries: int = 0
+    degrade_level: int = 0
+    checkpoints: list = dataclasses.field(default_factory=list)
+    resumed_from: str | None = None
+    resumed_tick: int | None = None
+    crash_dump: str | None = None
+    events: list = dataclasses.field(default_factory=list)
+
+    def log(self, event: str, **info) -> None:
+        self.events.append({"event": event, **info})
+
+
+def _key_data(keys) -> np.ndarray:
+    """uint32 view of a key array, old-style (raw uint32) or typed (typed
+    keys refuse direct np.asarray; unwrap them first)."""
+    try:
+        if jax.dtypes.issubdtype(keys.dtype, jax.dtypes.prng_key):
+            return np.asarray(jax.random.key_data(keys))
+    except (AttributeError, TypeError):
+        pass
+    return np.asarray(keys)
+
+
+def _hard_flush(sinks) -> None:
+    for s in sinks:
+        try:
+            if hasattr(s, "hard_flush"):
+                s.hard_flush()
+            elif hasattr(s, "flush"):
+                s.flush()
+        except Exception:
+            pass        # the failure path must never mask the failure
+
+
+def _is_invariant_trip(err: BaseException) -> bool:
+    # the checkify message format of sim/invariants.record_flags
+    return "invariant violation" in str(err)
+
+
+def _ckpt_path(ckpt_dir: str, tick: int) -> str:
+    return os.path.join(ckpt_dir, f"ckpt_t{tick:09d}")
+
+
+def list_checkpoints(ckpt_dir: str) -> list:
+    """Supervisor checkpoints in ``ckpt_dir`` as ``[(path, tick)]``,
+    ascending tick. ``path`` is the bare name ``checkpoint.restore``
+    accepts for both backends (the ``.npz`` suffix of the fallback is
+    stripped)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = {}
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.match(name)
+        if m:
+            bare = name[:-4] if name.endswith(".npz") else name
+            out[bare] = int(m.group(1))
+    return sorted(((os.path.join(ckpt_dir, b), t) for b, t in out.items()),
+                  key=lambda pt: pt[1])
+
+
+def _prune_checkpoints(ckpt_dir: str, keep: int) -> None:
+    for path, _tick in list_checkpoints(ckpt_dir)[:-keep or None]:
+        for victim in (path, path + ".npz", path + ".fingerprint"):
+            try:
+                if os.path.isdir(victim):
+                    shutil.rmtree(victim)
+                elif os.path.lexists(victim):
+                    os.remove(victim)
+            except OSError:
+                pass    # pruning is best-effort; never fail the run for it
+
+
+def _try_resume(sup: SupervisorConfig, cfg: SimConfig, like: SimState,
+                start_tick: int, n_ticks: int,
+                report: SupervisorReport) -> tuple:
+    """Newest checkpoint in the run's tick window that restores cleanly,
+    falling back past torn/mismatched ones; (state, ticks_done)."""
+    for path, tick in reversed(list_checkpoints(sup.checkpoint_dir)):
+        if not (start_tick < tick <= start_tick + n_ticks):
+            continue
+        try:
+            st = checkpoint.restore(path, like, cfg=cfg)
+        except ValueError as e:     # CheckpointCorrupt or mismatch
+            report.log("resume_skip", path=path, error=str(e)[:200])
+            continue
+        done = int(np.asarray(st.tick)) - start_tick
+        if done != tick - start_tick:   # name/state tick disagreement
+            report.log("resume_skip", path=path,
+                       error=f"state tick {done + start_tick} != {tick}")
+            continue
+        report.resumed_from = path
+        report.resumed_tick = tick
+        report.log("resume", path=path, tick=tick)
+        return st, done
+    return like, 0
+
+
+def _degrade(exec_cfg: SimConfig, chunk_ticks: int, sup: SupervisorConfig,
+             report: SupervisorReport) -> tuple:
+    """One rung down the ladder: kernel modes first (pallas-mxu/mxu/sort →
+    the EXPLICIT conservative formulations "xla"/"scalar", bit-identical
+    per the mode-parity suites — not "auto", which resolves right back to
+    the failing mode on its home backend), then chunk shrinking. Sticky
+    for the rest of the run — a chunk that needed the fallback would need
+    it again."""
+    if exec_cfg.hop_mode != "xla" or exec_cfg.edge_gather_mode != "scalar":
+        exec_cfg = dataclasses.replace(exec_cfg, hop_mode="xla",
+                                       edge_gather_mode="scalar")
+        report.degrade_level = max(report.degrade_level, 1)
+        report.log("degrade", hop_mode="xla", edge_gather_mode="scalar")
+    elif chunk_ticks > sup.min_chunk_ticks:
+        chunk_ticks = max(sup.min_chunk_ticks, chunk_ticks // 2)
+        report.degrade_level += 1
+        report.log("degrade", chunk_ticks=chunk_ticks)
+    return exec_cfg, chunk_ticks
+
+
+def _write_crash_dump(sup: SupervisorConfig, cfg: SimConfig,
+                      last_good: SimState, keys_chunk, start_tick: int,
+                      done: int, this_chunk: int, n_ticks: int,
+                      err: BaseException,
+                      report: SupervisorReport) -> str:
+    from .invariants import decode_flags
+
+    base = sup.crash_dir or os.environ.get("GRAFT_CRASH_DIR") \
+        or os.path.join(os.getcwd(), "graft_crash")
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    dump = os.path.join(base, f"crash_{stamp}_p{os.getpid()}")
+    os.makedirs(dump, exist_ok=True)
+    checkpoint.save(os.path.join(dump, "last_good"), last_good, cfg=cfg)
+    flags = int(np.asarray(last_good.fault_flags))
+    meta = {
+        "error": str(err)[:2000],
+        "error_type": type(err).__name__,
+        "tick_start": start_tick + done,
+        "tick_end": start_tick + done + this_chunk,
+        "run_start_tick": start_tick,
+        "n_ticks": n_ticks,
+        "config_fingerprint": checkpoint.config_fingerprint(cfg),
+        "invariant_mode": cfg.invariant_mode,
+        "fault_flags": flags,
+        "fault_flag_names": decode_flags(flags),
+        # the failing window's exact per-tick keys: replay_crash.py feeds
+        # these straight back into engine.run_checked_keys
+        "window_key_data": _key_data(keys_chunk).tolist(),
+        "degrade_level": report.degrade_level,
+        "retries": report.retries,
+        "scenario": sup.scenario,
+        "scenario_kwargs": sup.scenario_kwargs,
+    }
+    tmp = os.path.join(dump, f"crash.json.tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dump, "crash.json"))
+    report.log("crash_dump", path=dump)
+    return dump
+
+
+# AOT-compiled chunk executables, keyed by (exec_cfg, chunk_len, key
+# dtype): compiling through .lower().compile() ahead of the watchdog keeps
+# compile time out of the run deadline, and re-dispatching the SAME
+# executable across chunks/retries skips the jit cache lookup entirely.
+# SimConfig is frozen/hashable, so the dict stays small (one entry per
+# ladder rung per tail-chunk shape).
+_AOT_CACHE: dict = {}
+
+
+def _chunk_executable(exec_cfg: SimConfig, state: SimState, tp: TopicParams,
+                      keys_chunk):
+    from .engine import run_keys
+    cache_key = (exec_cfg, int(keys_chunk.shape[0]), str(keys_chunk.dtype))
+    exe = _AOT_CACHE.get(cache_key)
+    if exe is None:
+        exe = run_keys.lower(state, exec_cfg, tp, keys_chunk).compile()
+        _AOT_CACHE[cache_key] = exe
+    return exe
+
+
+def _with_deadline(fn, deadline_s, what: str, info: dict):
+    """Run ``fn`` under a wall-clock deadline on a DAEMON thread. A
+    timed-out dispatch cannot be cancelled — the thread is abandoned and
+    its result discarded (a retry re-runs the same keys from the same
+    last-good state, so nothing is lost but time). Daemon is load-bearing:
+    concurrent.futures workers are non-daemon and joined at interpreter
+    exit, so a truly wedged dispatch (the axon-tunnel failure class) would
+    hang the process at shutdown — after the supervisor already crashed
+    out — and burn the rest of an unattended window."""
+    if deadline_s is None:
+        return fn()
+    box: list = []
+
+    def runner():
+        try:
+            box.append((True, fn()))
+        except BaseException as e:      # rethrown on the caller thread
+            box.append((False, e))
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name=f"graft-chunk-t{info['chunk_start']}")
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        raise ChunkDeadline(
+            f"{what} at tick {info['chunk_start']} "
+            f"({info['chunk_ticks']} ticks) overran the "
+            f"{deadline_s}s deadline")
+    ok, val = box[0]
+    if not ok:
+        raise val
+    return val
+
+
+def _run_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
+               keys_chunk, sup: SupervisorConfig, traced: bool,
+               chunk_events: list, chunk_health: list,
+               chunk_hook, info: dict) -> SimState:
+    """One chunk attempt: compile (its own deadline) then run (the
+    watchdog deadline)."""
+    exe = None
+    if not traced and exec_cfg.invariant_mode != "raise":
+        exe = _with_deadline(
+            lambda: _chunk_executable(exec_cfg, state, tp, keys_chunk),
+            sup.compile_deadline_s, "compile", info)
+
+    def worker():
+        if chunk_hook is not None:      # test/smoke fault-injection point
+            chunk_hook(info)
+        if traced:
+            from .trace_export import run_traced
+            out, evs = run_traced(state, exec_cfg, tp, None, 0,
+                                  health_out=chunk_health, keys=keys_chunk)
+            chunk_events.extend(evs)
+        elif exe is not None:
+            out = exe(state, tp, keys_chunk)
+        else:
+            # "raise" mode: per-call checkify transform (the debugging
+            # path — compile rides the run deadline here)
+            from .engine import run_checked_keys
+            out = run_checked_keys(state, exec_cfg, tp, keys_chunk)
+        # real sync by value fetch: async dispatch (and the axon tunnel,
+        # which block_until_ready does not block through) must not let a
+        # wedged chunk slide past the deadline
+        np.asarray(out.tick)
+        return out
+
+    return _with_deadline(worker, sup.deadline_s, "chunk", info)
+
+
+def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
+                   key, n_ticks: int,
+                   sup: SupervisorConfig | None = None, *,
+                   traced: bool = False,
+                   events_out: list | None = None,
+                   health_out: list | None = None,
+                   _chunk_hook=None) -> tuple:
+    """Run ``n_ticks`` engine ticks under supervision (module docstring).
+
+    Returns ``(final_state, report)``; the final state is bit-identical to
+    ``engine.run(state, cfg, tp, key, n_ticks)`` regardless of chunking,
+    checkpointing, resumption, retries, or degraded modes. Raises
+    :class:`SupervisorCrash` after writing a crash dump when the run
+    cannot make progress.
+
+    ``traced=True`` routes chunks through ``trace_export.run_traced``
+    (requires ``cfg.record_provenance``); successful chunks append their
+    events/health records to ``events_out``/``health_out`` — a failed
+    attempt's partial records are discarded, so the collected stream never
+    double-counts a retried tick. ``_chunk_hook(info)`` is a test/smoke
+    fault-injection point called at the top of every chunk attempt.
+    """
+    sup = sup or SupervisorConfig.from_env()
+    report = SupervisorReport()
+    start_tick = int(np.asarray(state.tick))
+    all_keys = jax.random.split(key, n_ticks)   # run's exact discipline
+
+    done = 0
+    if sup.checkpoint_dir:
+        state, done = _try_resume(sup, cfg, state, start_tick, n_ticks,
+                                  report)
+
+    exec_cfg = cfg
+    chunk_ticks = max(1, int(sup.chunk_ticks))
+    every = sup.checkpoint_every_ticks or chunk_ticks
+    next_ckpt = done + every
+    failures = 0            # consecutive; reset on every successful chunk
+    while done < n_ticks:
+        this_chunk = min(chunk_ticks, n_ticks - done)
+        keys_chunk = all_keys[done:done + this_chunk]
+        info = {"chunk_start": start_tick + done, "chunk_ticks": this_chunk,
+                "attempt": failures, "degrade_level": report.degrade_level}
+        chunk_events: list = []
+        chunk_health: list = []
+        try:
+            out = _run_chunk(state, exec_cfg, tp, keys_chunk, sup, traced,
+                             chunk_events, chunk_health, _chunk_hook, info)
+        except Exception as e:
+            _hard_flush(sup.sinks)
+            failures += 1
+            if _is_invariant_trip(e) or failures > sup.max_retries:
+                # invariant trips are never retried: the trajectory itself
+                # is poisoned and would trip again on the same keys
+                dump = _write_crash_dump(sup, cfg, state, keys_chunk,
+                                         start_tick, done, this_chunk,
+                                         n_ticks, e, report)
+                report.crash_dump = dump
+                raise SupervisorCrash(
+                    f"supervised run gave up at tick {start_tick + done} "
+                    f"({failures} consecutive failure(s)); crash dump: "
+                    f"{dump}", dump_dir=dump, report=report) from e
+            report.retries += 1
+            report.log("chunk_failed",
+                       kind="deadline" if isinstance(e, ChunkDeadline)
+                       else "error", error=str(e)[:200], **info)
+            exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup,
+                                             report)
+            delay = min(sup.backoff_cap_s, sup.backoff_base_s
+                        * sup.backoff_factor ** (failures - 1))
+            report.log("backoff", delay_s=round(delay, 3))
+            sup.sleep(delay)
+            continue
+        failures = 0
+        state = out
+        done += this_chunk
+        report.chunks_run += 1
+        report.ticks_run += this_chunk
+        report.log("chunk_ok", **info)
+        if events_out is not None:
+            events_out.extend(chunk_events)
+        if health_out is not None:
+            health_out.extend(chunk_health)
+        if sup.checkpoint_dir and (done >= next_ckpt or done >= n_ticks):
+            path = _ckpt_path(sup.checkpoint_dir, start_tick + done)
+            os.makedirs(sup.checkpoint_dir, exist_ok=True)
+            checkpoint.save(path, state, cfg=cfg)   # crash-atomic
+            report.checkpoints.append(path)
+            report.log("checkpoint", tick=start_tick + done, path=path)
+            _prune_checkpoints(sup.checkpoint_dir, sup.keep_checkpoints)
+            next_ckpt = done + every
+    return state, report

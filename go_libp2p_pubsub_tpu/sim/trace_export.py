@@ -108,7 +108,8 @@ def export_events(prev: SimState, cur: SimState,
 
 
 def run_traced(state: SimState, cfg: SimConfig, tp: TopicParams, key,
-               n_ticks: int, health_out: list | None = None):
+               n_ticks: int, health_out: list | None = None,
+               keys=None):
     """Host-stepped run collecting the exported event stream.
 
     Returns (final_state, events). Requires cfg.record_provenance. Intended
@@ -122,14 +123,24 @@ def run_traced(state: SimState, cfg: SimConfig, tp: TopicParams, key,
     as a clean one. Kept OUT of the event stream itself: the pb/trace wire
     schema (pb/codec.py) has no health message, and replay consumers must
     keep round-tripping byte-exact.
+
+    ``keys``: optional explicit per-tick key array (``key``/``n_ticks``
+    are then ignored). Passing ``jax.random.split(key, n_ticks)`` puts the
+    traced run on the SAME trajectory as ``engine.run(state, cfg, tp, key,
+    n_ticks)`` — the pre-split discipline sim/supervisor.py uses so traced
+    chunks stay bit-identical to the single scan. The default (no
+    ``keys``) keeps the historical chain-split stream.
     """
     assert cfg.record_provenance, "run_traced needs cfg.record_provenance"
     from .engine import step_jit
     from .invariants import decode_flags
 
     events: list[dict] = []
-    for i in range(n_ticks):
-        key, k = jax.random.split(key)
+    for i in range(n_ticks if keys is None else len(keys)):
+        if keys is None:
+            key, k = jax.random.split(key)
+        else:
+            k = keys[i]
         nxt = step_jit(state, cfg, tp, k)
         events.extend(export_events(state, nxt))
         if health_out is not None and cfg.invariant_mode != "off":

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import gzip
 import json
+import os
 import zlib
 from typing import Callable
 
@@ -32,6 +33,20 @@ class _BufferedTracer:
             self.dropped += 1
             return
         self.buf.append(evt)
+
+    def hard_flush(self) -> None:
+        """Flush buffered events AND fsync the backing file (when there is
+        one): the supervisor's failure path (sim/supervisor.py) calls this
+        so a crashed run leaves a readable partial trace on disk rather
+        than a page-cache-resident truncation. Batch-size gates do not
+        apply — everything buffered goes out."""
+        if self.closed:
+            return
+        self.flush()
+        fh = getattr(self, "_fh", None)
+        if fh is not None and not fh.closed:
+            fh.flush()
+            os.fsync(fh.fileno())
 
 
 class MemoryTracer:
@@ -119,6 +134,11 @@ class RemoteTracer(_BufferedTracer):
         if len(self.buf) < MIN_TRACE_BATCH_SIZE:
             return
         self._write_batch()
+
+    def hard_flush(self) -> None:
+        # failure path: the min-batch gate yields to getting the events out
+        if not self.closed and self.buf:
+            self._write_batch()
 
     def _write_batch(self) -> None:
         from ..pb import codec

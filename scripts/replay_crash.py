@@ -1,0 +1,132 @@
+"""Replay a supervisor crash dump: re-run the exact failing tick window
+from the last-good checkpoint with invariants raised.
+
+A dump (sim/supervisor.py `_write_crash_dump`) holds the last-good state,
+the failing window's per-tick keys, the config fingerprint, and the
+decoded health word. Replay restores the state, swaps
+``invariant_mode="raise"`` into the config, and drives
+``engine.run_checked_keys`` over the recorded keys — a deterministic
+re-execution of precisely the ticks that killed the run, with every
+violation escalated to a host exception naming its flags.
+
+Usage:
+    python scripts/replay_crash.py CRASH_DIR [--scenario NAME]
+        [--record] [--kwargs '{"n_peers": 512}']
+
+The scenario (a ``sim.scenarios.SCENARIOS`` key) and its kwargs default to
+what the supervisor stamped into crash.json; pass them explicitly for
+dumps written without scenario metadata. ``--record`` replays in record
+mode instead (no exception — prints the final flag word). Exit status: 0
+clean replay, 3 the invariant trip reproduced, 1 usage/config errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_meta(crash_dir: str) -> dict:
+    with open(os.path.join(crash_dir, "crash.json")) as f:
+        return json.load(f)
+
+
+def replay(crash_dir: str, like=None, cfg=None, tp=None,
+           invariant_mode: str = "raise") -> dict:
+    """Re-run the dump's failing window; returns a result record with
+    ``tripped`` (did the invariant trip reproduce), the final
+    ``fault_flags`` when it didn't, and the window bounds.
+
+    ``like``/``cfg``/``tp`` may be passed directly (tests, callers that
+    still hold the objects); otherwise they are rebuilt from the
+    scenario metadata stamped in crash.json."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu.sim import checkpoint
+    from go_libp2p_pubsub_tpu.sim.engine import run_checked_keys, run_keys
+    from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+
+    meta = load_meta(crash_dir)
+    if cfg is None or like is None or tp is None:
+        from go_libp2p_pubsub_tpu.sim import scenarios
+        name = meta.get("scenario")
+        if not name:
+            raise SystemExit(
+                "crash.json carries no scenario metadata; pass --scenario "
+                "(and --kwargs) or call replay() with like/cfg/tp objects")
+        if name not in scenarios.SCENARIOS:
+            raise SystemExit(
+                f"unknown scenario {name!r}; known: "
+                f"{sorted(scenarios.SCENARIOS)}")
+        cfg, tp, like = scenarios.SCENARIOS[name](
+            **(meta.get("scenario_kwargs") or {}))
+    want = meta.get("config_fingerprint")
+    got = checkpoint.config_fingerprint(cfg)
+    if want and got != want:
+        raise SystemExit(
+            f"rebuilt config fingerprint {got[:12]}… does not match the "
+            f"dump's {want[:12]}… — wrong scenario/kwargs; replaying under "
+            "a drifted config would not reproduce the crash")
+    state = checkpoint.restore(os.path.join(crash_dir, "last_good"), like,
+                               cfg=cfg)
+    keys = jnp.asarray(np.asarray(meta["window_key_data"], dtype=np.uint32))
+    replay_cfg = dataclasses.replace(cfg, invariant_mode=invariant_mode)
+    result = {"crash_dir": crash_dir, "tick_start": meta["tick_start"],
+              "tick_end": meta["tick_end"], "ticks": int(keys.shape[0]),
+              "invariant_mode": invariant_mode,
+              "original_error": meta.get("error", "")[:200]}
+    try:
+        if invariant_mode == "raise":
+            out = run_checked_keys(state, replay_cfg, tp, keys)
+        else:
+            out = run_keys(state, replay_cfg, tp, keys)
+        flags = int(np.asarray(out.fault_flags))
+        result.update(tripped=False, fault_flags=flags,
+                      fault_flag_names=decode_flags(flags))
+    except Exception as e:
+        if "invariant violation" not in str(e):
+            raise               # a replay-infra failure, not the trip
+        result.update(tripped=True, error=str(e)[:500])
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("crash_dir")
+    ap.add_argument("--scenario", default=None,
+                    help="sim.scenarios.SCENARIOS key (default: from dump)")
+    ap.add_argument("--kwargs", default=None,
+                    help="JSON dict of scenario builder kwargs")
+    ap.add_argument("--record", action="store_true",
+                    help="replay in record mode (collect flags, no raise)")
+    args = ap.parse_args()
+    mode = "record" if args.record else "raise"
+    if args.scenario:
+        # command-line override of the dump's scenario metadata (the dump
+        # itself is never mutated): rebuild the objects here and hand them
+        # to replay() directly
+        from go_libp2p_pubsub_tpu.sim import scenarios
+        if args.scenario not in scenarios.SCENARIOS:
+            print(json.dumps({"error": f"unknown scenario "
+                              f"{args.scenario!r}",
+                              "known": sorted(scenarios.SCENARIOS)}),
+                  flush=True)
+            return 1
+        kwargs = json.loads(args.kwargs) if args.kwargs else {}
+        cfg, tp, like = scenarios.SCENARIOS[args.scenario](**kwargs)
+        result = replay(args.crash_dir, like=like, cfg=cfg, tp=tp,
+                        invariant_mode=mode)
+    else:
+        result = replay(args.crash_dir, invariant_mode=mode)
+    print(json.dumps(result), flush=True)
+    return 3 if result.get("tripped") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

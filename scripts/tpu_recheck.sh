@@ -8,7 +8,8 @@
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/tpu_recheck
-for step in "bench:python bench.py" \
+for step in "supervisor_smoke:python scripts/supervisor_smoke.py" \
+            "bench:python bench.py" \
             "modes_sort:env GRAFT_EDGE_GATHER=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_mxu:env GRAFT_EDGE_GATHER=mxu BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "hop_pallas_mxu:env GRAFT_HOP_MODE=pallas-mxu BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
@@ -31,7 +32,12 @@ for step in "bench:python bench.py" \
             "microbench_100k:python scripts/microbench_kernels.py 100000 1 32 64"; do
   name="${step%%:*}"; cmd="${step#*:}"
   echo "== $name: $cmd =="
-  timeout 1500 $cmd 2>&1 | grep -v WARNING | tee "/tmp/tpu_recheck/$name.log"
+  # supervised-run plane (ISSUE 5): each bench step gets its own resumable
+  # journal, so a re-run of a preempted recheck skips already-banked
+  # configs, and bench's SIGTERM flush turns the `timeout` kill below into
+  # a partial-but-parseable record instead of a truncated log
+  BENCH_JOURNAL="/tmp/tpu_recheck/journal_$name.jsonl" \
+    timeout 1500 $cmd 2>&1 | grep -v WARNING | tee "/tmp/tpu_recheck/$name.log"
   rc=${PIPESTATUS[0]}
   echo "== $name done (rc=$rc) =="
 done

@@ -62,9 +62,18 @@ print(f'PROBE {int(alive)} {n} {plat or \"-\"}')" 2>"$LOGDIR/probe_stderr.log")
     mkdir -p "$attempt"
     cp -r /tmp/tpu_recheck/. "$attempt/" 2>/dev/null
     log "recheck done — final clean bench for the record"
-    timeout 3600 python bench.py 2>&1 | grep -v WARNING | tee "$attempt/bench.log"
+    # supervised record run (ISSUE 5): the journal lives at a STABLE path
+    # so a bench preempted on this hit resumes on the next watch hit
+    # (cleared only on success below; journal records carry platform+env
+    # fingerprints, so stale CPU-fallback lines can't mask a live window),
+    # and bench's SIGTERM flush means the timeout kill below still leaves
+    # a complete parseable record
+    BENCH_JOURNAL="$RESULTS/bench.journal" \
+      timeout 3600 python bench.py 2>&1 | grep -v WARNING | tee "$attempt/bench.log"
+    cp "$RESULTS/bench.journal" "$attempt/bench.journal" 2>/dev/null
     if grep -q '"platform": "tpu"' "$attempt/bench.log"; then
       cp "$attempt/bench.log" "$RESULTS/bench_tpu.log"
+      rm -f "$RESULTS/bench.journal"   # banked; next session starts fresh
       log "SUCCESS: on-TPU bench captured in $RESULTS/bench_tpu.log (full logs: $attempt)"
       exit 0
     fi

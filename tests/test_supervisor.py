@@ -1,0 +1,393 @@
+"""Supervised execution plane (sim/supervisor.py, ISSUE 5).
+
+The core correctness claim: chunked supervised execution — with
+checkpoints, kills, resumes, retries, and degraded modes in any
+combination — produces a final ``SimState`` bit-identical to the plain
+single-scan ``engine.run`` on the same master key. Everything else
+(watchdog, ladder, crash dumps, replay, sink flushing) is supervised-run
+plumbing proven on top of that claim.
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import (SimConfig, TopicParams, init_state,
+                                      topology)
+from go_libp2p_pubsub_tpu.sim import checkpoint
+from go_libp2p_pubsub_tpu.sim.engine import run
+from go_libp2p_pubsub_tpu.sim.supervisor import (ChunkDeadline,
+                                                 SupervisorConfig,
+                                                 SupervisorCrash,
+                                                 supervised_run)
+
+pytestmark = pytest.mark.supervisor
+
+N_TICKS = 13
+
+
+def _assert_states_equal(a, b):
+    for f, x, y in zip(a._fields, a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"field {f}")
+
+
+@pytest.fixture(scope="module")
+def plain():
+    """One shared tiny config + its uninterrupted reference trajectory
+    (module-scoped: every test reuses the jit cache for its shapes)."""
+    cfg = SimConfig(n_peers=64, k_slots=8, n_topics=1, msg_window=32,
+                    publishers_per_tick=2, prop_substeps=4,
+                    scoring_enabled=True)
+    tp = TopicParams.disabled(1)
+    st = init_state(cfg, topology.sparse(64, 8, degree=3))
+    key = jax.random.PRNGKey(42)
+    return cfg, tp, st, key, run(st, cfg, tp, key, N_TICKS)
+
+
+def _sup(**kw):
+    kw.setdefault("chunk_ticks", 5)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return SupervisorConfig(**kw)
+
+
+class TestChunkedParity:
+    def test_chunked_equals_single_scan(self, plain):
+        cfg, tp, st, key, ref = plain
+        out, rep = supervised_run(st, cfg, tp, key, N_TICKS, _sup())
+        _assert_states_equal(ref, out)
+        assert rep.chunks_run == 3 and rep.ticks_run == N_TICKS
+
+    def test_chunk_size_one(self, plain):
+        cfg, tp, st, key, ref = plain
+        out, _ = supervised_run(st, cfg, tp, key, N_TICKS,
+                                _sup(chunk_ticks=1))
+        _assert_states_equal(ref, out)
+
+
+class TestKillResume:
+    def test_kill_and_resume_bit_identical(self, plain, tmp_path):
+        """THE acceptance case: interrupt mid-scan (simulated kill escapes
+        the supervisor's retry net), re-invoke, final state bit-identical
+        to the uninterrupted run."""
+        cfg, tp, st, key, ref = plain
+        ck = str(tmp_path / "ck")
+
+        def kill(info):
+            if info["chunk_start"] >= 10:
+                raise KeyboardInterrupt("simulated preemption")
+
+        with pytest.raises(KeyboardInterrupt):
+            supervised_run(st, cfg, tp, key, N_TICKS,
+                           _sup(checkpoint_dir=ck), _chunk_hook=kill)
+        out, rep = supervised_run(st, cfg, tp, key, N_TICKS,
+                                  _sup(checkpoint_dir=ck))
+        assert rep.resumed_tick == 10
+        assert rep.ticks_run == 3          # only the missing window re-ran
+        _assert_states_equal(ref, out)
+
+    def test_resume_ignores_foreign_config_checkpoint(self, plain, tmp_path):
+        """A checkpoint stamped under a DIFFERENT config fingerprint is
+        skipped (not half-accepted) and the run starts from scratch."""
+        cfg, tp, st, key, ref = plain
+        ck = str(tmp_path / "ck")
+        os.makedirs(ck)
+        other = dataclasses.replace(cfg, publishers_per_tick=3)
+        mid = run(st, other, tp, key, 5)
+        checkpoint.save(os.path.join(ck, "ckpt_t000000005"), mid, cfg=other)
+        out, rep = supervised_run(st, cfg, tp, key, N_TICKS,
+                                  _sup(checkpoint_dir=ck))
+        assert rep.resumed_from is None
+        assert any(e["event"] == "resume_skip" for e in rep.events)
+        _assert_states_equal(ref, out)
+
+
+class TestTornCheckpoint:
+    def test_truncated_npz_raises_cleanly(self, plain, tmp_path):
+        cfg, tp, st, key, _ = plain
+        path = str(tmp_path / "torn.npz")
+        checkpoint.save(path, st)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+        with pytest.raises(checkpoint.CheckpointCorrupt,
+                           match="torn or incomplete"):
+            checkpoint.restore(path, st)
+
+    def test_supervisor_falls_back_past_torn_checkpoint(self, plain,
+                                                        tmp_path):
+        """Kill leaves ckpts at t5 and t10; t10 is then torn (simulated
+        partial write of a pre-atomicity save). Resume must fall back to
+        t5 and still land bit-identical."""
+        cfg, tp, st, key, ref = plain
+        ck = str(tmp_path / "ck")
+
+        def kill(info):
+            if info["chunk_start"] >= 10:
+                raise KeyboardInterrupt("simulated preemption")
+
+        with pytest.raises(KeyboardInterrupt):
+            supervised_run(st, cfg, tp, key, N_TICKS,
+                           _sup(checkpoint_dir=ck), _chunk_hook=kill)
+        newest = os.path.join(ck, "ckpt_t000000010")
+        if os.path.isdir(newest):              # orbax backend: gut the dir
+            for root, _dirs, files in os.walk(newest):
+                for fl in files:
+                    os.remove(os.path.join(root, fl))
+        else:
+            with open(newest + ".npz", "r+b") as f:
+                f.truncate(os.path.getsize(newest + ".npz") // 2)
+        out, rep = supervised_run(st, cfg, tp, key, N_TICKS,
+                                  _sup(checkpoint_dir=ck))
+        assert rep.resumed_tick == 5, rep.events
+        assert any(e["event"] == "resume_skip" for e in rep.events)
+        _assert_states_equal(ref, out)
+
+    def test_save_is_crash_atomic_no_partial_at_final_path(self, plain,
+                                                           tmp_path):
+        """The final path only ever holds a COMPLETE checkpoint: during
+        save the bytes live at a temp path, so a concurrent/killed save
+        leaves either the old payload or nothing — verified by checking
+        the temp-path discipline directly."""
+        cfg, tp, st, key, _ = plain
+        path = str(tmp_path / "atomic.npz")
+        checkpoint.save(path, st, cfg=cfg)
+        first = checkpoint.restore(path, st, cfg=cfg)
+        # overwrite with a different state; any failure mode in between
+        # must not have corrupted the readable artifact
+        st2 = run(st, cfg, tp, key, 2)
+        checkpoint.save(path, st2, cfg=cfg)
+        back = checkpoint.restore(path, st2, cfg=cfg)
+        _assert_states_equal(st2, back)
+        assert int(np.asarray(first.tick)) == 0
+        leftovers = [f for f in os.listdir(tmp_path) if ".tmp" in f]
+        assert leftovers == [], leftovers
+
+
+class TestWatchdogAndLadder:
+    def test_deadline_trip_backoff_degrade_then_parity(self, plain):
+        cfg, tp, st, key, ref = plain
+        delays = []
+
+        def slow_once(info):
+            if info["chunk_start"] == 0 and info["attempt"] == 0:
+                time.sleep(1.0)
+
+        sup = _sup(deadline_s=0.4, sleep=delays.append,
+                   backoff_base_s=0.25)
+        out, rep = supervised_run(st, cfg, tp, key, N_TICKS, sup,
+                                  _chunk_hook=slow_once)
+        assert rep.retries == 1
+        assert delays == [0.25]                # exponential backoff base
+        evs = [e["event"] for e in rep.events]
+        assert evs[:3] == ["chunk_failed", "degrade", "backoff"]
+        assert rep.events[0]["kind"] == "deadline"
+        _assert_states_equal(ref, out)          # degraded rungs stay exact
+
+    def test_mode_fallback_rung_first(self, plain):
+        """A config on a non-default kernel mode degrades modes before
+        shrinking the chunk, and the trajectory stays bit-identical."""
+        cfg, tp, st, key, _ = plain
+        mcfg = dataclasses.replace(cfg, edge_gather_mode="sort")
+        ref = run(st, mcfg, tp, key, N_TICKS)
+        fails = iter([True, False])
+
+        def flaky(info):
+            if next(fails, False):
+                raise RuntimeError("transient")
+
+        out, rep = supervised_run(st, mcfg, tp, key, N_TICKS, _sup(),
+                                  _chunk_hook=flaky)
+        deg = [e for e in rep.events if e["event"] == "degrade"]
+        # explicit conservative formulation, NOT "auto" (auto would
+        # resolve right back to the failing mode on its home backend)
+        assert deg and deg[0].get("edge_gather_mode") == "scalar"
+        assert rep.degrade_level == 1
+        _assert_states_equal(ref, out)
+
+    def test_backoff_schedule_is_exponential_and_capped(self, plain):
+        cfg, tp, st, key, _ = plain
+        delays = []
+        fails = iter([True, True, True])
+
+        def flaky(info):
+            if next(fails, False):
+                raise RuntimeError("transient")
+
+        sup = _sup(backoff_base_s=1.0, backoff_factor=2.0,
+                   backoff_cap_s=3.0, sleep=delays.append, max_retries=4)
+        supervised_run(st, cfg, tp, key, N_TICKS, sup, _chunk_hook=flaky)
+        assert delays == [1.0, 2.0, 3.0]        # 4.0 capped to 3.0
+
+
+class TestCrashDump:
+    def test_retries_exhausted_dumps_and_raises(self, plain, tmp_path):
+        cfg, tp, st, key, _ = plain
+
+        def boom(info):
+            raise RuntimeError("permanent failure")
+
+        with pytest.raises(SupervisorCrash) as ei:
+            supervised_run(st, cfg, tp, key, N_TICKS,
+                           _sup(max_retries=2, crash_dir=str(tmp_path)),
+                           _chunk_hook=boom)
+        dump = ei.value.dump_dir
+        meta = json.load(open(os.path.join(dump, "crash.json")))
+        assert meta["error_type"] == "RuntimeError"
+        assert meta["tick_start"] == 0
+        assert meta["config_fingerprint"] == \
+            checkpoint.config_fingerprint(cfg)
+        # the failing window's keys are recorded, replay-ready
+        keys = np.asarray(meta["window_key_data"], dtype=np.uint32)
+        assert keys.ndim == 2 and keys.shape[1] == 2
+        back = checkpoint.restore(os.path.join(dump, "last_good"), st,
+                                  cfg=cfg)
+        assert int(np.asarray(back.tick)) == 0
+        assert ei.value.report.retries == 2
+
+    def test_invariant_trip_is_unrecoverable_no_retry(self, plain,
+                                                      tmp_path):
+        """An invariant_mode="raise" checkify trip must crash-dump
+        IMMEDIATELY — the trajectory is poisoned; retrying the same keys
+        would trip again."""
+        cfg, tp, st, key, _ = plain
+        rcfg = dataclasses.replace(cfg, invariant_mode="raise")
+        poisoned = st._replace(halo_overflow=jnp.int32(3))
+        with pytest.raises(SupervisorCrash) as ei:
+            supervised_run(poisoned, rcfg, tp, key, N_TICKS,
+                           _sup(crash_dir=str(tmp_path)))
+        assert ei.value.report.retries == 0
+        meta = json.load(open(os.path.join(ei.value.dump_dir,
+                                           "crash.json")))
+        assert "invariant violation" in meta["error"]
+
+    def test_replay_crash_reproduces_clean_and_tripped(self, plain,
+                                                       tmp_path):
+        from scripts.replay_crash import replay
+        cfg, tp, st, key, _ = plain
+
+        def boom(info):
+            raise RuntimeError("host-side failure")
+
+        with pytest.raises(SupervisorCrash) as ei:
+            supervised_run(st, cfg, tp, key, N_TICKS,
+                           _sup(max_retries=1, crash_dir=str(tmp_path)),
+                           _chunk_hook=boom)
+        # host-side failure: the window itself is healthy -> clean replay
+        res = replay(ei.value.dump_dir, like=st, cfg=cfg, tp=tp)
+        assert res["tripped"] is False and res["fault_flags"] == 0
+        assert res["ticks"] == res["tick_end"] - res["tick_start"]
+
+        # poisoned trajectory: the replay must REPRODUCE the trip
+        rcfg = dataclasses.replace(cfg, invariant_mode="raise")
+        poisoned = st._replace(halo_overflow=jnp.int32(3))
+        with pytest.raises(SupervisorCrash) as ei2:
+            supervised_run(poisoned, rcfg, tp, key, N_TICKS,
+                           _sup(crash_dir=str(tmp_path / "p")))
+        res2 = replay(ei2.value.dump_dir, like=st, cfg=rcfg, tp=tp)
+        assert res2["tripped"] is True
+
+    def test_sinks_hard_flushed_on_failure(self, plain, tmp_path):
+        from go_libp2p_pubsub_tpu.trace.sinks import JSONTracer
+        cfg, tp, st, key, _ = plain
+        sink = JSONTracer(str(tmp_path / "trace.ndjson"))
+        sink.trace({"type": "PUBLISH_MESSAGE", "peerID": "p0"})
+
+        def boom(info):
+            raise RuntimeError("crash with buffered trace")
+
+        with pytest.raises(SupervisorCrash):
+            supervised_run(st, cfg, tp, key, N_TICKS,
+                           _sup(max_retries=0, crash_dir=str(tmp_path),
+                                sinks=(sink,)), _chunk_hook=boom)
+        # the buffered event reached disk, fsync'd, without close()
+        with open(tmp_path / "trace.ndjson") as f:
+            recs = [json.loads(ln) for ln in f]
+        assert recs == [{"type": "PUBLISH_MESSAGE", "peerID": "p0"}]
+
+
+class TestTracedMode:
+    def test_traced_chunks_match_engine_run(self, plain, tmp_path):
+        """Traced supervised chunks use the pre-split key discipline, so
+        the final state equals engine.run AND the event stream is
+        chunking-invariant."""
+        cfg, tp, st, key, _ = plain
+        pcfg = dataclasses.replace(cfg, record_provenance=True)
+        ref = run(st, pcfg, tp, key, 8)
+        ev_a, ev_b = [], []
+        out_a, _ = supervised_run(st, pcfg, tp, key, 8, _sup(chunk_ticks=3),
+                                  traced=True, events_out=ev_a)
+        out_b, _ = supervised_run(st, pcfg, tp, key, 8, _sup(chunk_ticks=8),
+                                  traced=True, events_out=ev_b)
+        _assert_states_equal(ref, out_a)
+        _assert_states_equal(out_a, out_b)
+        assert ev_a == ev_b and len(ev_a) > 0
+
+    def test_failed_attempt_events_discarded(self, plain):
+        """A retried chunk must not double-count its ticks' events."""
+        cfg, tp, st, key, _ = plain
+        pcfg = dataclasses.replace(cfg, record_provenance=True)
+        fails = iter([True])
+
+        def flaky(info):
+            if next(fails, False):
+                raise RuntimeError("transient")
+
+        ev, ref_ev = [], []
+        out, rep = supervised_run(st, pcfg, tp, key, 8, _sup(chunk_ticks=4),
+                                  traced=True, events_out=ev,
+                                  _chunk_hook=flaky)
+        assert rep.retries == 1
+        supervised_run(st, pcfg, tp, key, 8, _sup(chunk_ticks=4),
+                       traced=True, events_out=ref_ev)
+        assert ev == ref_ev
+
+
+class TestPartitionFaultsResume:
+    """The acceptance case under an ACTIVE FaultPlan: partition_50k (at
+    test scale) interrupted mid-scan across the partition window, resumed,
+    bit-identical to the uninterrupted run."""
+
+    def test_partition_kill_resume_parity(self, tmp_path):
+        from go_libp2p_pubsub_tpu.sim import scenarios
+        cfg, tp, st = scenarios.partition_50k(n_peers=256, k_slots=16,
+                                              degree=6, start=2, heal=7)
+        key = jax.random.PRNGKey(3)
+        n_ticks = 10
+        ref = run(st, cfg, tp, key, n_ticks)
+        assert int(np.asarray(ref.fault_flags)) != 0   # the plan FIRED
+        ck = str(tmp_path / "ck")
+
+        def kill(info):
+            if info["chunk_start"] >= 4:    # inside the partition window
+                raise KeyboardInterrupt("simulated preemption")
+
+        with pytest.raises(KeyboardInterrupt):
+            supervised_run(st, cfg, tp, key, n_ticks,
+                           _sup(chunk_ticks=4, checkpoint_dir=ck),
+                           _chunk_hook=kill)
+        out, rep = supervised_run(st, cfg, tp, key, n_ticks,
+                                  _sup(chunk_ticks=4, checkpoint_dir=ck))
+        assert rep.resumed_tick == 4
+        _assert_states_equal(ref, out)
+
+
+def test_full_ladder_smoke(tmp_path):
+    """CI twin of the scripts/tpu_recheck.sh `supervisor_smoke` step:
+    deadline trip -> backoff -> degraded mode -> checkpoint/resume ->
+    crash dump -> replay on a tiny config, all stages green."""
+    from scripts.supervisor_smoke import run_smoke
+    lines = []
+    assert run_smoke(str(tmp_path), emit=lines.append) == 0
+    stages = [json.loads(ln) for ln in lines]
+    assert [s["stage"] for s in stages] == [
+        "deadline_backoff_degrade", "checkpoint_resume",
+        "crash_dump_replay"]
+    assert all(s["status"] == "ok" for s in stages)
