@@ -117,6 +117,10 @@ def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
     # trajectory
     from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
     flags = int(np.asarray(st.fault_flags))
+    # the RESOLVED formulation per op (not the requested "auto"): sort-vs-
+    # mxu trajectory lines in BENCH_*.json stay attributable post-hoc
+    # without re-deriving the dispatch logic (ops/dispatch.py)
+    from go_libp2p_pubsub_tpu.ops.dispatch import resolved_formulations
     line = json.dumps({
         "metric": f"network_heartbeats_per_sec@{name}[{platform}]",
         "value": round(hbps, 2),
@@ -134,6 +138,10 @@ def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
         "n_peers": cfg.n_peers,
         "fault_flags": flags,
         "fault_flag_names": decode_flags(flags),
+        "resolved": resolved_formulations(cfg),
+        "requested": {"edge_gather_mode": cfg.edge_gather_mode,
+                      "hop_mode": cfg.hop_mode,
+                      "selection_mode": cfg.selection_mode},
     })
     print(line, flush=True)
     return line
@@ -315,7 +323,7 @@ _JOURNAL_ENV_KEYS = ("BENCH_N", "BENCH_MAX_N", "BENCH_TICKS",
                      "BENCH_REPEATS", "BENCH_K", "GRAFT_EDGE_GATHER",
                      "GRAFT_HOP_MODE", "GRAFT_SELECTION",
                      "GRAFT_COUNT_DTYPE", "GRAFT_FAULT_PLAN",
-                     "GRAFT_INVARIANT_MODE")
+                     "GRAFT_INVARIANT_MODE", "GRAFT_DISPATCH_TABLE")
 
 
 def _journal_env() -> dict:

@@ -1,0 +1,403 @@
+"""Measured cost-model dispatch: one shape+platform → formulation layer.
+
+Every engine seam with competing formulations — the generic [N, K]
+payload permute, the [W, N] word-table gathers, the packed edge exchange,
+the forwarding-hop / gossip-emit kernels, and masked selection — used to
+resolve ``"auto"`` through its own scattered static rule
+(``permgather.resolve_*``, ``hopkernel.resolve_*``,
+``selection.resolve_selection_mode``).  This module replaces those rules
+with ONE table-driven chooser:
+
+    choose(op, backend, **shape) -> ranked candidate formulations
+
+The ranking is driven by the analytic cost models (``ops/mxutake
+.cost_model`` is the template; the other formulations are priced from the
+same bytes/FLOP inventories PERF_MODEL.md derives its projections from),
+parameterized by per-platform coefficients, and optionally overridden by
+MEASURED timings from a microbench sweep (``scripts/calibrate_dispatch
+.py``).  The table is a versioned, platform-fingerprinted JSON artifact:
+
+    - the shipped default (``ops/dispatch_table.json``) is analytic and
+      CONSERVATIVE — its TPU coefficients price the mxu one-hot operand
+      as streamed (the pessimistic lowering), so TPU ``auto`` keeps the
+      measured sort-era winners until a live window calibrates;
+    - ``GRAFT_DISPATCH_TABLE=path`` loads a calibrated table — the one
+      env flip that promotes a measured winner into every ``auto``;
+    - ``quarantined`` markers exclude losing formulations from auto
+      ranking (explicit requests still honored; deletion deferred until
+      a real TPU window confirms, ROADMAP item 2).
+
+The resolvers keep their FEASIBILITY gates (VMEM budgets, dtype/block
+constraints, config eligibility): dispatch ranks, the resolver walks the
+ranking and takes the first formulation that is actually executable.
+Dispatch is deterministic for a fixed table + shape
+(tests/test_dispatch.py pins it, and pins CPU parity with the legacy
+static rules at the bench shapes).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+# canonical formulation order per op; doubles as the deterministic
+# tie-break (earlier wins on exact cost ties — "iter" leads selection so
+# the legacy CPU 2·max_count == k boundary keeps resolving to iter)
+OPS: dict = {
+    "edge_permute": ("scalar", "rows", "sort", "pallas", "mxu"),
+    "words": ("scalar", "rows", "sort", "pallas", "mxu"),
+    "edge_packed": ("scalar", "rows", "sort", "pallas", "mxu"),
+    "hop": ("xla", "pallas", "pallas-mxu"),
+    "emit": ("xla", "pallas", "pallas-mxu"),
+    "selection": ("iter", "sort", "ranks"),
+}
+
+DEFAULT_TABLE_PATH = os.path.join(os.path.dirname(__file__),
+                                  "dispatch_table.json")
+
+_COEFF_KEYS = (
+    "mem_gbps",             # effective HBM/stream bandwidth
+    "gather_ns_per_index",  # XLA gather cost (measured ~7 ns on v5e)
+    "sort_ns_per_elem",     # variadic-sort comparator cost per element
+    "mxu_gflops",           # usable matmul rate for the one-hot selects
+    "onehot_streamed",      # bool: price the one-hot operand as streamed
+                            # (worst-case lowering) instead of resident
+    "pallas_overhead",      # multiplier on Pallas-kernel estimates (the
+                            # interpret emulation on CPU is ~1000x)
+    "sel_elem_ns",          # selection elementwise cost per element
+    "sel_sort_factor",      # sort-threshold work multiplier
+    "sel_ranks_factor",     # O(K^2) comparison-rank work multiplier
+    "sel_serial_us",        # per-sequential-pass latency (iter argmax)
+)
+
+_TABLE_CACHE: dict = {}
+
+
+class DispatchTableError(ValueError):
+    """The dispatch table failed to parse or misses required keys."""
+
+
+def clear_table_cache() -> None:
+    """Drop cached tables (tests that flip GRAFT_DISPATCH_TABLE)."""
+    _TABLE_CACHE.clear()
+
+
+def _validate(table: dict, path: str) -> dict:
+    if not isinstance(table, dict) or "platforms" not in table:
+        raise DispatchTableError(f"{path}: no 'platforms' mapping")
+    if int(table.get("version", 0)) < 1:
+        raise DispatchTableError(f"{path}: missing/zero 'version'")
+    for plat, entry in table["platforms"].items():
+        coeff = entry.get("coefficients", {})
+        missing = [k for k in _COEFF_KEYS if k not in coeff]
+        if missing:
+            raise DispatchTableError(
+                f"{path}: platform {plat!r} misses coefficients {missing}")
+        for op in entry.get("quarantined", {}):
+            if op not in OPS:
+                raise DispatchTableError(
+                    f"{path}: platform {plat!r} quarantines unknown op "
+                    f"{op!r}")
+    return table
+
+
+def load_table(path: str | None = None) -> dict:
+    """The active dispatch table: ``path`` arg, else the
+    ``GRAFT_DISPATCH_TABLE`` env override, else the shipped default.
+    Cached per path — the table is jit-static configuration, not state."""
+    path = path or os.environ.get("GRAFT_DISPATCH_TABLE") \
+        or DEFAULT_TABLE_PATH
+    cached = _TABLE_CACHE.get(path)
+    if cached is not None:
+        return cached
+    with open(path) as f:
+        table = _validate(json.load(f), path)
+    _TABLE_CACHE[path] = table
+    return table
+
+
+def platform_fingerprint() -> dict:
+    """What a calibrated table is stamped with — enough to refuse to
+    stand in for a different chip/runtime (scripts/calibrate_dispatch.py
+    writes it; bench journals carry the same discipline)."""
+    import jax
+    dev = jax.devices()[0]
+    return {"platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", ""),
+            "jax": jax.__version__}
+
+
+def _entry(table: dict, backend: str) -> dict:
+    plats = table["platforms"]
+    return plats.get(backend) or plats.get("default") or plats["cpu"]
+
+
+# --- analytic per-formulation cost models (milliseconds per call) ---------
+#
+# Shapes are jit-static ints; costs are host floats. The models reuse the
+# honest inventories of ops/mxutake.cost_model and PERF_MODEL.md's phase
+# accounting — bytes at mem_gbps, indices at gather_ns, sort elements at
+# sort_ns, one-hot FLOPs at mxu_gflops (plus the streamed-operand bytes
+# when the platform prices the pessimistic lowering).
+
+def _t_mem(nbytes: float, c: dict) -> float:
+    return nbytes / (c["mem_gbps"] * 1e9) * 1e3
+
+
+def _t_gather(indices: float, c: dict) -> float:
+    return indices * c["gather_ns_per_index"] * 1e-6
+
+
+def _t_sort(elems: float, lanes: int, c: dict) -> float:
+    # a variadic sort carries extra payload lanes almost free (measured
+    # on the live window); 15%/lane covers the extra payload moves
+    return elems * c["sort_ns_per_elem"] * 1e-6 * (1 + 0.15 * max(0, lanes - 1))
+
+
+def _t_mxu(model: dict, c: dict) -> float:
+    t = model["flops"] / (c["mxu_gflops"] * 1e9) * 1e3
+    t += _t_mem(model["table_bytes"] + model["out_bytes"]
+                + model.get("select_bytes", 0), c)
+    if c.get("onehot_streamed"):
+        t += _t_mem(model["onehot_bytes"] + model["lane_bytes"], c)
+    return t
+
+
+def _cost_edge_permute(form: str, c: dict, n: int, k: int,
+                       itemsize: int = 4, have_sort_key: bool = True,
+                       **_: object) -> float:
+    from .mxutake import cost_model_payload
+    r = n * k
+    if form == "scalar":
+        return _t_gather(r, c) + _t_mem(r * (2 * itemsize + 8), c)
+    if form == "rows":
+        # the row fetch is STILL an r-index gather (just of whole rows) —
+        # exactly why the live window measured rows at ~24.7 ms vs the
+        # model's bytes-only 2 ms — plus the [N, K, K] temporary
+        return _t_gather(r, c) \
+            + _t_mem(n * k * k * itemsize * 2 + r * (itemsize + 8), c)
+    if form == "sort":
+        if not have_sort_key:
+            return math.inf
+        return _t_sort(r, 1, c) + _t_mem(r * (itemsize + 4) * 2, c)
+    if form == "pallas":
+        return (_t_mem(n * k * itemsize * 3, c)
+                + _t_gather(r, c) * 0.2) * c["pallas_overhead"]
+    if form == "mxu":
+        if itemsize != 4:
+            return math.inf
+        return _t_mxu(cost_model_payload(n, k), c)
+    return math.inf
+
+
+def _cost_words(form: str, c: dict, w: int, n: int, k: int,
+                itemsize: int = 4, have_sort_key: bool = True,
+                **_: object) -> float:
+    from .mxutake import cost_model
+    r = n * k
+    m = 32 * w
+    if form == "scalar":
+        return _t_gather(w * r, c) + _t_mem(w * r * itemsize * 2, c)
+    if form == "rows":
+        # row gather of r neighbor rows + [N, M] bool planes + the
+        # [N, K, M] row temporary (write + read)
+        return _t_gather(r, c) \
+            + _t_mem(n * m + n * k * m * 2 + w * r * itemsize, c)
+    if form == "sort":
+        if not have_sort_key:
+            return math.inf
+        return _t_sort(r, w, c) + _t_mem(w * r * itemsize * 2, c)
+    if form == "pallas":
+        return _t_mem(w * n * itemsize + w * r * itemsize, c) \
+            * c["pallas_overhead"]
+    if form == "mxu":
+        if itemsize != 4:
+            return math.inf
+        return _t_mxu(cost_model(n, r, w), c)
+    return math.inf
+
+
+def _cost_edge_packed(form: str, c: dict, n: int, k: int, b: int,
+                      **_: object) -> float:
+    from .mxutake import cost_model
+    r = n * k
+    n_groups = (b + 31) // 32
+    wb = (b * k + 31) // 32
+    if form in ("scalar", "rows"):
+        return n_groups * _cost_edge_permute(form, c, n, k, itemsize=4)
+    if form == "sort":
+        # the packed exchange always computes its own destination keys
+        return _t_sort(r, n_groups, c) + _t_mem(n_groups * r * 8, c)
+    if form == "pallas":
+        return _t_mem(n * wb * 4 * 3, c) * c["pallas_overhead"]
+    if form == "mxu":
+        # one wb-word take + the plain-XLA bit-extract passes (b selects
+        # over the fetched [WB, N, K] rows)
+        return _t_mxu(cost_model(n, r, wb), c) + _t_mem(b * r / 2, c)
+    return math.inf
+
+
+def _cost_hop(form: str, c: dict, w: int, n: int, k: int,
+              **_: object) -> float:
+    from .mxutake import cost_model
+    r = n * k
+    if form == "xla":
+        # the best available words gather + the 5-pass K-prefix scan and
+        # the bit-set accumulators (PERF_MODEL.md pre-surgery inventory)
+        gather = min(_cost_words(f, c, w, n, k) for f in
+                     ("scalar", "rows", "sort"))
+        return gather + _t_mem(9 * w * k * n * 4, c)
+    if form == "pallas":
+        return (_t_mem(w * n * 4 + w * r, c) + _t_gather(r, c) * 0.2) \
+            * c["pallas_overhead"]
+    if form == "pallas-mxu":
+        return (_t_mxu(cost_model(n, r, w), c) + _t_mem(w * n * 4, c)) \
+            * c["pallas_overhead"]
+    return math.inf
+
+
+def _cost_emit(form: str, c: dict, w: int, n: int, k: int,
+               **_: object) -> float:
+    from .mxutake import cost_model
+    r = n * k
+    if form == "xla":
+        gather = min(_cost_words(f, c, w, n, k) for f in
+                     ("scalar", "rows", "sort"))
+        return gather + _t_mem(3 * k * w * n * 4, c)
+    if form == "pallas":
+        return (_t_mem(w * n * 4 + w * r, c) + _t_gather(r, c) * 0.2) \
+            * c["pallas_overhead"]
+    if form == "pallas-mxu":
+        return (_t_mxu(cost_model(n, r, w), c) + _t_mem(w * n * 4, c)) \
+            * c["pallas_overhead"]
+    return math.inf
+
+
+# nominal row count for selection ranking: the resolver does not know its
+# caller's row count (it never did), so ranking uses a fixed nominal —
+# keeping dispatch a pure function of (table, k, max_count)
+_SEL_ROWS = 4096
+
+
+def _cost_selection(form: str, c: dict, k: int,
+                    max_count: int | None = None, **_: object) -> float:
+    e = c["sel_elem_ns"] * 1e-6
+    if form == "iter":
+        if max_count is None or max_count >= k:
+            return math.inf
+        return max_count * k * _SEL_ROWS * e \
+            + max_count * c["sel_serial_us"] * 1e-3
+    if form == "sort":
+        return (k * k / 2) * _SEL_ROWS * e * c["sel_sort_factor"]
+    if form == "ranks":
+        return k * k * _SEL_ROWS * e * c["sel_ranks_factor"]
+    return math.inf
+
+
+_COST_FNS = {
+    "edge_permute": _cost_edge_permute,
+    "words": _cost_words,
+    "edge_packed": _cost_edge_packed,
+    "hop": _cost_hop,
+    "emit": _cost_emit,
+    "selection": _cost_selection,
+}
+
+
+def _measured_ms(entry: dict, op: str, shape: dict) -> dict:
+    """Measured per-formulation timings for the closest recorded shape
+    bucket, or {}. A record only matches when every shared numeric dim is
+    within 2x; the closest (min sum of |log ratio|) wins — deterministic
+    for a fixed table."""
+    best, best_d = {}, math.inf
+    for rec in entry.get("measured", ()):
+        if rec.get("op") != op:
+            continue
+        rshape = rec.get("shape", {})
+        d = 0.0
+        ok = True
+        for dim, val in rshape.items():
+            have = shape.get(dim)
+            if not isinstance(val, (int, float)) or have in (None, 0) \
+                    or val <= 0:
+                continue
+            ratio = have / val
+            if ratio > 2.0 or ratio < 0.5:
+                ok = False
+                break
+            d += abs(math.log(ratio))
+        if ok and d < best_d:
+            best, best_d = rec.get("ms", {}), d
+    return best
+
+
+def cost_ms(op: str, form: str, coeff: dict, **shape) -> float:
+    """Analytic cost estimate (ms) of one ``form`` call of ``op`` at
+    ``shape`` under the platform ``coeff`` — the number the ranking
+    sorts by when no measured bucket matches."""
+    return _COST_FNS[op](form, coeff, **shape)
+
+
+def explain(op: str, backend: str | None = None,
+            table: dict | None = None, **shape) -> dict:
+    """{formulation: estimated/measured ms} for every non-quarantined
+    candidate — the debugging/calibration view of one choose() call."""
+    import jax
+    backend = backend or jax.default_backend()
+    table = table or load_table()
+    entry = _entry(table, backend)
+    quarantined = set(entry.get("quarantined", {}).get(op, ()))
+    measured = _measured_ms(entry, op, shape)
+    out = {}
+    for form in OPS[op]:
+        if form in quarantined:
+            continue
+        ms = measured.get(form)
+        out[form] = float(ms) if ms is not None \
+            else cost_ms(op, form, entry["coefficients"], **shape)
+    return out
+
+
+def choose(op: str, backend: str | None = None,
+           table: dict | None = None, **shape) -> list:
+    """Ranked formulation candidates for ``op`` at ``shape`` on
+    ``backend`` (default: the active JAX backend), cheapest first.
+    Quarantined formulations are excluded; exact ties break toward the
+    canonical OPS order. The caller (the resolver) walks the list and
+    takes the first formulation that passes its feasibility gates."""
+    costs = explain(op, backend, table, **shape)
+    order = {f: i for i, f in enumerate(OPS[op])}
+    ranked = sorted(costs, key=lambda f: (costs[f], order[f]))
+    return ranked or list(OPS[op])
+
+
+def resolved_formulations(cfg) -> dict:
+    """The concrete formulation every engine seam executes under ``cfg``
+    — requested ``"auto"`` resolved through the dispatch table. bench.py
+    stamps this into every record so sort-vs-mxu trajectory lines are
+    attributable post-hoc without re-deriving the resolution logic."""
+    import jax.numpy as jnp
+
+    from .hopkernel import resolve_emit_mode, resolve_hop_mode
+    from .permgather import (
+        resolve_edge_packed_mode,
+        resolve_mode,
+        resolve_words_mode,
+    )
+    from .selection import resolve_selection_mode
+
+    n, k, t = cfg.n_peers, cfg.k_slots, cfg.n_topics
+    w = (cfg.msg_window + 31) // 32
+    return {
+        "edge_permute": resolve_mode(cfg.edge_gather_mode, jnp.uint32, n, k,
+                                     have_sort_key=True),
+        "words": resolve_words_mode(cfg.edge_gather_mode, w, n, k,
+                                    have_sort_key=True),
+        "edge_packed": resolve_edge_packed_mode(cfg.edge_gather_mode, n, k,
+                                                2 * t, extra_w=w),
+        "hop": resolve_hop_mode(cfg.hop_mode, cfg, w, n, k),
+        "emit": resolve_emit_mode(cfg.hop_mode, w, n, k),
+        "selection": resolve_selection_mode(cfg.selection_mode, k,
+                                            max_count=cfg.dhi),
+    }

@@ -83,9 +83,12 @@ def edge_gather_packed(masks: list, state: SimState,
     Every serially-dependent sort is ~7% of the sort-era tick (VERDICT r4
     item 1), so data-independent exchanges must share one comparator pass —
     forward_tick's IWANT answer-table gather rides the heartbeat's final
-    exchange this way. Only legal when the resolved mode is ``sort``
-    (callers gate on resolve_edge_packed_mode); invalid slots carry
-    garbage the consumers mask, exactly like gather_words' sort path."""
+    exchange this way. Legal when the resolved mode is ``sort`` (extra
+    lanes of the variadic sort) or ``mxu`` (extra word rows concatenated
+    onto the bit-table, fetched by the same two-level take — the MXU
+    formulation of the ride-along); callers gate on
+    resolve_edge_packed_mode. Invalid slots carry garbage the consumers
+    mask, exactly like gather_words' sort path."""
     from ..parallel.kernel_context import current_kernel_mesh
     from .permgather import (
         _edge_table_mxu,
@@ -100,13 +103,14 @@ def edge_gather_packed(masks: list, state: SimState,
     jn = jnp.clip(state.neighbors, 0, n - 1)
     rk = jnp.clip(state.reverse_slot, 0, k - 1)
     valid = ((state.neighbors >= 0) & (state.reverse_slot >= 0))[:, None, :]
-    mode = resolve_edge_packed_mode(mode, n, k, b)
     has_extras = extra_words is not None      # [] still returns the 2-tuple
     extra_words = extra_words or []
-    if extra_words and mode != "sort":
+    extra_w = sum(tab.shape[0] for tab in extra_words)
+    mode = resolve_edge_packed_mode(mode, n, k, b, extra_w=extra_w)
+    if extra_words and mode not in ("sort", "mxu"):
         raise ValueError(
-            f"extra_words requires the sort formulation (resolved {mode!r}); "
-            "callers gate on resolve_edge_packed_mode")
+            f"extra_words requires the sort or mxu formulation (resolved "
+            f"{mode!r}); callers gate on resolve_edge_packed_mode")
     sk = edge_sort_key(state.neighbors, state.reverse_slot, k_major=False) \
         if mode == "sort" else None
     # broadcast each extra word-table row along the slot axis: source slot
@@ -114,11 +118,16 @@ def edge_gather_packed(masks: list, state: SimState,
     # with neighbors[n, k] == j — the receiver view, [N, K] per row
     extra_lanes = [jnp.broadcast_to(tab[i][:, None], (n, k))
                    for tab in extra_words for i in range(tab.shape[0])]
+    extras_views = []                          # [W_i, K, N] per extra table
     if mode == "mxu":
         from .bits import pack_bool
         table = pack_bool(planes.reshape(n, b * k))        # [N, ceil(BK/32)]
-        groups = _edge_table_mxu(table, jn, rk, b,
-                                 interpret=jax.default_backend() != "tpu")
+        # the extras ride the SAME two-level take as concatenated word
+        # rows (permgather._edge_table_mxu) — the mxu analogue of the
+        # shared variadic sort below
+        groups, extras_views = _edge_table_mxu(
+            table, jn, rk, b, extra_words=tuple(extra_words),
+            interpret=jax.default_backend() != "tpu")
     elif mode == "pallas":
         from functools import partial
 
@@ -178,13 +187,15 @@ def edge_gather_packed(masks: list, state: SimState,
     # word-AND so no consumer can ever read a down edge's garbage words
     # (ADVICE r5: the old contract leaned on churn clearing iwant_pending
     # for downed edges, an implicit cross-module invariant)
+    if mode == "sort":
+        ofs = 0
+        for tab in extra_words:
+            wt = tab.shape[0]
+            extras_views.append(jnp.stack(
+                [extra_out[ofs + i].T for i in range(wt)]))
+            ofs += wt                                 # [W_i, K, N] each
     vmask = jnp.where(valid[:, 0, :].T, U32(0xFFFFFFFF), U32(0))   # [K, N]
-    extras, ofs = [], 0
-    for tab in extra_words:
-        wt = tab.shape[0]
-        extras.append(jnp.stack(
-            [extra_out[ofs + i].T for i in range(wt)]) & vmask[None])
-        ofs += wt                                     # [W_i, K, N] each
+    extras = [view & vmask[None] for view in extras_views]
     return results, extras
 
 

@@ -15,9 +15,9 @@ HBM per hop drops to: nbr indices + two bool planes + the uint8 count
 accumulators + a handful of [W, N] tables — ~55 MB at the headline shape
 (PERF_MODEL.md "planned" hop row).
 
-Eligibility (resolve_hop_mode): TPU backend (CPU auto keeps the XLA path;
-interpret mode is for tests), no per-edge/validation budgets, no gater, no
-provenance, no flood-publish — those configs keep the XLA formulation.
+Eligibility (resolve_hop_mode; ``auto`` ranks through ops/dispatch.py):
+no per-edge/validation budgets, no gater, no provenance, no
+flood-publish — those configs keep the XLA formulation.
 Bit-identical to the XLA hop: tests/test_hopkernel.py checks op-level
 (forward_tick, T=1 and T=3) and full-8-tick-run state equality in
 interpret mode, plus the resolution policy.
@@ -77,26 +77,9 @@ class HopOut(NamedTuple):
     dup: jnp.ndarray          # [T, K, N] uint8 mesh-duplicate counts
 
 
-def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
-    """'xla' everywhere on ``auto``: the fused kernels are bit-exact and
-    shard_map-ready, but the first live-tunnel window proved current
-    Mosaic CANNOT lower any >128-wide table lookup ("Multiple source vregs
-    along gather dimension" — tpu.dynamic_gather shuffles within one
-    vector register only), so the VMEM-table design is not compilable on
-    real v5e today. Explicit ``pallas`` stays available for interpret-mode
-    tests, the virtual-mesh sharded path, and future Mosaic versions;
-    ``pallas-mxu`` is the same fused design with every in-kernel gather
-    rewritten as the gather-free two-level one-hot select (mxutake.py) —
-    the wall-dodging variant the next live window A/Bs natively. Config
-    eligibility applies to both Pallas variants; ``pallas-mxu``
-    additionally needs a lane-aligned peer count (the in-kernel chunk
-    reshape, take_words_onehot)."""
-    if mode not in ("auto", "xla", "pallas", "pallas-mxu"):
-        raise ValueError(f"unknown hop_mode {mode!r}")
-    if mode == "auto":
-        mode = "xla"
-    if mode in ("pallas", "pallas-mxu"):
-        if (cfg.gater_enabled or cfg.record_provenance
+def _hop_config_ok(cfg) -> bool:
+    """Config eligibility shared by both Pallas hop variants."""
+    return not (cfg.gater_enabled or cfg.record_provenance
                 or cfg.edge_queue_cap > 0 or cfg.validation_queue_cap > 0
                 or (cfg.flood_publish and cfg.router == "gossipsub")
                 or cfg.count_dtype != "uint8"
@@ -108,43 +91,75 @@ def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
                 # data_ok plane cannot express
                 or (cfg.fault_plan is not None
                     and (cfg.fault_plan.link_dup_prob > 0
-                         or cfg.fault_plan.link_drop_prob > 0))):
-            return "xla"
-        # table feasibility is GLOBAL n; block feasibility is the
-        # per-shard row count under a kernel mesh
-        if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
-                or _block_rows(local_rows(n), 4 * w * k * 4) is None):
-            return "xla"
-        if mode == "pallas-mxu" and n % 128 != 0:
-            return "xla"
+                         or cfg.fault_plan.link_drop_prob > 0)))
+
+
+def _hop_shape_ok(w: int, n: int, k: int) -> bool:
+    # table feasibility is GLOBAL n; block feasibility is the per-shard
+    # row count under a kernel mesh. pallas-mxu no longer needs a
+    # lane-aligned peer count: the table pads OUT of kernel (mxutake
+    # .pad_lanes seam in hop_pallas/iwant_resolve_pallas/emit_pallas)
+    return (w * n * 4 <= _PALLAS_VMEM_PAYLOAD_BYTES
+            and _block_rows(local_rows(n), 4 * w * k * 4) is not None)
+
+
+def resolve_hop_mode(mode: str, cfg, w: int, n: int, k: int) -> str:
+    """Resolve the forwarding-hop formulation. ``auto`` ranks candidates
+    through the measured cost-model dispatch (ops/dispatch.py); under the
+    shipped conservative table that is 'xla' everywhere: the fused
+    kernels are bit-exact and shard_map-ready, but the first live-tunnel
+    window proved current Mosaic CANNOT lower any >128-wide table lookup
+    ("Multiple source vregs along gather dimension" — tpu.dynamic_gather
+    shuffles within one vector register only), so the VMEM-table design
+    is not compilable on real v5e today ('pallas' is quarantined in the
+    table). ``pallas-mxu`` — the same fused design with every in-kernel
+    gather rewritten as the gather-free two-level one-hot select
+    (mxutake.py) — is priced pessimistically (streamed one-hot operand)
+    until a calibrated GRAFT_DISPATCH_TABLE measures the resident
+    lowering and promotes it. Config eligibility applies to both Pallas
+    variants; the old lane-aligned-N constraint on ``pallas-mxu`` is
+    gone (out-of-kernel pad seam)."""
+    if mode not in ("auto", "xla", "pallas", "pallas-mxu"):
+        raise ValueError(f"unknown hop_mode {mode!r}")
+    if mode == "auto":
+        from .dispatch import choose
+        for cand in choose("hop", w=w, n=n, k=k):
+            if cand == "xla" or (_hop_config_ok(cfg)
+                                 and _hop_shape_ok(w, n, k)):
+                return cand
+        return "xla"
+    if mode in ("pallas", "pallas-mxu") and \
+            not (_hop_config_ok(cfg) and _hop_shape_ok(w, n, k)):
+        return "xla"
     return mode
 
 
 def resolve_emit_mode(mode: str, w: int, n: int, k: int) -> str:
     """Gossip-emit formulation: the fused kernel has no config
     restrictions (the emit step has no cap/gater/provenance interaction) —
-    only backend and VMEM-feasibility gates (plus lane alignment for
-    ``pallas-mxu``, as in resolve_hop_mode).
+    only VMEM-feasibility gates (lane alignment is handled by the
+    out-of-kernel pad seam, as in resolve_hop_mode). ``auto`` ranks
+    through ops/dispatch.py like the hop.
 
     NATIVE-LOWERING RISK (ADVICE r5): ``emit_pallas`` mixes
     ``prefix_count_words`` and ``pack_words`` inside the kernel body —
     1-D iota, a ``masked.T`` transpose, per-word shifts — an op class
     Mosaic has historically refused to lower even where interpret mode
-    (the CI tier) is exact. ``auto`` therefore stays ``xla``; before
-    promoting an explicit ``pallas``/``pallas-mxu`` emit on real TPU,
-    confirm the dedicated native probes in scripts/tpu_kernel_smoke.py
-    ("emit_pallas*" and "emit resolve path (engine-shaped)") pass on a
-    live window."""
+    (the CI tier) is exact. The conservative table therefore keeps
+    ``auto`` at ``xla``; before promoting an explicit
+    ``pallas``/``pallas-mxu`` emit on real TPU, confirm the dedicated
+    native probes in scripts/tpu_kernel_smoke.py ("emit_pallas*" and
+    "emit resolve path (engine-shaped)") pass on a live window."""
     if mode not in ("auto", "xla", "pallas", "pallas-mxu"):
         raise ValueError(f"unknown hop_mode {mode!r}")
     if mode == "auto":
-        mode = "xla"               # see resolve_hop_mode: Mosaic gather wall
-    if mode in ("pallas", "pallas-mxu"):
-        if (w * n * 4 > _PALLAS_VMEM_PAYLOAD_BYTES
-                or _block_rows(local_rows(n), 4 * w * k * 4) is None):
-            return "xla"
-        if mode == "pallas-mxu" and n % 128 != 0:
-            return "xla"
+        from .dispatch import choose
+        for cand in choose("emit", w=w, n=n, k=k):
+            if cand == "xla" or _hop_shape_ok(w, n, k):
+                return cand
+        return "xla"
+    if mode in ("pallas", "pallas-mxu") and not _hop_shape_ok(w, n, k):
+        return "xla"
     return mode
 
 
@@ -168,6 +183,12 @@ def emit_pallas(window, have, gossip_u8, topic_bits, nbr, m, budget,
     """
     from jax.experimental import pallas as pl
 
+    if gather == "mxu":
+        # out-of-kernel pad seam: the in-kernel one-hot select needs a
+        # lane-aligned table width (mxutake.take_words_onehot); nbr < N
+        # never selects a pad column
+        from .mxutake import pad_lanes
+        window = pad_lanes(window)
     w, n = window.shape
     nr, k = nbr.shape                  # receiver rows (local shard under
     t = topic_bits.shape[0]            # a kernel mesh; == n unsharded)
@@ -240,6 +261,10 @@ def iwant_resolve_pallas(pend, answers, have, vm, inv_n, alive, data_ok_u8,
     """
     from jax.experimental import pallas as pl
 
+    if gather == "mxu":
+        # out-of-kernel pad seam (see emit_pallas)
+        from .mxutake import pad_lanes
+        answers = pad_lanes(answers)
     w, n = answers.shape
     nr, k = nbr.shape                  # receiver rows (local shard under
     t = topic_bits.shape[0]            # a kernel mesh; == n unsharded)
@@ -343,6 +368,10 @@ def hop_pallas(frontier, have, dlv, dlv_new, vm, inv_n, window_old,
     """
     from jax.experimental import pallas as pl
 
+    if gather == "mxu":
+        # out-of-kernel pad seam (see emit_pallas)
+        from .mxutake import pad_lanes
+        frontier = pad_lanes(frontier)
     w, n = frontier.shape
     nr, k = nbr.shape                  # receiver rows (local shard under
     t = topic_bits.shape[0]            # a kernel mesh; == n unsharded)

@@ -48,6 +48,33 @@ LANES = 128
 # mxu feasibility gate prices exactly this block size — keep them in sync
 # by importing from here.
 DEFAULT_BLOCK_G = 1024
+# word-tile budget for the blocked payload take: the chunk-plane table of
+# one take_words_twolevel call is 8·n_pad bytes per word row; tiles are
+# sized so the resident planes stay under this, leaving headroom for the
+# one-hot tile + MXU rows inside permgather's 8 MB payload budget
+_PAYLOAD_PLANES_BYTES = 4 * 1024 * 1024
+
+
+def pad_lanes(x_w: jnp.ndarray) -> jnp.ndarray:
+    """Zero-pad the table axis (last) of a [..., N] word table up to a
+    LANES multiple — the out-of-kernel pad seam that generalizes
+    ``take_words_onehot`` past the 128-lane-multiple constraint: callers
+    pad the table BEFORE the pallas_call (indices < N never select pad
+    columns), so the in-kernel chunk reshape always sees an aligned N."""
+    n = x_w.shape[-1]
+    pad = -n % LANES
+    if not pad:
+        return x_w
+    widths = [(0, 0)] * (x_w.ndim - 1) + [(0, pad)]
+    return jnp.pad(x_w, widths)
+
+
+def payload_w_tile(n: int, k: int) -> int:
+    """Word-tile size for the blocked payload take: how many of the K
+    word planes one take_words_twolevel call may carry before its resident
+    chunk planes (8·n_pad bytes/word) outgrow the tile budget."""
+    n_pad = -(-n // LANES) * LANES
+    return max(1, min(k, _PAYLOAD_PLANES_BYTES // (8 * n_pad)))
 
 
 def _prep_table(x_w: jnp.ndarray) -> jnp.ndarray:
@@ -132,8 +159,10 @@ def take_words_onehot(tab: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
     — for use INSIDE another Pallas kernel body whose [W, N] u32 table is
     already VMEM-resident (ops/hopkernel.py ``pallas-mxu`` dispatch). The
     chunk planes are built in-kernel from the words, so N must be a LANES
-    multiple (no pad seam inside a traced body; resolve_hop_mode gates
-    eligibility on it)."""
+    multiple — callers pad the table BEFORE the pallas_call with
+    :func:`pad_lanes` (no pad seam inside a traced body; the hop/resolve/
+    emit kernels all do, which is what freed ``pallas-mxu`` from the
+    lane-aligned peer-count constraint)."""
     w, n = tab.shape
     if n % LANES:
         # not assert: -O must not strip the reshape-contract guard
@@ -150,6 +179,45 @@ def take_words_onehot(tab: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
             acc = acc | (v << jnp.uint32(8 * c))
         words.append(acc)
     return jnp.stack(words)
+
+
+def take_payload_onehot(payload: jnp.ndarray, jn: jnp.ndarray,
+                        rk: jnp.ndarray, block_g: int = DEFAULT_BLOCK_G,
+                        interpret: bool = False) -> jnp.ndarray:
+    """out[i, s] = payload[jn[i, s], rk[i, s]] with NO gather op — the
+    blocked/tiled one-hot variant of the generic [N, K] payload permute
+    (the last scalar degradation the mxu mode carried, ROADMAP item 2).
+
+    The payload's K slot columns are viewed as K word planes ([K, N] u32
+    via bitcast for any 4-byte dtype), routed through the two-level take
+    in word TILES (``payload_w_tile``) so the resident chunk planes stay
+    VMEM-bounded at any K — the all-at-once formulation would need a
+    block_g × ceil(NK/128) one-hot tile (~50 MB at the 100k headline).
+    The slot pick is then a K-wide one-hot select over the fetched rows
+    (exactly one nonzero term per edge), all plain XLA.
+
+    ``jn``/``rk`` must be pre-clipped to valid range, like every
+    permutation_gather formulation. Exact for every 4-byte dtype
+    (u32 round-trips bitcast; the chunk select is integer routing)."""
+    dt = payload.dtype
+    if dt.itemsize != 4:
+        # not assert: -O must not strip the 4-u8-chunk contract guard
+        raise ValueError(
+            f"take_payload_onehot needs a 4-byte payload dtype, got {dt}")
+    n, k = payload.shape
+    words = payload if dt == jnp.uint32 else \
+        jax.lax.bitcast_convert_type(payload, jnp.uint32)
+    planes = words.T                                       # [K, N] tables
+    idx = jn.reshape(-1).astype(jnp.int32)                 # n-major [R]
+    wt = payload_w_tile(n, k)
+    rows = jnp.concatenate(
+        [take_words_twolevel(planes[w0:w0 + wt], idx, block_g, interpret)
+         for w0 in range(0, k, wt)], axis=0)               # [K, R]
+    sel = rk.reshape(-1)[None, :] == jnp.arange(k)[:, None]
+    out = jnp.sum(jnp.where(sel, rows, jnp.uint32(0)), axis=0,
+                  dtype=jnp.uint32).reshape(jn.shape)
+    return out if dt == jnp.uint32 else \
+        jax.lax.bitcast_convert_type(out, dt)
 
 
 def cost_model(n: int, r: int, w: int, block_g: int = DEFAULT_BLOCK_G) -> dict:
@@ -190,6 +258,25 @@ def cost_model(n: int, r: int, w: int, block_g: int = DEFAULT_BLOCK_G) -> dict:
         "out_bytes": out_bytes,
         "flops": flops,
     }
+
+
+def cost_model_payload(n: int, k: int,
+                       block_g: int = DEFAULT_BLOCK_G) -> dict:
+    """Bytes/FLOP inventory of one blocked payload take
+    (``take_payload_onehot``): a W=K-word two-level take over all N*K
+    edge indices, plus the K-wide one-hot slot select that re-reads the
+    fetched [K, R] rows once (``select_bytes``). Same honest-accounting
+    contract as :func:`cost_model` — PERF_MODEL.md "Dispatch table"
+    prices the mxu payload-permute formulation from exactly this."""
+    m = cost_model(n, n * k, k, block_g)
+    m["select_bytes"] = k * (n * k) * 4 + n * k * 4
+    # VMEM residency is per word TILE, not per the full K planes
+    wt = payload_w_tile(n, k)
+    nb = -(-n // LANES)
+    m["vmem_bytes"] = (wt * 4 * nb * LANES * 2
+                       + min(n * k, block_g) * nb * 2
+                       + min(n * k, block_g) * LANES * 4)
+    return m
 
 
 def take_words_twolevel_ref(x_w: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:

@@ -22,16 +22,21 @@ TPU recheck can measure them head-to-head (scripts/microbench_kernels.py):
   permutation never round-trips HBM at all. Only eligible while the payload
   fits VMEM (N*K*4B <= ~8MB, i.e. <= ~60k peers at K=32); falls back to
   ``rows`` above that.
-- ``mxu``: the gather-free two-level MXU take (ops/mxutake.py) for the
-  WORD-TABLE gathers — one-hot bf16 matmul block select + lane select, no
-  gather op of any width, so it sidesteps the Mosaic 128-lane wall that
-  blocks every ``pallas`` table kernel on current chips. Word-table call
-  sites (gather_words, the packed edge exchange via its bit-table) route
-  through it; the generic [N, K] payload permute degrades to ``scalar``
-  (an N*K-wide one-hot tile would blow VMEM at bench shapes).
+- ``mxu``: the gather-free two-level MXU take (ops/mxutake.py) — one-hot
+  bf16 matmul block select + lane select, no gather op of any width, so
+  it sidesteps the Mosaic 128-lane wall that blocks every ``pallas``
+  table kernel on current chips. Word-table call sites (gather_words, the
+  packed edge exchange via its bit-table, which also carries the IWANT
+  answer ride-along as extra concatenated word rows) route through the
+  two-level take, and the generic [N, K] payload permute rides the
+  blocked/tiled variant (mxutake.take_payload_onehot) for 4-byte dtypes —
+  ``edge_gather_mode="mxu"`` lowers with zero serialized scalar HBM
+  gathers.
 
-``auto`` resolves to ``scalar`` on CPU and ``rows`` on TPU (the
-measured-safe default until the chip recheck promotes ``pallas``).
+``auto`` ranks every formulation through the measured cost-model dispatch
+(ops/dispatch.py; the shipped conservative table reproduces the
+measured-safe legacy picks — scalar on CPU, sort on TPU — until a
+calibrated GRAFT_DISPATCH_TABLE promotes a winner).
 """
 
 from __future__ import annotations
@@ -295,14 +300,25 @@ def _mxu_take_feasible(w: int, n: int) -> bool:
     return vmem <= _PALLAS_VMEM_PAYLOAD_BYTES and current_kernel_mesh() is None
 
 
-def _edge_table_mxu(table, jn, rk, b_planes, interpret=False):
+def _edge_table_mxu(table, jn, rk, b_planes, extra_words=(),
+                    interpret=False):
     """Bit-table edge exchange routed through the gather-free two-level MXU
     take: same [N, ceil(B*K/32)] u32 b-major/slot-minor bit-table contract
     as ``_edge_table_pallas``, but the per-edge row fetch is
     ``take_words_twolevel`` (one-hot matmul block select — no gather op of
     any width, mxutake.py) and the bit extraction runs as plain XLA
-    word-selects. Returns one [N, K] u32 payload per 32-plane group,
-    bit-compatible with every other formulation."""
+    word-selects. Returns ``(groups, extras)``: one [N, K] u32 payload per
+    32-plane group, bit-compatible with every other formulation, plus the
+    receiver views of ``extra_words``.
+
+    ``extra_words`` ([W_i, N] u32 tables) is the MXU formulation of the
+    sort mode's ride-along (heartbeat.edge_gather_packed): the extra word
+    rows CONCATENATE onto the bit-table, so the one one-hot matmul — the
+    expensive operand — fetches the exchange AND the extras in a single
+    take, exactly as the variadic sort carries extra payload lanes. This
+    is what lets engine._iwant_answer_extras merge the IWANT answer
+    gather under ``edge_gather_mode="mxu"`` instead of paying its own
+    serially-dependent take (the last mxu scalar tail, ROADMAP item 2)."""
     from .mxutake import take_words_twolevel
 
     n, wb = table.shape
@@ -310,8 +326,11 @@ def _edge_table_mxu(table, jn, rk, b_planes, interpret=False):
     n_groups = (b_planes + 31) // 32
     u32 = jnp.uint32
     idx = jn.reshape(-1).astype(jnp.int32)                 # n-major [NR*K]
-    rows = take_words_twolevel(table.T, idx, interpret=interpret)
-    rows = rows.reshape(wb, nr, k)                         # [WB, N, K]
+    tabs = table.T                                         # [WB, N]
+    if extra_words:
+        tabs = jnp.concatenate([tabs, *extra_words], axis=0)
+    rows_all = take_words_twolevel(tabs, idx, interpret=interpret)
+    rows = rows_all[:wb].reshape(wb, nr, k)                # [WB, N, K]
     pos0 = rk.astype(u32)                                  # bit positions
     accs = [jnp.zeros((nr, k), u32) for _ in range(n_groups)]
     for b in range(b_planes):
@@ -322,61 +341,55 @@ def _edge_table_mxu(table, jn, rk, b_planes, interpret=False):
             word = jnp.where(wsel == wi, rows[wi], word)   # static: select
         bit = (word >> (pos % u32(32))) & u32(1)
         accs[b // 32] = accs[b // 32] | (bit << u32(b % 32))
-    return accs
+    extras, ofs = [], wb
+    for tab in extra_words:
+        wt = tab.shape[0]
+        extras.append(jnp.transpose(
+            rows_all[ofs:ofs + wt].reshape(wt, nr, k), (0, 2, 1)))
+        ofs += wt                                          # [W_i, K, N]
+    return accs, extras
 
 
-def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int) -> str:
-    """Resolve the packed-edge-exchange formulation (heartbeat
-    edge_gather_packed). ``pallas`` is the bit-table kernel above; ``mxu``
-    is the same bit-table routed through the two-level MXU take
-    (_edge_table_mxu); TPU ``auto`` picks sort (PERF_MODEL.md), CPU
-    ``auto`` keeps the scalar per-group gather. Ineligible shapes degrade
-    pallas/mxu -> rows."""
-    backend = jax.default_backend()
-    if mode == "auto":
-        # TPU auto is the sort-permute apply (edge_sort_key docstring:
-        # ~5-7 ms vs 34 ms scalar per exchange at 100k, honest-methodology
-        # live-window numbers); Mosaic cannot lower the bit-table kernel's
-        # >128-wide VMEM gather (see hopkernel.resolve_hop_mode)
-        mode = {"cpu": "scalar", "tpu": "sort"}.get(backend, "rows")
-    if mode == "mxu":
-        wb = (b_planes * k + 31) // 32
-        if not _mxu_take_feasible(wb, n):
-            return "rows"
+def _edge_packed_eligible(mode: str, n: int, k: int, b_planes: int,
+                          extra_w: int = 0) -> str:
+    """Concrete mode if ``mode`` is executable at this shape, else its
+    degrade target (the dispatch walk skips candidates that degrade)."""
+    wb = (b_planes * k + 31) // 32
+    if mode == "mxu" and not _mxu_take_feasible(wb + extra_w, n):
+        return "rows"
     if mode == "pallas":
         # table feasibility is GLOBAL n (the whole bit-table pins in VMEM);
         # block feasibility is the per-shard row count under a kernel mesh
-        wb = (b_planes * k + 31) // 32
-        # table + _mosaic_take's table-width index/result temporaries
+        # (table + _mosaic_take's table-width index/result temporaries)
         if (n * wb * 12 > _PALLAS_VMEM_PAYLOAD_BYTES
                 or _block_rows(local_rows(n), 2 * k * wb * 4) is None):
             return "rows"
     return mode
 
 
-def resolve_words_mode(mode: str, w: int, n: int, k: int,
-                       itemsize: int = 4,
-                       have_sort_key: bool = False) -> str:
-    """Resolve the message-table gather mode (bits.gather_words_rows).
-
-    TPU ``auto`` is ``sort`` when the caller passes the edge keys (the
-    sort-permute apply, edge_sort_key docstring; 9.0 vs 24.7 ms for the
-    100k hop gather on the live window), else ``rows``. ``pallas`` (the
-    VMEM table kernel PERF_MODEL.md S1 designed) is blocked from auto by
-    the Mosaic >128-wide gather wall and stays explicit-only;
-    scripts/ablate.py sweeps all formulations head-to-head.
-    """
-    backend = jax.default_backend()
+def resolve_edge_packed_mode(mode: str, n: int, k: int, b_planes: int,
+                             extra_w: int = 0) -> str:
+    """Resolve the packed-edge-exchange formulation (heartbeat
+    edge_gather_packed). ``pallas`` is the bit-table kernel above; ``mxu``
+    is the same bit-table routed through the two-level MXU take
+    (_edge_table_mxu). ``auto`` ranks candidates through the measured
+    cost-model dispatch (ops/dispatch.py — sort on TPU, scalar on CPU
+    under the shipped conservative table) and takes the first executable
+    one. ``extra_w`` is the ride-along word count (sort and mxu carry
+    extras; the mxu VMEM gate prices them). Ineligible shapes degrade
+    pallas/mxu -> rows."""
     if mode == "auto":
-        # TPU auto is the sort-permute form when the caller supplies the
-        # edge keys (9.0 ms vs rows 24.7 ms for the hop gather at 100k,
-        # live-window honest-methodology measurement), else rows (which
-        # beat scalar 2.5x for M-wide window rows). The Mosaic gather
-        # wall blocks the VMEM-table kernel (resolve_hop_mode).
-        if backend == "tpu":
-            mode = "sort" if have_sort_key else "rows"
-        else:
-            mode = "scalar"
+        from .dispatch import choose
+        for cand in choose("edge_packed", n=n, k=k, b=b_planes):
+            got = _edge_packed_eligible(cand, n, k, b_planes, extra_w)
+            if got == cand:
+                return got
+        return "scalar"
+    return _edge_packed_eligible(mode, n, k, b_planes, extra_w)
+
+
+def _words_eligible(mode: str, w: int, n: int, k: int, itemsize: int,
+                    have_sort_key: bool) -> str:
     if mode == "sort" and not have_sort_key:
         return "rows"
     if mode == "mxu":
@@ -389,6 +402,31 @@ def resolve_words_mode(mode: str, w: int, n: int, k: int,
                 or _block_rows(local_rows(n), 2 * w * k * itemsize) is None):
             return "rows"
     return mode
+
+
+def resolve_words_mode(mode: str, w: int, n: int, k: int,
+                       itemsize: int = 4,
+                       have_sort_key: bool = False) -> str:
+    """Resolve the message-table gather mode (bits.gather_words_rows).
+
+    ``auto`` ranks candidates through the measured cost-model dispatch
+    (ops/dispatch.py): under the shipped conservative table TPU picks
+    ``sort`` when the caller passes the edge keys (9.0 vs 24.7 ms for the
+    100k hop gather on the live window), else ``rows``; CPU picks
+    ``scalar``. A calibrated GRAFT_DISPATCH_TABLE can promote ``mxu``.
+    ``pallas`` (the VMEM table kernel PERF_MODEL.md S1 designed) is
+    quarantined from TPU auto by the Mosaic >128-wide gather wall and
+    stays explicit-only; scripts/ablate.py sweeps all formulations
+    head-to-head."""
+    if mode == "auto":
+        from .dispatch import choose
+        for cand in choose("words", w=w, n=n, k=k, itemsize=itemsize,
+                           have_sort_key=have_sort_key):
+            if _words_eligible(cand, w, n, k, itemsize,
+                               have_sort_key) == cand:
+                return cand
+        return "scalar"
+    return _words_eligible(mode, w, n, k, itemsize, have_sort_key)
 
 
 def gather_words(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
@@ -442,30 +480,19 @@ def gather_words(x_w: jnp.ndarray, nbr: jnp.ndarray, m: int,
     raise ValueError(f"unknown gather_words mode {mode!r}")
 
 
-def resolve_mode(mode: str, payload_dtype, n: int, k: int,
-                 have_sort_key: bool = False) -> str:
-    """Resolve ``auto``/ineligible requests to a concrete formulation.
-
-    TPU auto is ``sort`` (the sort-permute apply, edge_sort_key docstring)
-    when the caller supplies the destination keys, else ``scalar`` — the
-    honest-methodology live-window numbers: sort ~5-7 ms vs scalar
-    advanced-index ~23-34 ms vs rows ~55 ms for a [N,K] u32 exchange at
-    100k (XLA gathers pay ~7 ns/index; sort moves the same bytes 4x
-    faster)."""
-    backend = jax.default_backend()
-    if mode == "auto":
-        mode = "sort" if (backend == "tpu" and have_sort_key) else "scalar"
+def _payload_eligible(mode: str, itemsize: int, n: int, k: int,
+                      have_sort_key: bool) -> str:
     if mode == "mxu":
-        # the two-level take is a WORD-TABLE formulation: flattening the
-        # [N, K] payload into an N*K-wide table would need a block_g x
-        # ceil(NK/128) one-hot tile (~50 MB at the 100k headline) — VMEM
-        # infeasible, so the generic payload permute rides scalar while
-        # the word-table call sites carry the mxu exchange
-        return "scalar"
+        # the blocked/tiled one-hot payload take
+        # (mxutake.take_payload_onehot) views the K slot columns as word
+        # planes and tiles them through the two-level take, so VMEM stays
+        # bounded at any shape — the gates left are the exact-4-u8-chunk
+        # dtype contract and the whole-table (unsharded) requirement
+        if itemsize != 4 or current_kernel_mesh() is not None:
+            return "scalar"
     if mode == "sort" and not have_sort_key:
         return "scalar"
     if mode == "pallas":
-        itemsize = jnp.dtype(payload_dtype).itemsize
         # footprint = payload table + _mosaic_take's full-table-width
         # broadcast index (i32) and take result per chunk — ~3x the
         # payload for u32, which the old payload-only gate understated
@@ -476,6 +503,33 @@ def resolve_mode(mode: str, payload_dtype, n: int, k: int,
             return "rows"    # sub-word dtype, payload > VMEM budget, or no
                              # block size whose row scratch fits
     return mode
+
+
+def resolve_mode(mode: str, payload_dtype, n: int, k: int,
+                 have_sort_key: bool = False) -> str:
+    """Resolve ``auto``/ineligible requests to a concrete formulation.
+
+    ``auto`` ranks candidates through the measured cost-model dispatch
+    (ops/dispatch.py): under the shipped conservative table TPU picks
+    ``sort`` (the sort-permute apply, edge_sort_key docstring) when the
+    caller supplies the destination keys, else ``scalar`` — the
+    honest-methodology live-window numbers: sort ~5-7 ms vs scalar
+    advanced-index ~23-34 ms vs rows ~55 ms for a [N,K] u32 exchange at
+    100k (XLA gathers pay ~7 ns/index; sort moves the same bytes 4x
+    faster); CPU picks ``scalar``. Explicit ``mxu`` now rides the
+    blocked one-hot payload take (mxutake.take_payload_onehot) for
+    4-byte dtypes — the generic payload permute no longer degrades the
+    mxu mode to serialized scalar HBM gathers."""
+    itemsize = jnp.dtype(payload_dtype).itemsize
+    if mode == "auto":
+        from .dispatch import choose
+        for cand in choose("edge_permute", n=n, k=k, itemsize=itemsize,
+                           have_sort_key=have_sort_key):
+            if _payload_eligible(cand, itemsize, n, k,
+                                 have_sort_key) == cand:
+                return cand
+        return "scalar"
+    return _payload_eligible(mode, itemsize, n, k, have_sort_key)
 
 
 def permutation_gather(payload: jnp.ndarray, jn: jnp.ndarray,
@@ -497,6 +551,12 @@ def permutation_gather(payload: jnp.ndarray, jn: jnp.ndarray,
         return _gather_scalar(payload, jn, rk)
     if mode == "rows":
         return _gather_rows(payload, jn, rk)
+    if mode == "mxu":
+        # blocked/tiled one-hot payload take (ops/mxutake.py): no gather
+        # op of any width — the mxu mode's last scalar degradation closed
+        from .mxutake import take_payload_onehot
+        return take_payload_onehot(payload, jn, rk,
+                                   interpret=jax.default_backend() != "tpu")
     if mode == "pallas":
         fn = functools.partial(_gather_pallas,
                                interpret=jax.default_backend() != "tpu")

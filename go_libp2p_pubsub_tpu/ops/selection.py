@@ -53,19 +53,23 @@ def ranks_desc(keys: jnp.ndarray) -> jnp.ndarray:
 
 def resolve_selection_mode(mode: str, k: int,
                            max_count: int | None = None) -> str:
-    """Resolve ``auto``/ineligible selection-mode requests.
+    """Resolve ``auto``/ineligible selection-mode requests through the
+    measured cost-model dispatch (ops/dispatch.py). The shipped
+    conservative table reproduces the legacy static rule — CPU picks
+    ``iter`` while ``2 * max_count <= k`` else ``sort``; TPU picks
+    ``ranks`` — until a calibrated GRAFT_DISPATCH_TABLE re-ranks.
 
     ``iter`` needs a static ``max_count`` bound and only pays off while the
     bound is well under K (its cost is max_count sequential argmax passes).
     """
     backend = jax.default_backend()
     if mode == "auto":
-        if backend == "cpu":
-            mode = "iter" if (max_count is not None and 2 * max_count <= k) \
-                else "sort"
-        else:
-            mode = "ranks"     # measured-safe TPU default until the chip
-                               # recheck promotes a formulation
+        from .dispatch import choose
+        for cand in choose("selection", k=k, max_count=max_count):
+            if cand == "iter" and (max_count is None or max_count >= k):
+                continue
+            return cand
+        mode = "sort"
     if mode == "iter" and (max_count is None or max_count >= k):
         return "ranks" if backend != "cpu" else "sort"
     return mode

@@ -91,26 +91,33 @@ class SimConfig:
     scoring_enabled: bool = True
 
     # reverse-edge permutation gather formulation (ops/permgather.py):
-    # "auto" (backend default) | "scalar" | "rows" | "sort" | "pallas" |
-    # "mxu" — "mxu" routes every word-table gather (hop gathers, IWANT
-    # answer table, the packed edge exchange via its bit-table) through
-    # the gather-free two-level MXU take (ops/mxutake.py), the one
-    # formulation the Mosaic 128-lane gather wall cannot block; the
-    # next TPU window A/Bs sort-vs-mxu with GRAFT_EDGE_GATHER=mxu
+    # "auto" (measured cost-model dispatch, ops/dispatch.py) | "scalar" |
+    # "rows" | "sort" | "pallas" | "mxu" — "mxu" routes EVERY gather
+    # through the gather-free two-level MXU take (ops/mxutake.py): the
+    # word tables (hop gathers, the packed edge exchange via its
+    # bit-table, the IWANT answer table riding the exchange as extra word
+    # rows) AND the generic [N, K] payload permute (the blocked one-hot
+    # take) — zero serialized scalar HBM gathers, the one formulation the
+    # Mosaic 128-lane gather wall cannot block. "auto" ranks candidates
+    # by the dispatch table (GRAFT_DISPATCH_TABLE loads a calibrated one;
+    # the shipped default reproduces the measured sort-era picks)
     edge_gather_mode: str = "auto"
 
     # masked selection formulation (ops/selection.py):
-    # "auto" (backend default) | "ranks" | "sort" | "iter"
+    # "auto" (cost-model dispatch) | "ranks" | "sort" | "iter"
     selection_mode: str = "auto"
 
     # forwarding-hop formulation (ops/hopkernel.py): "auto" | "xla" |
     # "pallas" | "pallas-mxu" — the fused Pallas hop needs cap-free/
     # gater-free/provenance-free configs and falls back to the XLA hop
-    # otherwise (auto is xla everywhere: the Mosaic gather wall,
-    # resolve_hop_mode); "pallas-mxu" is the same fused design with the
-    # in-kernel gathers rewritten as the gather-free two-level one-hot
-    # select (ops/mxutake.py) — the S1-S7 resurrection candidate the next
-    # live window probes natively (GRAFT_HOP_MODE sweep knob in bench.py)
+    # otherwise; "auto" ranks through ops/dispatch.py (xla everywhere
+    # under the shipped conservative table: the Mosaic gather wall
+    # quarantines "pallas", and "pallas-mxu" is priced at its streamed
+    # worst case until a live window calibrates). "pallas-mxu" is the
+    # fused design with the in-kernel gathers rewritten as the
+    # gather-free two-level one-hot select (ops/mxutake.py) — the S1-S7
+    # resurrection candidate; any peer count works (out-of-kernel pad
+    # seam), subject to the VMEM block gates (GRAFT_HOP_MODE sweep knob)
     hop_mode: str = "auto"
 
     # sort-mode routing under a sharded step (parallel/halo.py):

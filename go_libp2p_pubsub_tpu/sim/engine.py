@@ -52,16 +52,19 @@ def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
 
 
 def _iwant_answer_extras(state: SimState, cfg: SimConfig) -> list | None:
-    """When the tick's exchanges ride the sort-permute formulation, the
-    IWANT answer-table gather (forward_tick step 1) is data-independent of
-    the heartbeat — it reads only deliver_tick and malicious, which the
-    heartbeat never writes — so it can share the heartbeat's FINAL
-    exchange's variadic sort instead of paying its own serially-dependent
-    comparator pass (~13 serial sorts bound the sort-era tick; VERDICT r4
-    item 1). Returns the [W, N] answer table to ride along, or None when
-    the formulations don't line up (non-sort modes — mxu included: the
-    two-level take gathers its own answer table — or the fused resolve
-    kernel)."""
+    """When the tick's exchanges ride a formulation that can carry extra
+    word lanes, the IWANT answer-table gather (forward_tick step 1) is
+    data-independent of the heartbeat — it reads only deliver_tick and
+    malicious, which the heartbeat never writes — so it can share the
+    heartbeat's FINAL exchange instead of paying its own
+    serially-dependent pass (~13 serial sorts bound the sort-era tick;
+    VERDICT r4 item 1). Two carriers exist: ``sort`` (extra lanes of the
+    variadic sort) and ``mxu`` (extra word rows concatenated onto the
+    bit-table, fetched by the same two-level take — the MXU formulation
+    that closes the mode's last serialized self-gather). Returns the
+    [W, N] answer table to ride along, or None when the formulations
+    don't line up (scalar/rows/pallas exchanges, or the fused resolve
+    kernel, which gathers in VMEM)."""
     from ..ops.bits import pack_words
     from ..ops.hopkernel import resolve_hop_mode
     from ..ops.permgather import resolve_edge_packed_mode
@@ -72,7 +75,8 @@ def _iwant_answer_extras(state: SimState, cfg: SimConfig) -> list | None:
     if resolve_hop_mode(cfg.hop_mode, cfg, w, n, k) in ("pallas",
                                                         "pallas-mxu"):
         return None                  # fused resolve kernel gathers in VMEM
-    if resolve_edge_packed_mode(cfg.edge_gather_mode, n, k, 2 * t) != "sort":
+    if resolve_edge_packed_mode(cfg.edge_gather_mode, n, k, 2 * t,
+                                extra_w=w) not in ("sort", "mxu"):
         return None
     answer_bits = jnp.where(state.malicious[None, :], jnp.uint32(0),
                             pack_words(state.deliver_tick < _NEVER))

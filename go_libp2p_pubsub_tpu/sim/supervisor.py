@@ -232,19 +232,29 @@ def _try_resume(sup: SupervisorConfig, cfg: SimConfig, like: SimState,
     return like, 0
 
 
+# the explicit conservative fallback per mode FAMILY. Every mode name the
+# engine can carry — including the blocked-onehot/mxu-extras formulations
+# and any future/unknown string (which would raise in its resolver and
+# land here as a chunk failure) — maps to the same safe floor, so an
+# unrecognized mode can never dead-end a retry: the ladder's first rung
+# always produces a config that compiles everywhere. NOT "auto": auto
+# resolves right back to the failing mode on its home backend.
+_CONSERVATIVE_MODES = {"hop_mode": "xla", "edge_gather_mode": "scalar",
+                       "selection_mode": "sort"}
+
+
 def _degrade(exec_cfg: SimConfig, chunk_ticks: int, sup: SupervisorConfig,
              report: SupervisorReport) -> tuple:
-    """One rung down the ladder: kernel modes first (pallas-mxu/mxu/sort →
-    the EXPLICIT conservative formulations "xla"/"scalar", bit-identical
-    per the mode-parity suites — not "auto", which resolves right back to
-    the failing mode on its home backend), then chunk shrinking. Sticky
-    for the rest of the run — a chunk that needed the fallback would need
-    it again."""
-    if exec_cfg.hop_mode != "xla" or exec_cfg.edge_gather_mode != "scalar":
-        exec_cfg = dataclasses.replace(exec_cfg, hop_mode="xla",
-                                       edge_gather_mode="scalar")
+    """One rung down the ladder: kernel modes first (pallas-mxu / mxu /
+    sort / unknown → the EXPLICIT conservative formulations
+    ``_CONSERVATIVE_MODES``, bit-identical per the mode-parity suites),
+    then chunk shrinking. Sticky for the rest of the run — a chunk that
+    needed the fallback would need it again."""
+    current = {f: getattr(exec_cfg, f) for f in _CONSERVATIVE_MODES}
+    if current != _CONSERVATIVE_MODES:
+        exec_cfg = dataclasses.replace(exec_cfg, **_CONSERVATIVE_MODES)
         report.degrade_level = max(report.degrade_level, 1)
-        report.log("degrade", hop_mode="xla", edge_gather_mode="scalar")
+        report.log("degrade", **_CONSERVATIVE_MODES)
     elif chunk_ticks > sup.min_chunk_ticks:
         chunk_ticks = max(sup.min_chunk_ticks, chunk_ticks // 2)
         report.degrade_level += 1

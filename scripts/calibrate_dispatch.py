@@ -1,0 +1,308 @@
+"""Calibrate the dispatch table (ops/dispatch.py) on the live accelerator.
+
+Measures every (op, formulation) pair of the gather families — the
+generic [N, K] payload permute, the [W, N] word-table gather, the packed
+edge exchange — plus masked selection, at a sweep of engine shapes, and
+writes a versioned, platform-fingerprinted dispatch table whose
+``measured`` buckets override the analytic ranking. Point
+``GRAFT_DISPATCH_TABLE`` at the output and every ``*_mode="auto"``
+resolves through the measured winners — the one-env-flip promotion
+ROADMAP item 2 describes.
+
+Resumable under the BENCH_JOURNAL discipline: every measurement is
+fsync-appended to a journal line as it lands (op, formulation, shape, ms,
+platform fingerprint), and a re-invocation skips already-journaled
+measurements whose fingerprint matches — one preempted TPU window
+refreshes the table incrementally instead of starting over
+(scripts/tpu_recheck.sh runs this with a per-step journal).
+
+A formulation that FAILS to lower or execute (the Mosaic gather wall
+class) is recorded as failed and quarantined; a formulation ≥
+``--quarantine-factor`` times slower than the best at every measured
+shape of its op is quarantined as a measured loser (deletion deferred
+until a real TPU window confirms — the marker keeps it out of auto while
+explicit requests still work).
+
+Usage:
+    python scripts/calibrate_dispatch.py [--out PATH] [--journal PATH]
+        [--shapes "n,k,m;n,k,m;..."] [--repeats R]
+        [--quarantine-factor F]
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+from types import SimpleNamespace
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _fingerprint() -> dict:
+    from go_libp2p_pubsub_tpu.ops.dispatch import platform_fingerprint
+    return platform_fingerprint()
+
+
+def _time_call(fn, args, repeats: int) -> float:
+    """Median wall time of ``fn(*args)`` (a jitted function with TRACED
+    operand arguments — a zero-arg thunk closing over its operands would
+    let XLA constant-fold the whole computation and time a literal
+    fetch) with value-fetch sync — block_until_ready does not block
+    through the axon tunnel (bench.py)."""
+    np.asarray(jax.tree_util.tree_leaves(fn(*args))[0])   # compile + warm
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        np.asarray(jax.tree_util.tree_leaves(fn(*args))[0])
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples) * 1e3             # ms
+
+
+def _build_shape(n: int, k: int, m: int, seed: int = 7):
+    from go_libp2p_pubsub_tpu.sim import topology
+    topo = topology.sparse(n, k, degree=min(12, k - 1), seed=seed)
+    st = SimpleNamespace(neighbors=jnp.asarray(topo.neighbors),
+                         reverse_slot=jnp.asarray(topo.reverse_slot))
+    rng = np.random.default_rng(seed)
+    w = (m + 31) // 32
+    words = jnp.asarray(rng.integers(0, 2**32, (w, n), dtype=np.uint64),
+                        jnp.uint32)
+    payload = jnp.asarray(rng.integers(0, 2**32, (n, k), dtype=np.uint64),
+                          jnp.uint32)
+    nbr = jnp.clip(st.neighbors, 0, n - 1)
+    rk = jnp.clip(st.reverse_slot, 0, k - 1)
+    return st, words, payload, nbr, rk, w
+
+
+def _measurements(n: int, k: int, m: int, t: int = 2):
+    """Yield (op, formulation, shape_dict, jitted_fn, args) for one shape
+    point. Operands travel as TRACED jit arguments (never closed over —
+    see _time_call), and a formulation the resolver degrades at this
+    shape is not timed under its own label (the measurement would be of
+    the degrade target)."""
+    import dataclasses
+
+    from go_libp2p_pubsub_tpu.ops.heartbeat import edge_gather_packed
+    from go_libp2p_pubsub_tpu.ops.hopkernel import (
+        resolve_emit_mode,
+        resolve_hop_mode,
+    )
+    from go_libp2p_pubsub_tpu.ops.permgather import (
+        edge_sort_key,
+        gather_words,
+        permutation_gather,
+        resolve_edge_packed_mode,
+        resolve_mode,
+        resolve_words_mode,
+    )
+    from go_libp2p_pubsub_tpu.ops.selection import (
+        resolve_selection_mode,
+        select_random,
+    )
+    from go_libp2p_pubsub_tpu.sim import SimConfig, TopicParams, init_state
+    from go_libp2p_pubsub_tpu.sim.engine import step
+
+    st, words, payload, nbr, rk, w = _build_shape(n, k, m)
+    sk_w = edge_sort_key(st.neighbors, st.reverse_slot, k_major=True)
+    sk_e = edge_sort_key(st.neighbors, st.reverse_slot, k_major=False)
+    rng = np.random.default_rng(3)
+    masks = [jnp.asarray(rng.random((n, t, k)) < 0.35) for _ in range(2)]
+
+    for form in ("scalar", "rows", "sort", "mxu", "pallas"):
+        if resolve_words_mode(form, w, n, k, have_sort_key=True) != form:
+            continue
+        fn = jax.jit(lambda x, i, s, f=form: gather_words(x, i, m, f,
+                                                          sort_key=s))
+        yield "words", form, {"w": w, "n": n, "k": k}, fn, \
+            (words, nbr, sk_w)
+    for form in ("scalar", "rows", "sort", "mxu", "pallas"):
+        if resolve_mode(form, jnp.uint32, n, k, have_sort_key=True) != form:
+            continue
+        fn = jax.jit(lambda p, i, r, s, f=form: permutation_gather(
+            p, i, r, f, sort_key=s))
+        yield "edge_permute", form, {"n": n, "k": k}, fn, \
+            (payload, nbr, rk, sk_e)
+    for form in ("scalar", "rows", "sort", "mxu", "pallas"):
+        if resolve_edge_packed_mode(form, n, k, 2 * t) != form:
+            continue
+        fn = jax.jit(lambda m0, m1, nb, rs, f=form: tuple(edge_gather_packed(
+            [m0, m1], SimpleNamespace(neighbors=nb, reverse_slot=rs), f)))
+        yield "edge_packed", form, {"n": n, "k": k, "b": 2 * t}, fn, \
+            (masks[0], masks[1], st.neighbors, st.reverse_slot)
+
+    key = jax.random.PRNGKey(0)
+    mask3 = jnp.asarray(rng.random((n, t, k)) < 0.5)
+    count = jnp.asarray(rng.integers(0, 13, (n, t)), jnp.int32)
+    for form in ("iter", "sort", "ranks"):
+        if resolve_selection_mode(form, k, 12) != form:
+            continue
+        fn = jax.jit(lambda ms, c, ky, f=form: select_random(
+            ms, c, ky, max_count=12, mode=f))
+        yield "selection", form, {"k": k, "max_count": 12}, fn, \
+            (mask3, count, key)
+
+    # hop/emit: no standalone op exists for the XLA formulations (they
+    # are inline in forward_tick), so the comparator is ONE FULL ENGINE
+    # STEP per hop_mode — every formulation sees the identical non-hop
+    # work, so the relative ranking (all dispatch consumes) is exact,
+    # and every eligible formulation lands in the same measured bucket
+    cfg0 = SimConfig(n_peers=n, k_slots=k, n_topics=t, msg_window=m,
+                     publishers_per_tick=4, prop_substeps=4)
+    tp0 = TopicParams.disabled(t)
+    from go_libp2p_pubsub_tpu.sim import topology as _topo
+    st0 = init_state(cfg0, _topo.sparse(n, k, degree=min(12, k - 1),
+                                        seed=7))
+    for form in ("xla", "pallas", "pallas-mxu"):
+        cfgf = dataclasses.replace(cfg0, hop_mode=form)
+        hop_ok = resolve_hop_mode(form, cfgf, w, n, k) == form
+        emit_ok = resolve_emit_mode(form, w, n, k) == form
+        if not (hop_ok or emit_ok):
+            continue
+        fn = jax.jit(lambda s0, tp_, ky, c=cfgf: step(s0, c, tp_, ky))
+        args = (st0, tp0, jax.random.PRNGKey(1))
+        if hop_ok:
+            yield "hop", form, {"w": w, "n": n, "k": k}, fn, args
+        if emit_ok:
+            yield "emit", form, {"w": w, "n": n, "k": k}, fn, args
+
+
+def _journal_load(path: str, fp: dict) -> dict:
+    recs = {}
+    if path and os.path.exists(path):
+        with open(path) as f:
+            for ln in f:
+                try:
+                    r = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue        # torn tail line: its point re-runs
+                if r.get("fingerprint") == fp and "op" in r:
+                    key = (r["op"], r["form"],
+                           tuple(sorted(r["shape"].items())))
+                    recs[key] = r
+    return recs
+
+
+def _journal_append(path: str, rec: dict) -> None:
+    if not path:
+        return
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _merge_table(out_path: str, platform: str, fp: dict,
+                 journal: dict, quarantine_factor: float) -> dict:
+    """Fold the journal's measurements into a dispatch table at
+    ``out_path`` (seeded from the existing file, else the shipped
+    default — other platforms' entries are preserved)."""
+    from go_libp2p_pubsub_tpu.ops.dispatch import (
+        DEFAULT_TABLE_PATH,
+        OPS,
+        load_table,
+    )
+    base_path = out_path if os.path.exists(out_path) else DEFAULT_TABLE_PATH
+    table = json.loads(json.dumps(load_table(base_path)))   # deep copy
+    entry = table["platforms"].setdefault(
+        platform, json.loads(json.dumps(
+            table["platforms"].get("default")
+            or next(iter(table["platforms"].values())))))
+    entry["fingerprint"] = fp
+    # group by (op, shape)
+    buckets: dict = {}
+    failed: dict = {}
+    for (op, form, shape_key), rec in journal.items():
+        if "ms" in rec:
+            buckets.setdefault((op, shape_key), {})[form] = rec["ms"]
+        else:
+            failed.setdefault(op, set()).add(form)
+    entry["measured"] = [
+        {"op": op, "shape": dict(shape_key), "ms": ms}
+        for (op, shape_key), ms in sorted(buckets.items())]
+    quarantined: dict = {op: sorted(forms) for op, forms in failed.items()}
+    if quarantine_factor > 0:
+        for op in OPS:
+            per_form: dict = {}
+            for (bop, _sk), ms in buckets.items():
+                if bop != op or not ms:
+                    continue
+                best = min(ms.values())
+                for form, v in ms.items():
+                    per_form.setdefault(form, []).append(
+                        v >= quarantine_factor * max(best, 1e-6))
+            losers = [f for f, flags in per_form.items()
+                      if flags and all(flags)]
+            for f in losers:
+                cur = set(quarantined.get(op, []))
+                cur.add(f)
+                quarantined[op] = sorted(cur)
+    entry["quarantined"] = quarantined
+    table["generated_by"] = "scripts/calibrate_dispatch.py"
+    tmp = out_path + f".tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(table, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, out_path)
+    return table
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.environ.get(
+        "GRAFT_DISPATCH_TABLE", "dispatch_table_measured.json"))
+    ap.add_argument("--journal", default=os.environ.get("BENCH_JOURNAL", ""))
+    ap.add_argument("--shapes", default="")
+    ap.add_argument("--repeats", type=int, default=3)
+    ap.add_argument("--quarantine-factor", type=float, default=3.0)
+    args = ap.parse_args()
+
+    platform = jax.devices()[0].platform
+    fp = _fingerprint()
+    if not args.shapes:
+        # CPU tier: contract-sized shapes; live accelerator: bench shapes
+        args.shapes = "1024,32,64;4096,32,64" if platform == "cpu" \
+            else "10240,48,64;102400,32,64"
+    journal_path = args.journal or args.out + ".journal.jsonl"
+    done = _journal_load(journal_path, fp)
+    print(json.dumps({"info": "calibrate_dispatch", "platform": platform,
+                      "shapes": args.shapes, "out": args.out,
+                      "journal": journal_path,
+                      "resumed_points": len(done)}), flush=True)
+
+    for spec in args.shapes.split(";"):
+        n, k, m = (int(x) for x in spec.split(","))
+        for op, form, shape, fn, operands in _measurements(n, k, m):
+            key = (op, form, tuple(sorted(shape.items())))
+            if key in done:
+                continue
+            rec = {"op": op, "form": form, "shape": shape,
+                   "platform": platform, "fingerprint": fp}
+            try:
+                rec["ms"] = round(_time_call(fn, operands, args.repeats), 4)
+            except Exception as e:      # lowering/runtime failure: the
+                rec["error"] = str(e)[:300]   # Mosaic-wall class — the
+                                              # form is quarantined
+            print(json.dumps(rec), flush=True)
+            _journal_append(journal_path, rec)
+            done[key] = rec
+
+    table = _merge_table(args.out, platform, fp, done,
+                         args.quarantine_factor)
+    print(json.dumps({"info": "dispatch table written", "path": args.out,
+                      "quarantined":
+                      table["platforms"][platform]["quarantined"],
+                      "measured_buckets":
+                      len(table["platforms"][platform]["measured"])}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

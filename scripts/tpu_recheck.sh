@@ -23,6 +23,8 @@ for step in "supervisor_smoke:python scripts/supervisor_smoke.py" \
             "sel_iter:env GRAFT_SELECTION=iter BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_ranks:env GRAFT_SELECTION=ranks BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "sel_sort:env GRAFT_SELECTION=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
+            "calibrate_dispatch:python scripts/calibrate_dispatch.py --out /tmp/tpu_recheck/dispatch_table.json" \
+            "bench_dispatched:env GRAFT_DISPATCH_TABLE=/tmp/tpu_recheck/dispatch_table.json BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "ablate_100k:python scripts/ablate.py headline_100000 10" \
             "ablate_10k:python scripts/ablate.py 10k_beacon 10" \
             "pallas_smoke:python scripts/tpu_kernel_smoke.py" \

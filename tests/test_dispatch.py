@@ -1,0 +1,205 @@
+"""The measured cost-model dispatch layer (ops/dispatch.py): the shipped
+default table parses, covers every (op, backend) key, dispatch is
+deterministic for a fixed table + shape, quarantine markers bind, and —
+the migration contract — CPU ``auto`` resolutions at the bench shapes
+match the legacy static rules exactly (so the dispatched choice can only
+match or beat the old resolution on the 1k/10k bench configs)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from go_libp2p_pubsub_tpu.ops import dispatch as dp
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache(monkeypatch):
+    monkeypatch.delenv("GRAFT_DISPATCH_TABLE", raising=False)
+    dp.clear_table_cache()
+    yield
+    dp.clear_table_cache()
+
+
+NOMINAL = {
+    "edge_permute": dict(n=10_000, k=32),
+    "words": dict(w=2, n=10_000, k=32),
+    "edge_packed": dict(n=10_000, k=32, b=4),
+    "hop": dict(w=2, n=10_000, k=32),
+    "emit": dict(w=2, n=10_000, k=32),
+    "selection": dict(k=32, max_count=12),
+}
+
+
+class TestShippedTable:
+    def test_parses_and_versioned(self):
+        table = dp.load_table()
+        assert table["version"] >= 1
+        assert {"cpu", "tpu"} <= set(table["platforms"])
+
+    def test_covers_every_op_backend_key(self):
+        """Every (op, backend) pair must yield a non-empty ranking whose
+        members are known formulations — the tier-1 coverage gate the
+        CI satellite asks for."""
+        for backend in ("cpu", "tpu", "default"):
+            for op, forms in dp.OPS.items():
+                ranked = dp.choose(op, backend=backend, **NOMINAL[op])
+                assert ranked, (op, backend)
+                assert set(ranked) <= set(forms), (op, backend, ranked)
+
+    def test_quarantined_excluded_from_auto_ranking(self):
+        table = dp.load_table()
+        for backend, entry in table["platforms"].items():
+            for op, losers in entry.get("quarantined", {}).items():
+                ranked = dp.choose(op, backend=backend, **NOMINAL[op])
+                assert not set(ranked) & set(losers), (backend, op, ranked)
+
+    def test_dispatch_deterministic(self):
+        """Fixed table + shape => identical ranking, across repeated
+        calls AND across a cache flush (a reload must not reorder)."""
+        first = {(op, b): dp.choose(op, backend=b, **NOMINAL[op])
+                 for op in dp.OPS for b in ("cpu", "tpu")}
+        dp.clear_table_cache()
+        again = {(op, b): dp.choose(op, backend=b, **NOMINAL[op])
+                 for op in dp.OPS for b in ("cpu", "tpu")}
+        assert first == again
+
+
+class TestCpuParityWithLegacyStatic:
+    """The dispatched CPU choice must equal the legacy static rule at the
+    bench shapes (1k: N=1024 K=32; 10k beacon: N=10000 K=48 T=9) — the
+    acceptance bar that the dispatched choice matches or beats the old
+    resolution on the 1k and 10k bench configs."""
+
+    def test_gather_families(self):
+        from go_libp2p_pubsub_tpu.ops.permgather import (
+            resolve_edge_packed_mode,
+            resolve_mode,
+            resolve_words_mode,
+        )
+        assert jax.default_backend() == "cpu"
+        for n, k, t in ((1024, 32, 1), (10_000, 48, 9)):
+            w = 2
+            assert resolve_mode("auto", jnp.uint32, n, k,
+                                have_sort_key=True) == "scalar"
+            assert resolve_mode("auto", jnp.uint32, n, k) == "scalar"
+            assert resolve_words_mode("auto", w, n, k,
+                                      have_sort_key=True) == "scalar"
+            assert resolve_edge_packed_mode("auto", n, k, 2 * t) == "scalar"
+
+    def test_hop_emit_and_selection(self):
+        from go_libp2p_pubsub_tpu.ops.hopkernel import (
+            resolve_emit_mode,
+            resolve_hop_mode,
+        )
+        from go_libp2p_pubsub_tpu.ops.selection import resolve_selection_mode
+        from go_libp2p_pubsub_tpu.sim.config import SimConfig
+
+        for n, k in ((1024, 32), (10_000, 48)):
+            cfg = SimConfig(n_peers=n, k_slots=k)
+            assert resolve_hop_mode("auto", cfg, 2, n, k) == "xla"
+            assert resolve_emit_mode("auto", 2, n, k) == "xla"
+        # the legacy CPU rule: iter while 2*max_count <= k, else sort
+        assert resolve_selection_mode("auto", 48, 12) == "iter"
+        assert resolve_selection_mode("auto", 48, 24) == "iter"
+        assert resolve_selection_mode("auto", 48, 25) == "sort"
+        assert resolve_selection_mode("auto", 48, None) == "sort"
+
+
+class TestTpuRankingConservative:
+    """Under the shipped conservative table (streamed one-hot pricing),
+    TPU auto keeps the measured sort-era winners — mxu stays an explicit
+    mode until a calibrated table promotes it."""
+
+    def test_tpu_gather_families(self, monkeypatch):
+        import go_libp2p_pubsub_tpu.ops.permgather as pg
+        monkeypatch.setattr(pg.jax, "default_backend", lambda: "tpu")
+        assert pg.resolve_mode("auto", jnp.uint32, 100_000, 32,
+                               have_sort_key=True) == "sort"
+        assert pg.resolve_mode("auto", jnp.uint32, 100_000, 32) == "scalar"
+        assert pg.resolve_words_mode("auto", 2, 100_000, 32,
+                                     have_sort_key=True) == "sort"
+        assert pg.resolve_words_mode("auto", 2, 100_000, 32) == "rows"
+        assert pg.resolve_edge_packed_mode("auto", 100_000, 32, 2) == "sort"
+
+    def test_tpu_hop_emit_selection(self, monkeypatch):
+        import go_libp2p_pubsub_tpu.ops.hopkernel as hk
+        import go_libp2p_pubsub_tpu.ops.selection as sel
+        from go_libp2p_pubsub_tpu.sim.config import SimConfig
+        monkeypatch.setattr(hk.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(sel.jax, "default_backend", lambda: "tpu")
+        cfg = SimConfig(n_peers=102_400, k_slots=32)
+        assert hk.resolve_hop_mode("auto", cfg, 2, 102_400, 32) == "xla"
+        assert hk.resolve_emit_mode("auto", 2, 102_400, 32) == "xla"
+        # legacy TPU rule was ranks UNCONDITIONALLY — incl. large K and
+        # small max_count, where the analytic iter estimate would
+        # otherwise win (its serial-pass cost is unmeasured on chip, so
+        # the shipped table quarantines iter/sort from TPU auto)
+        for k in (16, 32, 48, 64, 96, 128):
+            for mc in (1, 4, 12, None):
+                assert sel.resolve_selection_mode("auto", k, mc) \
+                    == "ranks", (k, mc)
+
+
+class TestCalibratedTableOverride:
+    """GRAFT_DISPATCH_TABLE promotion path: a measured table that times
+    mxu under sort flips the TPU auto choice — the one-env-flip product
+    of ROADMAP item 2 — and a quarantine marker in the loaded table
+    excludes a formulation from auto without touching explicit modes."""
+
+    def _write(self, tmp_path, measured=(), quarantined=None):
+        table = json.loads(json.dumps(dp.load_table()))     # deep copy
+        entry = table["platforms"]["tpu"]
+        entry["measured"] = list(measured)
+        if quarantined is not None:
+            entry["quarantined"] = quarantined
+        path = tmp_path / "calibrated.json"
+        path.write_text(json.dumps(table))
+        return str(path)
+
+    def test_measured_bucket_promotes_mxu(self, tmp_path, monkeypatch):
+        import go_libp2p_pubsub_tpu.ops.permgather as pg
+        path = self._write(tmp_path, measured=[
+            {"op": "words", "shape": {"w": 2, "n": 102_400, "k": 32},
+             "ms": {"sort": 9.0, "mxu": 0.8, "rows": 24.7}}])
+        monkeypatch.setenv("GRAFT_DISPATCH_TABLE", path)
+        dp.clear_table_cache()
+        monkeypatch.setattr(pg.jax, "default_backend", lambda: "tpu")
+        assert pg.resolve_words_mode("auto", 2, 102_400, 32,
+                                     have_sort_key=True) == "mxu"
+        # a far-off shape does not match the bucket: analytic ranking
+        assert pg.resolve_words_mode("auto", 2, 1024, 32,
+                                     have_sort_key=True) == "sort"
+
+    def test_quarantine_marker_binds(self, tmp_path, monkeypatch):
+        import go_libp2p_pubsub_tpu.ops.permgather as pg
+        table = json.loads(json.dumps(dp.load_table()))
+        table["platforms"]["tpu"]["quarantined"]["edge_packed"] = ["sort"]
+        path = tmp_path / "q.json"
+        path.write_text(json.dumps(table))
+        monkeypatch.setenv("GRAFT_DISPATCH_TABLE", str(path))
+        dp.clear_table_cache()
+        monkeypatch.setattr(pg.jax, "default_backend", lambda: "tpu")
+        # auto avoids the quarantined sort; explicit sort still resolves
+        assert pg.resolve_edge_packed_mode("auto", 100_000, 32, 2) != "sort"
+        assert pg.resolve_edge_packed_mode("sort", 100_000, 32, 2) == "sort"
+
+    def test_malformed_table_raises(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"platforms": {"cpu": {}}}))
+        with pytest.raises(dp.DispatchTableError):
+            dp.load_table(str(bad))
+
+
+class TestResolvedFormulations:
+    def test_bench_record_stamp(self):
+        """resolved_formulations covers every dispatched seam with a
+        concrete (non-auto) formulation — what bench.py stamps into
+        records."""
+        from go_libp2p_pubsub_tpu.sim.config import SimConfig
+        cfg = SimConfig(n_peers=1024, k_slots=32)
+        got = dp.resolved_formulations(cfg)
+        assert set(got) == set(dp.OPS)
+        for op, form in got.items():
+            assert form in dp.OPS[op] and form != "auto", (op, form)

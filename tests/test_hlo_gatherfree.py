@@ -1,0 +1,97 @@
+"""HLO-inspection guard (SNIPPETS [1]/[2] grep-the-IR pattern): with
+``edge_gather_mode="mxu"`` + ``hop_mode="pallas-mxu"`` the lowered engine
+step contains ZERO dense table gathers — the property that makes the mxu
+mode immune to both the Mosaic 128-lane gather wall and the ~7 ns/index
+XLA gather tax. If a scalar/rows formulation sneaks back into any seam
+(a resolver regression, a new call site bypassing dispatch), this fails.
+
+"Dense table gather" = a gather whose RESULT carries more than 4·N·T
+elements: the serialized-HBM class routes N*K edge indices (32·N at the
+headline K), while the benign per-row ops the engine legitimately keeps
+(take_along_axis over the K-minor axis in selection/median, the P=8
+publisher picks) stay at or under N·T. The threshold is checked against
+a positive control — the scalar formulation MUST trip it — so the grep
+can never silently match nothing."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import SimConfig, TopicParams, init_state, topology
+from go_libp2p_pubsub_tpu.sim.engine import step
+
+
+def _dense_gathers(text: str, thresh: int) -> list:
+    """(result_elems, snippet) of every gather op in the StableHLO text
+    whose result exceeds ``thresh`` elements."""
+    out = []
+    for m in re.finditer(
+            r'"?stablehlo\.gather"?.*?-> tensor<([0-9x]+)x?[a-z]', text):
+        dims = [int(d) for d in m.group(1).split("x") if d]
+        elems = int(np.prod(dims)) if dims else 1
+        if elems > thresh:
+            out.append((elems, m.group(0)[:160]))
+    return out
+
+
+def _lowered_step_text(n: int, k: int, **overrides) -> tuple:
+    cfg = SimConfig(n_peers=n, k_slots=k, n_topics=1, msg_window=64,
+                    publishers_per_tick=4, prop_substeps=8,
+                    scoring_enabled=True, **overrides)
+    tp = TopicParams.disabled(1)
+    st = init_state(cfg, topology.sparse(n, k, degree=12, seed=1))
+    low = jax.jit(step, static_argnames=("cfg",)).lower(
+        st, cfg, tp, jax.random.PRNGKey(0))
+    return low.as_text(), cfg
+
+
+def test_mxu_step_has_zero_dense_gathers():
+    """Tier-1 guard at a lane-unfriendly-free shape (2048 = 16·128): the
+    full step under the mxu modes lowers gather-free; the kernels run in
+    interpret mode on CPU, so every in-kernel take appears as its real
+    one-hot matmul formulation in the IR."""
+    n, k = 2048, 32
+    text, cfg = _lowered_step_text(n, k, edge_gather_mode="mxu",
+                                   hop_mode="pallas-mxu")
+    # the modes must actually resolve (not silently degrade to xla/scalar)
+    from go_libp2p_pubsub_tpu.ops.dispatch import resolved_formulations
+    resolved = resolved_formulations(cfg)
+    assert resolved["hop"] == "pallas-mxu" and resolved["emit"] == "pallas-mxu"
+    assert resolved["edge_packed"] == "mxu" and resolved["words"] == "mxu"
+    assert resolved["edge_permute"] == "mxu"
+    bad = _dense_gathers(text, 4 * n * cfg.n_topics)
+    assert not bad, f"dense gathers sneaked back in: {bad[:5]}"
+
+
+def test_scalar_control_trips_the_grep():
+    """Positive control: the scalar word gather at the same shape MUST
+    contain a dense gather, or the grep is matching nothing."""
+    n, k, m = 2048, 32, 64
+    from go_libp2p_pubsub_tpu.ops.permgather import gather_words
+    words = jnp.zeros(((m + 31) // 32, n), jnp.uint32)
+    nbr = jnp.zeros((n, k), jnp.int32)
+    text = jax.jit(
+        lambda x, i: gather_words(x, i, m, "scalar")).lower(
+        words, nbr).as_text()
+    assert _dense_gathers(text, 4 * n), \
+        "control failed: scalar gather not visible to the grep"
+
+
+@pytest.mark.slow
+def test_headline_shape_has_zero_dense_gathers():
+    """The acceptance-criteria shape: 100k-class peers (102400 — the
+    128-friendly headline peer count every bench scenario uses,
+    PERF_MODEL.md) × K=32. Slow tier: host-side topology build + the
+    full-step lowering take minutes on CPU."""
+    n, k = 102_400, 32
+    text, cfg = _lowered_step_text(n, k, edge_gather_mode="mxu",
+                                   hop_mode="pallas-mxu")
+    from go_libp2p_pubsub_tpu.ops.dispatch import resolved_formulations
+    resolved = resolved_formulations(cfg)
+    assert resolved["hop"] == "pallas-mxu" and resolved["emit"] == "pallas-mxu"
+    assert resolved["edge_packed"] == "mxu" and resolved["words"] == "mxu"
+    bad = _dense_gathers(text, 4 * n * cfg.n_topics)
+    assert not bad, f"dense gathers at the headline shape: {bad[:5]}"

@@ -7,8 +7,9 @@ tier must pin: (1) op-level bit-exactness of every word-table call site,
 (2) full engine trajectories bit-identical to the sort mode — including a
 shape whose N*K index count is NOT a multiple of the take's block_g, the
 case the old kernel asserted away (mxutake.py r5) — and (3) the resolve
-policy (word tables ride mxu, the generic payload permute degrades, the
-IWANT answer ride-along steps aside)."""
+policy (word tables ride mxu; the generic payload permute rides the
+blocked one-hot take; the IWANT answer table rides the exchange as
+concatenated word rows — the mxu scalar tail is closed, ISSUE 6)."""
 
 import dataclasses
 
@@ -47,25 +48,32 @@ class TestResolvePolicy:
         # bit-table planes beyond the VMEM budget degrade to rows
         assert resolve_edge_packed_mode("mxu", 4_000_000, 32, 64) == "rows"
 
-    def test_generic_payload_permute_degrades(self):
-        # the [N, K] payload permute would need an N*K-wide one-hot tile —
-        # VMEM-infeasible, so it rides scalar under the mxu config
-        assert resolve_mode("mxu", jnp.uint32, 100_000, 32) == "scalar"
-        assert resolve_mode("mxu", jnp.float32, 256, 16) == "scalar"
+    def test_generic_payload_permute_rides_blocked_onehot(self):
+        # the blocked/tiled one-hot variant (mxutake.take_payload_onehot)
+        # closed the old degrade-to-scalar: any 4-byte payload rides mxu;
+        # sub-word dtypes (no exact 4-u8-chunk recombination) still degrade
+        assert resolve_mode("mxu", jnp.uint32, 100_000, 32) == "mxu"
+        assert resolve_mode("mxu", jnp.float32, 256, 16) == "mxu"
+        assert resolve_mode("mxu", jnp.bool_, 256, 16) == "scalar"
 
-    def test_answer_ride_along_steps_aside(self):
-        """_iwant_answer_extras only merges the IWANT answer gather into
-        the heartbeat's final exchange under the SORT formulation; with
-        mxu carrying the exchange it must return None so forward_tick
-        gathers its own answer table through the take."""
+    def test_answer_ride_along_rides_the_mxu_exchange(self):
+        """_iwant_answer_extras merges the IWANT answer gather into the
+        heartbeat's final exchange under BOTH carrier formulations: sort
+        (extra variadic-sort lanes) and now mxu (extra word rows
+        concatenated onto the bit-table, one shared two-level take —
+        the mode's last serialized self-gather closed). Non-carrier
+        formulations still step aside."""
         from go_libp2p_pubsub_tpu.sim.engine import _iwant_answer_extras
 
         cfg = SimConfig(n_peers=256, k_slots=16, n_topics=1, msg_window=32,
                         edge_gather_mode="mxu")
         st = init_state(cfg, topology.sparse(256, 16, degree=6, seed=1))
-        assert _iwant_answer_extras(st, cfg) is None
+        assert _iwant_answer_extras(st, cfg) is not None
         cfg_s = dataclasses.replace(cfg, edge_gather_mode="sort")
         assert _iwant_answer_extras(st, cfg_s) is not None
+        for plain in ("scalar", "rows"):
+            cfg_p = dataclasses.replace(cfg, edge_gather_mode=plain)
+            assert _iwant_answer_extras(st, cfg_p) is None, plain
 
 
 class TestOpParity:
@@ -103,6 +111,49 @@ class TestOpParity:
             for r, g in zip(ref, got):
                 np.testing.assert_array_equal(
                     np.asarray(r), np.asarray(g), err_msg=f"mxu t={t}")
+
+    def test_payload_permute_mxu_bit_identical(self):
+        """permutation_gather mode='mxu' (the blocked one-hot take) vs
+        the scalar reference, u32 and f32, at a ragged shape."""
+        from go_libp2p_pubsub_tpu.ops.permgather import permutation_gather
+
+        rng = np.random.default_rng(13)
+        n, k = 200, 12
+        jn = jnp.asarray(rng.integers(0, n, (n, k)), jnp.int32)
+        rk = jnp.asarray(rng.integers(0, k, (n, k)), jnp.int32)
+        for pay in (jnp.asarray(rng.integers(0, 2**32, (n, k),
+                                             dtype=np.uint64), jnp.uint32),
+                    jnp.asarray(rng.normal(size=(n, k)), jnp.float32)):
+            ref = permutation_gather(pay, jn, rk, "scalar")
+            got = permutation_gather(pay, jn, rk, "mxu")
+            np.testing.assert_array_equal(np.asarray(ref), np.asarray(got),
+                                          err_msg=str(pay.dtype))
+
+    def test_extras_ride_along_mxu_bit_identical(self):
+        """The mxu extras ride-along (concatenated word rows on the
+        bit-table take) must reproduce the sort formulation's receiver
+        views exactly — mask groups AND extras, invalid slots zeroed."""
+        from types import SimpleNamespace
+
+        from go_libp2p_pubsub_tpu.ops.heartbeat import edge_gather_packed
+
+        rng = np.random.default_rng(17)
+        n, k, t = 192, 8, 3
+        topo = topology.sparse(n, k, degree=5)
+        st = SimpleNamespace(neighbors=jnp.asarray(topo.neighbors),
+                             reverse_slot=jnp.asarray(topo.reverse_slot))
+        masks = [jnp.asarray(rng.random((n, t, k)) < 0.35)
+                 for _ in range(2)]
+        tab = jnp.asarray(rng.integers(0, 2**32, (2, n), dtype=np.uint64),
+                          jnp.uint32)
+        res_s, ex_s = edge_gather_packed(masks, st, "sort",
+                                         extra_words=[tab])
+        res_m, ex_m = edge_gather_packed(masks, st, "mxu",
+                                         extra_words=[tab])
+        for a, b in zip(res_s, res_m):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(ex_s, ex_m):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 class TestEngineTrajectory:

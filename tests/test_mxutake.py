@@ -14,6 +14,9 @@ import pytest
 
 from go_libp2p_pubsub_tpu.ops.mxutake import (
     cost_model,
+    cost_model_payload,
+    pad_lanes,
+    take_payload_onehot,
     take_words_onehot,
     take_words_twolevel,
     take_words_twolevel_ref,
@@ -91,6 +94,101 @@ def test_cost_model_tracks_compiled_bytes():
     ceiling = m["onehot_bytes"] + m["lane_bytes"] \
         + m["table_bytes"] + m["out_bytes"]
     assert 0.25 * floor <= compiled <= 4.0 * ceiling, \
+        (compiled, floor, ceiling)
+
+
+@pytest.mark.parametrize("n,k", [
+    (200, 12),     # N and K both non-multiples of 128 (pad + w-tiling)
+    (1000, 16),    # N non-multiple, larger
+    (384, 32),     # lane-aligned N, full word-tile
+    (129, 7),      # pathological ragged tail on both axes
+])
+def test_payload_take_exact_ragged(n, k):
+    """The blocked/tiled one-hot payload permute (the mxu formulation of
+    the generic [N, K] gather — the last scalar degradation of the mxu
+    mode) must be bit-exact vs the scalar reference at non-multiple-of-
+    128 N and K, for u32 AND bitcast f32 payloads."""
+    rng = np.random.default_rng(n * k)
+    jn = jnp.asarray(rng.integers(0, n, (n, k)), jnp.int32)
+    rk = jnp.asarray(rng.integers(0, k, (n, k)), jnp.int32)
+    pay_u = jnp.asarray(rng.integers(0, 2**32, (n, k), dtype=np.uint64),
+                        jnp.uint32)
+    got = take_payload_onehot(pay_u, jn, rk, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(pay_u[jn, rk]))
+    pay_f = jnp.asarray(rng.normal(size=(n, k)), jnp.float32)
+    got_f = take_payload_onehot(pay_f, jn, rk, interpret=True)
+    np.testing.assert_array_equal(np.asarray(got_f),
+                                  np.asarray(pay_f[jn, rk]))
+
+
+def test_payload_take_dtype_guard():
+    with pytest.raises(ValueError, match="4-byte"):
+        take_payload_onehot(jnp.zeros((64, 8), jnp.uint8),
+                            jnp.zeros((64, 8), jnp.int32),
+                            jnp.zeros((64, 8), jnp.int32))
+
+
+def test_pad_lanes_seam():
+    x = jnp.arange(2 * 200, dtype=jnp.uint32).reshape(2, 200)
+    p = pad_lanes(x)
+    assert p.shape == (2, 256)
+    np.testing.assert_array_equal(np.asarray(p[:, :200]), np.asarray(x))
+    assert not np.asarray(p[:, 200:]).any()
+    assert pad_lanes(p) is p        # aligned tables pass through untouched
+
+
+def test_payload_cost_model_tracks_compiled_bytes():
+    """test_cost_model_tracks_compiled_bytes extended to the blocked
+    one-hot payload permute: the analytic inventory must bracket XLA's
+    own bytes-accessed for the interpret lowering."""
+    n, k = 512, 16
+    pay = jnp.zeros((n, k), jnp.uint32)
+    jn = jnp.zeros((n, k), jnp.int32)
+    rk = jnp.zeros((n, k), jnp.int32)
+    fn = jax.jit(lambda p, a, b: take_payload_onehot(p, a, b,
+                                                     interpret=True))
+    cost = fn.lower(pay, jn, rk).compile().cost_analysis()
+    if isinstance(cost, list):          # older jax returns [dict]
+        cost = cost[0]
+    compiled = float(cost.get("bytes accessed", 0.0))
+    if compiled == 0.0:
+        pytest.skip("backend reports no bytes-accessed estimate")
+    m = cost_model_payload(n, k)
+    floor = m["table_bytes"] + m["out_bytes"]
+    ceiling = m["onehot_bytes"] + m["lane_bytes"] + m["select_bytes"] \
+        + m["table_bytes"] + m["out_bytes"]
+    assert 0.25 * floor <= compiled <= 4.0 * ceiling, \
+        (compiled, floor, ceiling)
+
+
+def test_extras_ride_along_cost_tracks_compiled_bytes():
+    """...and to the mxu formulation of _iwant_answer_extras: the
+    bit-table take with W extra word rows concatenated must stay within
+    the cost model priced at (wb + W) words — the extras ride the SAME
+    one-hot operand instead of paying their own take."""
+    from go_libp2p_pubsub_tpu.ops.permgather import _edge_table_mxu
+
+    n, k, b, we = 512, 8, 2, 2
+    wb = (b * k + 31) // 32
+    table = jnp.zeros((n, wb), jnp.uint32)
+    jn = jnp.zeros((n, k), jnp.int32)
+    rk = jnp.zeros((n, k), jnp.int32)
+    extra = jnp.zeros((we, n), jnp.uint32)
+    fn = jax.jit(lambda t, a, b_, e: _edge_table_mxu(
+        t, a, b_, 2, extra_words=(e,), interpret=True))
+    cost = fn.lower(table, jn, rk, extra).compile().cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0]
+    compiled = float(cost.get("bytes accessed", 0.0))
+    if compiled == 0.0:
+        pytest.skip("backend reports no bytes-accessed estimate")
+    m = cost_model(n, n * k, wb + we)
+    floor = m["table_bytes"] + m["out_bytes"]
+    ceiling = m["onehot_bytes"] + m["lane_bytes"] \
+        + m["table_bytes"] + m["out_bytes"]
+    # the bit-extract/transpose passes outside the take add small-factor
+    # traffic over the take's own inventory
+    assert 0.25 * floor <= compiled <= 8.0 * ceiling, \
         (compiled, floor, ceiling)
 
 

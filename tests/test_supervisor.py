@@ -212,6 +212,22 @@ class TestWatchdogAndLadder:
         assert rep.degrade_level == 1
         _assert_states_equal(ref, out)
 
+    def test_unknown_mode_degrades_instead_of_deadending(self, plain):
+        """ISSUE 6 ladder satellite: a mode name the resolvers do not
+        know (a future formulation, a typo'd env knob) raises at chunk
+        compile — the ladder must map it to the explicit conservative
+        floor (_CONSERVATIVE_MODES) and complete the run, never dead-end
+        the retry loop on an unresolvable config."""
+        cfg, tp, st, key, ref = plain
+        bogus = dataclasses.replace(cfg, hop_mode="blocked-onehot-v2")
+        out, rep = supervised_run(st, bogus, tp, key, N_TICKS, _sup())
+        deg = [e for e in rep.events if e["event"] == "degrade"]
+        assert deg and deg[0].get("hop_mode") == "xla"
+        assert deg[0].get("edge_gather_mode") == "scalar"
+        assert deg[0].get("selection_mode") == "sort"
+        # the degraded trajectory equals the plain run (mode parity)
+        _assert_states_equal(ref, out)
+
     def test_backoff_schedule_is_exponential_and_capped(self, plain):
         cfg, tp, st, key, _ = plain
         delays = []
