@@ -11,8 +11,12 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-NEG_INF = jnp.float32(-1e30)
+# numpy scalar, not jnp: a module-level jax Array closed over by the
+# fleet plane's vmapped traces leaks a stale constant tracer across
+# fleet-group retraces (see sim/state.py NEVER)
+NEG_INF = np.float32(-1e30)
 
 # Test-time guard for the count <= max_count precondition of the iterative
 # formulation (see _select_iter): flip on in tests/debug runs to turn a

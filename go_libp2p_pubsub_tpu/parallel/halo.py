@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .kernel_context import (
     PEER,
@@ -63,7 +64,9 @@ from .kernel_context import (
 # counter also folds into the SimState.fault_flags health word
 # (sim/invariants.py FLAG_HALO_OVERFLOW), so every bench metric line and
 # trace export carries the poison marker alongside the count.
-_BIG = jnp.int32(2_147_483_647)
+# numpy scalar, not jnp (see sim/state.py NEVER: module-level jax
+# Arrays leak stale tracers across fleet-group retraces)
+_BIG = np.int32(2_147_483_647)
 
 
 def _capacity_factor() -> int:

@@ -6,6 +6,10 @@ _ENGINE_EXPORTS = ("delivery_fraction", "delivery_latency_ticks", "mesh_degrees"
                    "choose_publishers")
 _SUPERVISOR_EXPORTS = ("supervised_run", "SupervisorConfig",
                        "SupervisorReport", "SupervisorCrash")
+_FLEET_EXPORTS = ("FleetMember", "FleetResult", "fleet_run",
+                  "supervised_fleet_run", "fleet_run_keys", "stack_states",
+                  "member_state")
+_CONFIG_EXPORTS = ("with_score_weights", "SCORE_WEIGHT_KEYS")
 
 
 def __getattr__(name):
@@ -17,4 +21,10 @@ def __getattr__(name):
     if name in _SUPERVISOR_EXPORTS:
         from . import supervisor
         return getattr(supervisor, name)
+    if name in _FLEET_EXPORTS:
+        from . import fleet
+        return getattr(fleet, name)
+    if name in _CONFIG_EXPORTS:
+        from . import config
+        return getattr(config, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -61,11 +61,27 @@ except Exception:  # pragma: no cover - orbax is baked into the image
     _HAVE_ORBAX = False
 
 
-def config_fingerprint(cfg) -> str:
+def config_fingerprint(cfg, fleet: int | None = None) -> str:
     """Deterministic digest of a SimConfig: the frozen dataclass repr
     enumerates every field in definition order (including the fault plan),
-    so any knob drift changes the digest."""
-    return hashlib.sha256(repr(cfg).encode()).hexdigest()
+    so any knob drift changes the digest. ``fleet`` folds a leading
+    fleet/batch axis (sim/fleet.py stacks B member states) into the
+    digest: a B=4 fleet journal must never resume into a B=8 run — the
+    mismatch is caught HERE by name, not as a shape crash deep in the
+    scan. ``fleet=None`` (an unbatched state) reproduces the historical
+    digest, so existing checkpoints stay valid."""
+    base = repr(cfg)
+    if fleet is not None:
+        base += f"|fleet_axis B={int(fleet)}"
+    return hashlib.sha256(base.encode()).hexdigest()
+
+
+def fleet_axis(state) -> int | None:
+    """Leading fleet/batch axis of a SimState, or None when unbatched.
+    ``state.tick`` is the discriminator: scalar for a single simulation,
+    [B] for a fleet-stacked state (sim/fleet.py)."""
+    tick = state.tick
+    return int(np.shape(tick)[0]) if np.ndim(tick) >= 1 else None
 
 
 def _sidecar(path: str) -> str:
@@ -119,9 +135,15 @@ def save(path: str, state: SimState, cfg=None) -> None:
             os.fsync(fh.fileno())
         _replace_path(tmp, final)
     if cfg is not None:
+        fleet = fleet_axis(state)
         side_tmp = f"{_sidecar(path)}.tmp{os.getpid()}"
         with open(side_tmp, "w") as f:
-            f.write(config_fingerprint(cfg) + "\n")
+            f.write(config_fingerprint(cfg, fleet=fleet) + "\n")
+            if fleet is not None:
+                # the fleet axis travels in clear alongside the digest so
+                # a mismatched resume can be REJECTED BY NAME (restore
+                # below) instead of as an anonymous digest mismatch
+                f.write(f"fleet={fleet}\n")
             f.flush()
             os.fsync(f.fileno())
         _replace_path(side_tmp, _sidecar(path))
@@ -147,9 +169,21 @@ def restore(path: str, like: SimState, cfg=None) -> SimState:
     path = os.path.abspath(path)
     if cfg is not None and os.path.exists(_sidecar(path)):
         with open(_sidecar(path)) as f:
-            stamped = f.read().strip()
-        want = config_fingerprint(cfg)
+            lines = f.read().split()
+        stamped = lines[0] if lines else ""
+        meta = dict(ln.split("=", 1) for ln in lines[1:] if "=" in ln)
+        fleet = fleet_axis(like)
+        want = config_fingerprint(cfg, fleet=fleet)
         if stamped != want:
+            saved_fleet = meta.get("fleet")
+            if saved_fleet != (None if fleet is None else str(fleet)):
+                def _axis(b):
+                    return "an unbatched state" if b is None else f"B={b}"
+                raise ValueError(
+                    f"checkpoint {path!r} fleet-axis mismatch: saved with "
+                    f"{_axis(saved_fleet)} but this run expects "
+                    f"{_axis(fleet)} — a fleet journal can only resume at "
+                    "its own batch size (sim/fleet.py)")
             raise ValueError(
                 f"checkpoint {path!r} was saved under a different config "
                 f"(fingerprint {stamped[:12]}… != {want[:12]}…); restoring "

@@ -291,3 +291,73 @@ class TopicParams(NamedTuple):
         return TopicParams.from_topic_params(
             [TopicScoreParams(skip_atomic_validation=True, time_in_mesh_quantum=1.0)
              for _ in range(n_topics)])
+
+
+# ---------------------------------------------------------------------------
+# P1–P7 score-weight override helper (sweeps, tests, fleets)
+
+# short P-names → the per-topic TopicParams weight rows (score.go P1–P4;
+# P3b is the mesh failure penalty leg of P3)
+_TP_WEIGHT_ALIASES = {
+    "p1": "time_in_mesh_weight",
+    "p2": "first_message_deliveries_weight",
+    "p3": "mesh_message_deliveries_weight",
+    "p3b": "mesh_failure_penalty_weight",
+    "p4": "invalid_message_deliveries_weight",
+}
+# short P-names → the GLOBAL SimConfig weights (score.go P5–P7). These are
+# jit-STATIC floats: varying one forks the compiled program, so a fleet
+# sweep batches P1–P4 variants in one vmapped scan while P5–P7 variants
+# land in separate fleet groups (sim/fleet.py grouping).
+_CFG_WEIGHT_ALIASES = {
+    "p5": "app_specific_weight",
+    "p6": "ip_colocation_factor_weight",
+    "p7": "behaviour_penalty_weight",
+}
+# every key with_score_weights accepts (aliases + full field names) —
+# consulted by scripts/sweep_scores.py to split a variant spec into
+# weight overrides vs. plain config overrides
+SCORE_WEIGHT_KEYS = frozenset(
+    list(_TP_WEIGHT_ALIASES) + list(_TP_WEIGHT_ALIASES.values())
+    + list(_CFG_WEIGHT_ALIASES) + list(_CFG_WEIGHT_ALIASES.values()))
+
+
+def with_score_weights(base: TopicParams, cfg: SimConfig | None = None,
+                       **overrides):
+    """``base`` with P1–P7 score-weight overrides applied — the sweep/test
+    constructor that replaces hand-editing weight arrays.
+
+    Keys are short P-names (``p1``/``p2``/``p3``/``p3b``/``p4`` →
+    TopicParams rows, ``p5``/``p6``/``p7`` → SimConfig globals) or the
+    full field names. Topic-level values may be scalars (broadcast over
+    all T topics) or [T] sequences. Returns the new TopicParams, or
+    ``(TopicParams, SimConfig)`` when ``cfg`` is passed; overriding a
+    P5–P7 weight WITHOUT ``cfg`` raises (those weights live on SimConfig,
+    and silently dropping them would fake a sweep variant)."""
+    tp_kw: dict = {}
+    cfg_kw: dict = {}
+    t = base.topic_weight.shape[0]
+    for key, val in overrides.items():
+        field = _TP_WEIGHT_ALIASES.get(key, key)
+        if field in TopicParams._fields:
+            arr = jnp.broadcast_to(
+                jnp.asarray(val, jnp.float32), (t,))
+            tp_kw[field] = arr
+            continue
+        field = _CFG_WEIGHT_ALIASES.get(key, key)
+        if field in _CFG_WEIGHT_ALIASES.values():
+            if cfg is None:
+                raise ValueError(
+                    f"score weight {key!r} is the jit-static SimConfig "
+                    f"field {field!r}; pass cfg= to override it "
+                    "(with_score_weights(tp, cfg=cfg, ...))")
+            cfg_kw[field] = float(val)
+            continue
+        raise ValueError(
+            f"unknown score weight {key!r}; expected one of "
+            f"{sorted(SCORE_WEIGHT_KEYS)}")
+    out_tp = base._replace(**tp_kw) if tp_kw else base
+    if cfg is None:
+        return out_tp
+    out_cfg = dataclasses.replace(cfg, **cfg_kw) if cfg_kw else cfg
+    return out_tp, out_cfg

@@ -1,0 +1,490 @@
+"""Fleet plane: B independent simulations as one vmap-batched scan.
+
+"Millions of users" for a simulator means thousands of concurrent
+experiments, not one big run (ROADMAP item 3). The engine's step is a pure
+scanned-JAX function of ``(state, cfg, tp, key)``, so B members that share
+one jit-static ``SimConfig`` — varying seeds, score weights
+(``TopicParams`` rows are traced arrays), and initial states — run as ONE
+``vmap``-batched scan: one dispatch, one compiled program, B lanes of MXU
+work, instead of B sequential dispatches that each leave a tiny-N config
+nowhere near filling the chip (the 1k config runs ~52–85 hb/s on CPU;
+bench.py's ``fleet_256x1k`` line measures the aggregate multiplier).
+
+Semantics, in order of importance:
+
+- **bit-exact per member**: ``vmap`` is semantics-preserving, and each
+  member's key discipline is exactly ``engine.run``'s (the member key is
+  pre-split into per-tick keys once; every window scans a contiguous
+  slice), so member i's trajectory equals ``engine.run(state_i, cfg_i,
+  tp_i, key_i, n_ticks_i)`` bit for bit (tests/test_fleet.py, the core
+  claim — plain, under faults, and across kill/resume).
+- **config grouping**: members are grouped by their (normalized) jit-static
+  ``SimConfig``; each group is one batched scan. Members whose configs
+  differ — a FaultPlan on one member, a P5–P7 weight variant (static
+  floats) — land in separate groups and still run, so a sweep mixes
+  batched and singleton members freely. Grouping never reorders results:
+  they return in input order.
+- **per-member fault isolation**: ``SimState.fault_flags`` is per-lane, so
+  one member's injected faults or invariant violations never taint a
+  sibling's flags. ``invariant_mode="raise"`` members execute in
+  ``"record"`` (identical state math — ``record_flags`` writes the same
+  flags either way and the checkify check writes nothing) and are
+  RETIRED at the first chunk boundary where a violation bit shows: the
+  member's state freezes (``FleetResult.tripped``), its siblings keep
+  running — one poisoned lane must not kill or mask B-1 healthy ones.
+- **early-exit compaction**: members finish at their own ``n_ticks`` (or
+  retire on a trip); finished lanes are compacted OUT of the batch at
+  window boundaries, so a long-tail member doesn't hold B-1 idle lanes of
+  compute. Windows end exactly at member-finish ticks (the chunk length is
+  ``min(chunk, min remaining among active)``), so compaction never splits
+  a member's key stream mid-window.
+- **supervision**: :func:`supervised_fleet_run` composes with the
+  supervised execution plane (sim/supervisor.py): per-window wall-clock
+  watchdog, retry/backoff down the same degraded-mode ladder, crash-atomic
+  fleet checkpoints at chunk boundaries whose fingerprint sidecar BINDS
+  the fleet axis (checkpoint.config_fingerprint(fleet=B) — a B=4 journal
+  can never resume into B=8), resume that verifies every member's tick
+  against the schedule, and fleet crash dumps with per-member flags.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import checkpoint
+from .config import SimConfig, TopicParams
+from .state import SimState
+from .supervisor import (SupervisorConfig, SupervisorCrash, SupervisorReport,
+                         _degrade, _key_data, _prune_checkpoints,
+                         _with_deadline, list_checkpoints)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetMember:
+    """One lane of a fleet: a full (cfg, tp, state, key, n_ticks) run
+    spec, exactly what ``engine.run`` takes. ``name`` labels the member in
+    reports, sweep rows, and crash dumps."""
+
+    cfg: SimConfig
+    tp: TopicParams
+    state: SimState
+    key: jax.Array
+    n_ticks: int
+    name: str = ""
+
+
+@dataclasses.dataclass
+class FleetResult:
+    """Per-member outcome. ``state`` is the member's final SimState
+    (bit-identical to its sequential run); ``tripped`` marks a member
+    whose ``invariant_mode="raise"`` sentinel fired — its state is frozen
+    at the end of the window where the trip was detected."""
+
+    name: str
+    state: SimState
+    ticks_run: int
+    fault_flags: int
+    flag_names: list
+    tripped: bool
+
+
+# ---------------------------------------------------------------------------
+# the batched core
+
+
+def _fleet_run_keys_impl(states: SimState, cfg: SimConfig, tps: TopicParams,
+                         keys: jax.Array) -> SimState:
+    """Advance B stacked members one tick per row of ``keys`` ([C, B]
+    per-tick-major, so the scan consumes one tick across all lanes per
+    iteration). The vmapped step is the UNCHANGED ``engine.step`` — the
+    fleet adds a batch axis, not semantics."""
+    from .engine import step
+
+    vstep = jax.vmap(lambda s, t, k: step(s, cfg, t, k))
+
+    def body(carry, keys_t):
+        return vstep(carry, tps, keys_t), None
+
+    out, _ = jax.lax.scan(body, states, keys)
+    return out
+
+
+fleet_run_keys = jax.jit(_fleet_run_keys_impl, static_argnames=("cfg",))
+# the bench path: donating the batched state halves peak fleet memory
+fleet_run_keys_donated = jax.jit(_fleet_run_keys_impl,
+                                 static_argnames=("cfg",),
+                                 donate_argnums=(0,))
+
+
+def stack_states(items: list) -> SimState | TopicParams:
+    """Stack member pytrees (SimState or TopicParams) along a new leading
+    fleet axis."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), items[0], *items[1:])
+
+
+def fleet_devices(b: int, devices: list | None = None) -> int:
+    """How many local devices a B-lane fleet can shard across: the largest
+    device count that divides B (1 when it can't split evenly)."""
+    d = len(devices) if devices is not None else jax.local_device_count()
+    return max(k for k in range(1, d + 1) if b % k == 0)
+
+
+def shard_fleet(states: SimState, tps: TopicParams, keys=None,
+                devices: list | None = None):
+    """Place a stacked fleet with the FLEET axis sharded across local
+    devices. Members are independent, so the batched scan is
+    embarrassingly SPMD over this axis — GSPMD partitions every op with
+    ZERO collectives, and B lanes on D devices run D-wide in parallel.
+    This is the fleet's scaling story beyond one chip: vmap fills a
+    single accelerator's lanes, the fleet-axis sharding fills the other
+    D-1 devices (and on CPU, a forced multi-device host mesh turns lanes
+    into cores — bench.py's fleet line does this automatically).
+
+    Returns ``(states, tps)`` or ``(states, tps, keys)`` when per-tick
+    keys are passed — one [C, B, ...] window (fleet axis SECOND) or a
+    list of them. A B not divisible by the device count shards across
+    the largest dividing subset (:func:`fleet_devices`); D=1 is a no-op
+    placement."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    devs = list(devices) if devices is not None else jax.devices()
+    b = int(np.shape(states.tick)[0])
+    d = fleet_devices(b, devs)
+    mesh = Mesh(np.array(devs[:d]), ("fleet",))
+
+    def put(tree, spec):
+        sharding = NamedSharding(mesh, spec)
+        return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+    states = put(states, PartitionSpec("fleet"))
+    tps = put(tps, PartitionSpec("fleet"))
+    if keys is None:
+        return states, tps
+    kspec = PartitionSpec(None, "fleet")
+    if isinstance(keys, (list, tuple)):
+        return states, tps, [put(k, kspec) for k in keys]
+    return states, tps, put(keys, kspec)
+
+
+def member_state(batched, i: int):
+    """Member ``i``'s unbatched pytree out of a fleet-stacked one."""
+    return jax.tree.map(lambda x: x[i], batched)
+
+
+def _take_rows(tree, idx):
+    return jax.tree.map(lambda x: x[idx], tree)
+
+
+def _put_rows(full, idx, rows):
+    return jax.tree.map(lambda f, r: f.at[idx].set(r), full, rows)
+
+
+def _exec_cfg(cfg: SimConfig) -> SimConfig:
+    """The config a member EXECUTES under. ``"raise"`` checkifies the
+    whole batch — one member's trip would throw away B-1 healthy lanes —
+    so raise-mode members run ``"record"`` (bit-identical state: the
+    flags land in ``fault_flags`` either way, the check writes nothing)
+    and the driver retires them at the boundary where a violation bit
+    appears."""
+    if cfg.invariant_mode == "raise":
+        return dataclasses.replace(cfg, invariant_mode="record")
+    return cfg
+
+
+# window shapes already compiled, keyed by (cfg, C, B, key dtype): a
+# first-use (compiling) window runs under the COMPILE deadline, repeats
+# under the run watchdog. NOT the supervisor's .lower().compile() AOT
+# cache: on this jax, a second fresh trace of the batched scan hoists the
+# module-level scalar constants (state.NEVER, selection.NEG_INF) into
+# executable parameters that Compiled.__call__ then fails to thread
+# ("compiled for 61 inputs but called with 59") — the plain jit call
+# manages its consts consistently, at the cost of one cache lookup per
+# window
+_FLEET_COMPILED: set = set()
+
+
+def _run_window(states, exec_cfg, tps, keys_win, sup, hook, info):
+    """One window attempt under the supervisor's deadlines."""
+    cache_key = (exec_cfg, int(keys_win.shape[0]), int(keys_win.shape[1]),
+                 str(keys_win.dtype))
+    first_use = cache_key not in _FLEET_COMPILED
+
+    def worker():
+        if hook is not None:            # test/smoke fault-injection point
+            hook(info)
+        out = fleet_run_keys(states, exec_cfg, tps, keys_win)
+        np.asarray(out.tick)            # real sync by value fetch
+        return out
+
+    # a first-use window compiles AND runs: bound it by the compile
+    # deadline (unbounded by default — compile time is not execution
+    # time, sim/supervisor.py rationale), steady-state windows by the
+    # run watchdog
+    deadline = sup.compile_deadline_s if first_use else sup.deadline_s
+    out = _with_deadline(worker, deadline,
+                         "fleet compile+window" if first_use
+                         else "fleet window", info)
+    _FLEET_COMPILED.add(cache_key)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# supervision plumbing (fleet flavor of the sim/supervisor.py pieces)
+
+
+def _ckpt_path(ckpt_dir: str, done: int) -> str:
+    # "tick" in the checkpoint name is the GROUP's window progress, not a
+    # member's absolute tick (members may start at different ticks and
+    # finish at different n_ticks)
+    return os.path.join(ckpt_dir, f"ckpt_t{done:09d}")
+
+
+def _expected_ticks(starts, n_ticks, done: int) -> np.ndarray:
+    return starts + np.minimum(done, np.asarray(n_ticks, np.int64))
+
+
+def _try_resume_fleet(sup, ckpt_dir, group_cfg, full, starts, n_ticks,
+                      escalate, report, gi):
+    """Newest fleet checkpoint that restores cleanly AND whose per-member
+    ticks match the deterministic window schedule at its recorded
+    progress; tripped members (violation bits set on a raise-mode lane)
+    are exempt from the progress check — they froze early by design."""
+    from .invariants import VIOLATION_MASK
+
+    for path, done in reversed(list_checkpoints(ckpt_dir)):
+        try:
+            st = checkpoint.restore(path, full, cfg=group_cfg)
+        except ValueError as e:         # corrupt, mismatched, wrong fleet
+            report.log("resume_skip", group=gi, path=path,
+                       error=str(e)[:200])
+            continue
+        ticks = np.asarray(st.tick)
+        flags = np.asarray(st.fault_flags)
+        tripped = [bool(esc and (int(f) & VIOLATION_MASK))
+                   for esc, f in zip(escalate, flags)]
+        want = _expected_ticks(starts, n_ticks, done)
+        ok = all(t == w or tr
+                 for t, w, tr in zip(ticks, want, tripped))
+        if not ok:
+            report.log("resume_skip", group=gi, path=path,
+                       error=f"member ticks {ticks.tolist()} do not match "
+                             f"schedule at done={done}")
+            continue
+        report.resumed_from = path
+        report.resumed_tick = done
+        report.log("resume", group=gi, path=path, done=done)
+        return st, done, tripped
+    return full, 0, [False] * len(n_ticks)
+
+
+def _write_fleet_crash_dump(sup, group_cfg, full, keys_win, gi, active,
+                            names, done, this_win, err, report) -> str:
+    from .invariants import decode_flags
+
+    base = sup.crash_dir or os.environ.get("GRAFT_CRASH_DIR") \
+        or os.path.join(os.getcwd(), "graft_crash")
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    dump = os.path.join(base, f"crash_fleet_{stamp}_p{os.getpid()}")
+    os.makedirs(dump, exist_ok=True)
+    checkpoint.save(os.path.join(dump, "last_good"), full, cfg=group_cfg)
+    flags = [int(f) for f in np.asarray(full.fault_flags)]
+    meta = {
+        "error": str(err)[:2000],
+        "error_type": type(err).__name__,
+        "fleet_group": gi,
+        "fleet_size": len(names),
+        "member_names": names,
+        "active_members": active,
+        "window_start": done,
+        "window_end": done + this_win,
+        "config_fingerprint": checkpoint.config_fingerprint(
+            group_cfg, fleet=len(names)),
+        "fault_flags": flags,
+        "fault_flag_names": [decode_flags(f) for f in flags],
+        # [C, B_active] per-tick keys of the failing window, replay-ready
+        "window_key_data": _key_data(keys_win).tolist(),
+        "degrade_level": report.degrade_level,
+        "retries": report.retries,
+    }
+    tmp = os.path.join(dump, f"crash.json.tmp{os.getpid()}")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(dump, "crash.json"))
+    report.log("crash_dump", group=gi, path=dump)
+    return dump
+
+
+# ---------------------------------------------------------------------------
+# the driver
+
+
+def _drive_group(gi, idxs, members, sup, report, dumps, hook) -> dict:
+    """Run one config group to completion; {input_index: FleetResult}."""
+    from .invariants import VIOLATION_MASK, decode_flags
+
+    group_cfg = _exec_cfg(members[idxs[0]].cfg)
+    escalate = [members[i].cfg.invariant_mode == "raise" for i in idxs]
+    names = [members[i].name or f"member{i}" for i in idxs]
+    n_ticks = [int(members[i].n_ticks) for i in idxs]
+    b = len(idxs)
+    full = stack_states([members[i].state for i in idxs])
+    tps = stack_states([members[i].tp for i in idxs])
+    # each member's per-tick keys, pre-split ONCE with engine.run's exact
+    # discipline — windows slice this array, never re-split
+    all_keys = [jax.random.split(members[i].key, members[i].n_ticks)
+                if members[i].n_ticks > 0 else None for i in idxs]
+    starts = np.asarray(full.tick, np.int64).copy()
+
+    done = 0
+    tripped = [False] * b
+    ckpt_dir = None
+    if sup.checkpoint_dir:
+        ckpt_dir = os.path.join(sup.checkpoint_dir, f"fleet_g{gi:02d}")
+        full, done, tripped = _try_resume_fleet(
+            sup, ckpt_dir, group_cfg, full, starts, n_ticks, escalate,
+            report, gi)
+
+    exec_cfg = group_cfg
+    chunk_ticks = max(1, int(sup.chunk_ticks))
+    every = sup.checkpoint_every_ticks or chunk_ticks
+    next_ckpt = done + every
+    failures = 0
+    prev_active = b
+    while True:
+        active = [j for j in range(b)
+                  if not tripped[j] and done < n_ticks[j]]
+        if not active:
+            break
+        if len(active) < prev_active:
+            report.log("compact", group=gi, active=len(active),
+                       retired=[names[j] for j in range(b)
+                                if j not in active])
+        prev_active = len(active)
+        this_win = min(chunk_ticks, min(n_ticks[j] - done for j in active))
+        whole = len(active) == b
+        idx = None if whole else jnp.asarray(active, jnp.int32)
+        sub = full if whole else _take_rows(full, idx)
+        sub_tps = tps if whole else _take_rows(tps, idx)
+        keys_win = jnp.stack([all_keys[j][done:done + this_win]
+                              for j in active], axis=1)
+        info = {"group": gi, "window_start": done, "window_ticks": this_win,
+                "b_active": len(active), "attempt": failures,
+                "degrade_level": report.degrade_level}
+        try:
+            out = _run_window(sub, exec_cfg, sub_tps, keys_win, sup, hook,
+                              info)
+        except Exception as e:
+            if not dumps:
+                raise       # plain fleet_run: no retry net, no dumps
+            failures += 1
+            if failures > sup.max_retries:
+                dump = _write_fleet_crash_dump(
+                    sup, group_cfg, full, keys_win, gi, active, names,
+                    done, this_win, e, report)
+                report.crash_dump = dump
+                raise SupervisorCrash(
+                    f"fleet group {gi} gave up at window start {done} "
+                    f"({failures} consecutive failure(s)); crash dump: "
+                    f"{dump}", dump_dir=dump, report=report) from e
+            report.retries += 1
+            report.log("chunk_failed", error=str(e)[:200], **info)
+            exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup,
+                                             report)
+            delay = min(sup.backoff_cap_s, sup.backoff_base_s
+                        * sup.backoff_factor ** (failures - 1))
+            report.log("backoff", delay_s=round(delay, 3))
+            sup.sleep(delay)
+            continue
+        failures = 0
+        full = out if whole else _put_rows(full, idx, out)
+        done += this_win
+        report.chunks_run += 1
+        report.ticks_run += this_win * len(active)      # member-ticks
+        report.log("chunk_ok", **info)
+        # per-member sentinel surfacing: a raise-mode lane whose violation
+        # bits lit retires HERE, its siblings keep running
+        if any(escalate):
+            flags = np.asarray(out.fault_flags)
+            for pos, j in enumerate(active):
+                if escalate[j] and not tripped[j] \
+                        and int(flags[pos]) & VIOLATION_MASK:
+                    tripped[j] = True
+                    report.log("member_tripped", group=gi, member=names[j],
+                               done=done,
+                               flags=decode_flags(int(flags[pos])))
+        if ckpt_dir and (done >= next_ckpt
+                         or not any(not tripped[j] and done < n_ticks[j]
+                                    for j in range(b))):
+            os.makedirs(ckpt_dir, exist_ok=True)
+            path = _ckpt_path(ckpt_dir, done)
+            checkpoint.save(path, full, cfg=group_cfg)  # fleet-axis bound
+            report.checkpoints.append(path)
+            report.log("checkpoint", group=gi, done=done, path=path)
+            _prune_checkpoints(ckpt_dir, sup.keep_checkpoints)
+            next_ckpt = done + every
+
+    flags = np.asarray(full.fault_flags)
+    ticks = np.asarray(full.tick, np.int64)
+    out: dict = {}
+    for j, i in enumerate(idxs):
+        fj = int(flags[j])
+        out[i] = FleetResult(
+            name=names[j], state=member_state(full, j),
+            ticks_run=int(ticks[j] - starts[j]), fault_flags=fj,
+            flag_names=decode_flags(fj), tripped=tripped[j])
+    return out
+
+
+def _drive(members, sup, dumps, hook):
+    if not members:
+        return [], SupervisorReport()
+    for m in members:
+        if m.n_ticks < 0:
+            raise ValueError(f"member {m.name!r}: n_ticks must be >= 0")
+    report = SupervisorReport()
+    # group by the normalized jit-static config, preserving first-seen
+    # order; every group is one batched scan
+    groups: dict = {}
+    for i, m in enumerate(members):
+        groups.setdefault(_exec_cfg(m.cfg), []).append(i)
+    report.log("fleet_plan", members=len(members), groups=len(groups),
+               sizes=[len(v) for v in groups.values()])
+    results: dict = {}
+    for gi, idxs in enumerate(groups.values()):
+        results.update(_drive_group(gi, idxs, members, sup, report, dumps,
+                                    hook))
+    return [results[i] for i in range(len(members))], report
+
+
+def fleet_run(members: list, chunk_ticks: int | None = None) -> list:
+    """Run a fleet unsupervised: no watchdog, no retries, no checkpoints —
+    failures propagate. ``chunk_ticks`` bounds the window length (windows
+    also end at member finishes for compaction); None scans each group's
+    longest common stretch in one dispatch. Returns ``[FleetResult]`` in
+    input order; bit-exact per member vs sequential ``engine.run``."""
+    sup = SupervisorConfig(chunk_ticks=chunk_ticks or (1 << 30),
+                           max_retries=0, backoff_base_s=0.0,
+                           sleep=lambda s: None)
+    results, _ = _drive(members, sup, dumps=False, hook=None)
+    return results
+
+
+def supervised_fleet_run(members: list, sup: SupervisorConfig | None = None,
+                         *, _chunk_hook=None) -> tuple:
+    """Run a fleet under the supervised execution plane (module
+    docstring): chunked windows with watchdog + retry/degrade ladder,
+    crash-atomic fleet-axis-bound checkpoints in
+    ``sup.checkpoint_dir/fleet_gNN/``, resume, and fleet crash dumps.
+    Returns ``([FleetResult], SupervisorReport)``."""
+    sup = sup or SupervisorConfig.from_env()
+    return _drive(members, sup, dumps=True, hook=_chunk_hook)

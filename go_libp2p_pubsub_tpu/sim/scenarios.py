@@ -254,6 +254,35 @@ def outage_10k(n_peers: int = 10_000, k_slots: int = 32, degree: int = 12,
     return cfg, default_topic_params(1), init_state(cfg, topo)
 
 
+# --- small-N attack family (scripts/sweep_scores.py grid cells) ----------
+# The same adversarial shapes as their big siblings, sized so a
+# weight-variant × seed fleet of them batches into one vmapped scan on any
+# backend (sim/fleet.py): the peer-score sweep's unit of work.
+
+
+def sybil_small(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
+                **kw) -> tuple[SimConfig, TopicParams, SimState]:
+    """sybil_100k's 20%-sybil colocation attack at sweep scale."""
+    return sybil_100k(n_peers=n_peers, k_slots=k_slots, degree=degree, **kw)
+
+
+def partition_small(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
+                    start: int = 8, heal: int = 20, **kw
+                    ) -> tuple[SimConfig, TopicParams, SimState]:
+    """partition_50k's 2-way cut-and-heal at sweep scale (earlier window
+    so a ~40-tick sweep run has a settled post-heal recovery period)."""
+    return partition_50k(n_peers=n_peers, k_slots=k_slots, degree=degree,
+                         start=start, heal=heal, **kw)
+
+
+def outage_small(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
+                 start: int = 8, heal: int = 20, **kw
+                 ) -> tuple[SimConfig, TopicParams, SimState]:
+    """outage_10k's 20%-dark regional outage at sweep scale."""
+    return outage_10k(n_peers=n_peers, k_slots=k_slots, degree=degree,
+                      start=start, heal=heal, **kw)
+
+
 SCENARIOS = {
     "1k_single_topic": single_topic_1k,
     "10k_beacon": beacon_10k,
@@ -261,4 +290,7 @@ SCENARIOS = {
     "100k_sybil": sybil_100k,
     "50k_partition": partition_50k,
     "10k_outage": outage_10k,
+    "sybil_small": sybil_small,
+    "partition_small": partition_small,
+    "outage_small": outage_small,
 }

@@ -25,8 +25,16 @@ import numpy as np
 from .config import SimConfig
 from .topology import Topology
 
-# sentinel for "never" ticks
-NEVER = jnp.int32(2**30)
+# sentinel for "never" ticks. A NUMPY scalar, deliberately not
+# jnp.int32(...): a module-level concrete jax Array closed over by traced
+# code is lifted by pjit as a constant ARGUMENT under the fleet plane's
+# vmapped scan (sim/fleet.py), and its per-trace tracer is cached by
+# object identity — a second trace (another fleet group's config) then
+# sees the FIRST trace's stale tracer and dies with UnexpectedTracerError
+# / "compiled for 61 inputs but called with 59". A numpy scalar has the
+# same dtype/semantics everywhere this is used and lowers as an inline
+# literal with no cross-trace identity.
+NEVER = np.int32(2**30)
 
 
 class SimState(NamedTuple):

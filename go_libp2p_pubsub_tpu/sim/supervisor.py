@@ -45,6 +45,12 @@ promise timeouts, gossipsub v1.1 hardening).
 
 Env knobs (``SupervisorConfig.from_env``): ``GRAFT_CHUNK_TICKS``,
 ``GRAFT_DEADLINE_S``, ``GRAFT_CRASH_DIR``, ``GRAFT_CHECKPOINT_DIR``.
+
+The fleet plane (sim/fleet.py) builds its batched-run supervision on the
+SAME primitives — ``SupervisorConfig``/``SupervisorReport``, the
+``_with_deadline`` watchdog, the ``_degrade`` ladder, and the
+checkpoint listing/pruning helpers — so a fleet window and a single-run
+chunk share one retry/degrade/checkpoint discipline.
 """
 
 from __future__ import annotations
@@ -345,14 +351,18 @@ def _with_deadline(fn, deadline_s, what: str, info: dict):
         except BaseException as e:      # rethrown on the caller thread
             box.append((False, e))
 
+    # two callers share this watchdog with different info schemas: the
+    # single-run supervisor (chunk_start/chunk_ticks) and the fleet plane
+    # (window_start/window_ticks, sim/fleet.py) — resolve either
+    start = info.get("chunk_start", info.get("window_start", "?"))
+    ticks = info.get("chunk_ticks", info.get("window_ticks", "?"))
     t = threading.Thread(target=runner, daemon=True,
-                         name=f"graft-chunk-t{info['chunk_start']}")
+                         name=f"graft-chunk-t{start}")
     t.start()
     t.join(deadline_s)
     if t.is_alive():
         raise ChunkDeadline(
-            f"{what} at tick {info['chunk_start']} "
-            f"({info['chunk_ticks']} ticks) overran the "
+            f"{what} at tick {start} ({ticks} ticks) overran the "
             f"{deadline_s}s deadline")
     ok, val = box[0]
     if not ok:

@@ -1,0 +1,353 @@
+#!/usr/bin/env python
+"""Peer-score / gater parameter sweep: delivery vs attack resistance.
+
+The evaluation the gossipsub v1.1 hardening literature actually runs
+(PAPER.md L4: peer scoring P1-P7, gater, PX) as one fleet product
+(sim/fleet.py): a grid of score-weight VARIANTS x small-N ATTACK
+scenarios (sybil_small / partition_small / outage_small, sim/scenarios.py)
+x seeds, where every cell is a fleet member and the whole missing grid
+runs as a handful of vmap-batched scans — P1-P4 variants share a
+jit-static config and batch into ONE scan per scenario; P5-P7/gater
+variants (static SimConfig floats) land in their own fleet groups
+automatically.
+
+Each (scenario, variant) cell reports:
+
+- ``delivery``: settled delivery fraction over the whole run (attack
+  window included — the damage the attack did),
+- ``resistance``: the scenario's attack-resistance metric — for sybil,
+  1 - (share of honest peers' mesh slots held by sybils) (scoring must
+  evict attackers from meshes); for partition/outage, the settled
+  delivery of messages published AFTER the heal tick (the network must
+  actually recover),
+- the per-member ``fault_flags`` union (a poisoned cell self-identifies).
+
+The sweep is JOURNAL-RESUMABLE under the BENCH_JOURNAL discipline
+(supervisor plane, ISSUE 5): the grid runs one fleet per scenario, each
+completed scenario's cells are fsync-appended to ``--journal`` with their
+env + variant-spec fingerprint, and a re-invocation replays recorded
+cells instead of re-running them — a killed TPU-window sweep completes
+incrementally at scenario granularity (set GRAFT_CHECKPOINT_DIR to also
+checkpoint/resume WITHIN the in-flight scenario's fleet). ``--write-perf-model`` re-renders the
+frontier table between the sweep_scores markers in PERF_MODEL.md.
+
+Env fallbacks: SWEEP_N, SWEEP_TICKS, SWEEP_SEEDS, SWEEP_SCENARIOS,
+SWEEP_VARIANTS, SWEEP_JOURNAL. Tiny-grid smoke: tests/test_sweep_scores.py
+(tier-1).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# variant spec: keys in sim.config.SCORE_WEIGHT_KEYS ride
+# with_score_weights (p1..p4 = traced TopicParams rows -> batch together;
+# p5..p7 = jit-static SimConfig floats -> own fleet group); everything
+# else is a plain SimConfig override (gater knobs)
+VARIANTS = {
+    "baseline": {},
+    "p1_off": {"p1": 0.0},
+    "p2_heavy": {"p2": 4.0},
+    "p3_off": {"p3": 0.0, "p3b": 0.0},
+    "p4_harsh": {"p4": -40.0},
+    "p6_harsh": {"p6": -200.0},
+    "p7_harsh": {"p7": -40.0},
+    "gater_on": {"gater_enabled": True, "validation_queue_cap": 64},
+}
+
+SCENARIO_NAMES = ("sybil_small", "partition_small", "outage_small")
+SEED_KEY_BASE = 271828
+
+PERF_BEGIN = "<!-- sweep_scores:frontier:begin -->"
+PERF_END = "<!-- sweep_scores:frontier:end -->"
+
+
+def apply_variant(cfg, tp, spec: dict):
+    """Split a variant spec into score-weight overrides (P1-P7 via
+    with_score_weights) and plain SimConfig overrides; apply both."""
+    from go_libp2p_pubsub_tpu.sim.config import (SCORE_WEIGHT_KEYS,
+                                                 with_score_weights)
+    weights = {k: v for k, v in spec.items() if k in SCORE_WEIGHT_KEYS}
+    extra = {k: v for k, v in spec.items() if k not in SCORE_WEIGHT_KEYS}
+    if weights:
+        tp, cfg = with_score_weights(tp, cfg=cfg, **weights)
+    if extra:
+        cfg = dataclasses.replace(cfg, **extra)
+    return cfg, tp
+
+
+def _sybil_mesh_share(state) -> float:
+    """Share of honest peers' mesh slots held by malicious neighbors —
+    the eviction metric: scoring that works drives this to ~0."""
+    import jax.numpy as jnp
+    n = state.neighbors.shape[0]
+    nbr_mal = state.malicious[jnp.clip(state.neighbors, 0, n - 1)] \
+        & (state.neighbors >= 0)                          # [N, K]
+    honest_mesh = state.mesh & (~state.malicious)[:, None, None]
+    bad = honest_mesh & nbr_mal[:, None, :]
+    return float(jnp.sum(bad) / jnp.maximum(jnp.sum(honest_mesh), 1))
+
+
+def _recovery_fraction(state, cfg, heal_tick: int) -> float | None:
+    """Settled delivery over messages published AFTER the heal tick —
+    delivery_fraction's census restricted to the recovered regime.
+    ``None`` when the census is empty (the run ended before heal +
+    settle; a silent 0.0 would read as catastrophic non-recovery)."""
+    import jax.numpy as jnp
+    age = state.tick - state.msg_publish_tick
+    alive = (age < cfg.history_length) & (age >= 2) \
+        & (state.msg_publish_tick >= heal_tick)
+    t_m = jnp.clip(state.msg_topic, 0, cfg.n_topics - 1)
+    should = state.subscribed[:, t_m] \
+        & (alive & (state.msg_topic >= 0))[None, :]
+    denom = int(jnp.sum(should))
+    if denom == 0:
+        return None
+    return float(jnp.sum(state.have & should) / denom)
+
+
+def _heal_tick(cfg) -> int:
+    """The tick the member's own FaultPlan fully heals (last window end)
+    — derived from the config so a re-tuned scenario window can never
+    silently desynchronize the recovery census."""
+    plan = cfg.fault_plan
+    ends = ([w.end for w in plan.partitions] + [w.end for w in plan.outages]
+            if plan is not None else [])
+    return max(ends) if ends else 0
+
+
+def cell_metrics(scenario: str, res, cfg) -> dict:
+    from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction
+    delivery = float(delivery_fraction(res.state, cfg, min_age_ticks=2))
+    if scenario == "sybil_small":
+        resistance = 1.0 - _sybil_mesh_share(res.state)
+    else:
+        resistance = _recovery_fraction(res.state, cfg, _heal_tick(cfg))
+    return {"delivery": round(delivery, 4),
+            "resistance": None if resistance is None
+            else round(resistance, 4)}
+
+
+def _env_fingerprint(n: int, ticks: int, seeds: int) -> dict:
+    import jax
+    return {"n": n, "ticks": ticks, "seeds": seeds,
+            "platform": jax.devices()[0].platform}
+
+
+def _journal_load(path: str | None, env: dict) -> dict:
+    """{(scenario, variant): row} for records whose env + variant spec
+    match the CURRENT run (torn tail lines skipped — their cells re-run)."""
+    recs: dict = {}
+    if path and os.path.exists(path):
+        with open(path) as f:
+            for ln in f:
+                try:
+                    r = json.loads(ln)
+                except json.JSONDecodeError:
+                    continue
+                if r.get("env") == env and "row" in r \
+                        and r.get("spec") == VARIANTS.get(r.get("variant")):
+                    recs[(r["scenario"], r["variant"])] = r["row"]
+    return recs
+
+
+def _journal_append(path: str | None, scenario: str, variant: str,
+                    env: dict, row: dict) -> None:
+    if not path:
+        return
+    with open(path, "a") as f:
+        f.write(json.dumps({"scenario": scenario, "variant": variant,
+                            "spec": VARIANTS.get(variant), "env": env,
+                            "row": row}) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def run_sweep(scenario_names=None, variant_names=None, *, n: int = 512,
+              ticks: int = 40, seeds: int = 2, journal: str | None = None,
+              emit=print, sup=None) -> list:
+    """Run the grid's missing cells — ONE fleet call per scenario (its
+    variant × seed cells batch into that fleet's groups), cells journaled
+    as soon as their scenario's fleet completes — and return the frontier
+    rows in (scenario, variant) order. A kill mid-sweep loses at most the
+    in-flight scenario (whose own windows GRAFT_CHECKPOINT_DIR can
+    checkpoint); completed scenarios replay from the journal."""
+    import jax
+    import numpy as np
+    from go_libp2p_pubsub_tpu.sim import scenarios as scen_mod
+    from go_libp2p_pubsub_tpu.sim.fleet import (FleetMember,
+                                                supervised_fleet_run)
+    from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+    from go_libp2p_pubsub_tpu.sim.supervisor import SupervisorConfig
+
+    scenario_names = list(scenario_names or SCENARIO_NAMES)
+    variant_names = list(variant_names or VARIANTS)
+    env = _env_fingerprint(n, ticks, seeds)
+    recorded = _journal_load(journal, env)
+
+    rows = []
+    for scen in scenario_names:
+        members, cells, cfgs = [], [], {}
+        for var in variant_names:
+            if (scen, var) in recorded:
+                emit(json.dumps({"info": "journal skip", "scenario": scen,
+                                 "variant": var}))
+                continue
+            cfg, tp, st = scen_mod.SCENARIOS[scen](n_peers=n)
+            cfg, tp = apply_variant(cfg, tp, VARIANTS[var])
+            cfgs[var] = cfg
+            for s in range(seeds):
+                members.append(FleetMember(
+                    cfg, tp, st, jax.random.PRNGKey(SEED_KEY_BASE + s),
+                    ticks, name=f"{scen}/{var}/s{s}"))
+                cells.append(var)
+
+        by_cell: dict = {}
+        if members:
+            results, report = supervised_fleet_run(
+                members, sup or SupervisorConfig.from_env())
+            groups = next((len(e["sizes"]) for e in report.events
+                           if e["event"] == "fleet_plan"), 0)
+            emit(json.dumps({"info": "fleet done", "scenario": scen,
+                             "members": len(members), "groups": groups,
+                             "member_ticks": report.ticks_run}))
+            for var, res in zip(cells, results):
+                by_cell.setdefault(var, []).append(res)
+
+        for var in variant_names:
+            if (scen, var) in recorded:
+                rows.append(recorded[(scen, var)])
+                emit(json.dumps(recorded[(scen, var)]))
+                continue
+            cell_res = by_cell[var]
+            mets = [cell_metrics(scen, r, cfgs[var]) for r in cell_res]
+            flags = int(np.bitwise_or.reduce(np.asarray(
+                [r.fault_flags for r in cell_res], np.uint32)))
+            resist = [m["resistance"] for m in mets]
+            row = {
+                "scenario": scen, "variant": var,
+                "delivery": round(float(np.mean(
+                    [m["delivery"] for m in mets])), 4),
+                "resistance": None if any(r is None for r in resist)
+                else round(float(np.mean(resist)), 4),
+                "fault_flags": flags,
+                "fault_flag_names": decode_flags(flags),
+                "tripped": any(r.tripped for r in cell_res),
+                "seeds": seeds, "n": n, "ticks": ticks,
+            }
+            rows.append(row)
+            emit(json.dumps(row))
+            _journal_append(journal, scen, var, env, row)
+    return rows
+
+
+def _pareto(rows: list) -> set:
+    """Indices of non-dominated (delivery, resistance) points — the
+    frontier a score-weight choice should be picked from. Rows with an
+    empty resistance census (None) are out of the running."""
+    out = set()
+    comp = [r for r in rows if r["resistance"] is not None]
+    for i, a in enumerate(rows):
+        if a["resistance"] is None:
+            continue
+        dominated = any(
+            (b["delivery"] >= a["delivery"]
+             and b["resistance"] >= a["resistance"]
+             and (b["delivery"] > a["delivery"]
+                  or b["resistance"] > a["resistance"]))
+            for b in comp)
+        if not dominated:
+            out.add(i)
+    return out
+
+
+def render_table(rows: list) -> str:
+    import jax
+    platform = jax.devices()[0].platform
+    if not rows:
+        return "(no sweep rows)"
+    meta = rows[0]
+    lines = [
+        f"Grid: {meta['seeds']} seed(s) x {meta['ticks']} ticks at "
+        f"N={meta['n']} per member, platform={platform} "
+        "(`python scripts/sweep_scores.py`). `frontier` marks the "
+        "Pareto-optimal (delivery, resistance) points per scenario.",
+        "",
+        "| scenario | variant | delivery | resistance | frontier | flags |",
+        "|---|---|---|---|---|---|",
+    ]
+    for scen in dict.fromkeys(r["scenario"] for r in rows):
+        sub = [r for r in rows if r["scenario"] == scen]
+        front = _pareto(sub)
+        for i, r in enumerate(sub):
+            flg = ",".join(r.get("fault_flag_names", [])) or "-"
+            res = "n/a" if r["resistance"] is None \
+                else f"{r['resistance']:.4f}"
+            lines.append(
+                f"| {scen} | {r['variant']} | {r['delivery']:.4f} | "
+                f"{res} | {'*' if i in front else ''} | {flg} |")
+    return "\n".join(lines)
+
+
+def write_perf_model(rows: list, path: str) -> None:
+    """Replace the frontier table between the sweep_scores markers in
+    PERF_MODEL.md (append the whole section when the markers are new)."""
+    table = render_table(rows)
+    block = f"{PERF_BEGIN}\n{table}\n{PERF_END}"
+    with open(path) as f:
+        text = f.read()
+    if PERF_BEGIN in text and PERF_END in text:
+        head, rest = text.split(PERF_BEGIN, 1)
+        _, tail = rest.split(PERF_END, 1)
+        text = head + block + tail
+    else:
+        text = text.rstrip("\n") + (
+            "\n\n## Peer-score / gater sweep frontier "
+            "(scripts/sweep_scores.py)\n\n" + block + "\n")
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--n", type=int,
+                    default=int(os.environ.get("SWEEP_N", 512)))
+    ap.add_argument("--ticks", type=int,
+                    default=int(os.environ.get("SWEEP_TICKS", 40)))
+    ap.add_argument("--seeds", type=int,
+                    default=int(os.environ.get("SWEEP_SEEDS", 2)))
+    ap.add_argument("--scenarios",
+                    default=os.environ.get("SWEEP_SCENARIOS", ""))
+    ap.add_argument("--variants",
+                    default=os.environ.get("SWEEP_VARIANTS", ""))
+    ap.add_argument("--journal",
+                    default=os.environ.get("SWEEP_JOURNAL", ""))
+    ap.add_argument("--write-perf-model", action="store_true",
+                    help="re-render the frontier table in PERF_MODEL.md")
+    args = ap.parse_args()
+    rows = run_sweep(
+        [s for s in args.scenarios.split(",") if s] or None,
+        [v for v in args.variants.split(",") if v] or None,
+        n=args.n, ticks=args.ticks, seeds=args.seeds,
+        journal=args.journal or None)
+    if args.write_perf_model:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "PERF_MODEL.md")
+        write_perf_model(rows, path)
+        print(json.dumps({"info": "perf model updated", "path": path,
+                          "rows": len(rows)}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

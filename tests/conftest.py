@@ -71,6 +71,18 @@ _SLOW = {
                            "test_replay_crash_reproduces_clean_and_tripped",
                            "test_mode_fallback_rung_first",
                            "TestTracedMode"),
+    # fleet plane (ISSUE 7): the acceptance core — B∈{1,4} parity,
+    # one-member FaultPlan isolation, supervised kill/resume, the
+    # fleet-axis fingerprint, trip retirement — stays tier-1 (shapes
+    # harmonized so the vmapped-scan compiles are shared); the extra
+    # lenses (device-sharded parity, compaction schedule, ladder/crash
+    # plumbing, weight-variant batching) are belt-and-braces
+    "test_fleet.py": ("test_sharded_fleet_matches_sequential",
+                      "test_heterogeneous_ticks_compact_finished_members",
+                      "test_retry_ladder_then_parity",
+                      "test_crash_dump_carries_per_member_flags",
+                      "test_score_weight_variants_batch_together",
+                      "test_record_member_with_flags_is_not_retired"),
     "test_sim_engine.py": ("test_negative_score_peer_gets_pruned",
                            "TestBackoff",
                            "TestNbrSubscribedCache",

@@ -47,7 +47,8 @@ class TestCleanScenariosZeroFlags:
         """fault_flags == 0 across the clean BASELINE builders, 24 ticks
         each at toy scale — the engine must never trip its own sentinel."""
         clean = {k: v for k, v in scenarios.SCENARIOS.items()
-                 if k not in ("50k_partition", "10k_outage")}
+                 if k not in ("50k_partition", "10k_outage",
+                              "partition_small", "outage_small")}
         for name, builder in clean.items():
             cfg, tp, st = builder(n_peers=96, k_slots=16, degree=6)
             assert cfg.invariant_mode == "record"

@@ -1,0 +1,375 @@
+"""Fleet plane (sim/fleet.py, ISSUE 7).
+
+The core correctness claim: fleet(B) member trajectories are BIT-IDENTICAL
+to B sequential ``engine.run`` calls — plain, with a FaultPlan firing on
+one member only, under heterogeneous tick counts (early-exit compaction),
+sharded across the test CPU mesh, and across supervised chunking with a
+kill/resume. Everything else (per-member flag isolation, trip retirement,
+the fleet-axis checkpoint fingerprint, with_score_weights) is fleet
+plumbing proven on top of that claim.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import (SimConfig, checkpoint, init_state,
+                                      scenarios, topology)
+from go_libp2p_pubsub_tpu.sim.config import with_score_weights
+from go_libp2p_pubsub_tpu.sim.engine import run
+from go_libp2p_pubsub_tpu.sim.fleet import (FleetMember, fleet_devices,
+                                            fleet_run, fleet_run_keys,
+                                            shard_fleet, stack_states,
+                                            supervised_fleet_run)
+from go_libp2p_pubsub_tpu.sim.supervisor import SupervisorConfig
+
+pytestmark = pytest.mark.fleet
+
+# 8 = 2 x the supervised chunk of 4: every supervised case below lands on
+# the same (4, B) window shapes, so the vmapped-scan compiles are shared
+N_TICKS = 8
+
+
+def _assert_states_equal(a, b, msg=""):
+    for f, x, y in zip(a._fields, a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"{msg} field {f}")
+
+
+@pytest.fixture(scope="module")
+def base():
+    """Shared tiny config (module-scoped: tests reuse the jit cache)."""
+    cfg = SimConfig(n_peers=64, k_slots=8, n_topics=1, msg_window=32,
+                    publishers_per_tick=2, prop_substeps=4,
+                    scoring_enabled=True)
+    tp = scenarios.default_topic_params(1)
+    st = init_state(cfg, topology.sparse(64, 8, degree=3))
+    return cfg, tp, st
+
+
+def _members(base, b, n_ticks=N_TICKS):
+    cfg, tp, st = base
+    return [FleetMember(cfg, tp, st, jax.random.PRNGKey(100 + i), n_ticks,
+                        name=f"m{i}") for i in range(b)]
+
+
+def _sup(**kw):
+    kw.setdefault("chunk_ticks", 4)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return SupervisorConfig(**kw)
+
+
+class TestFleetParity:
+    @pytest.mark.parametrize("b", [1, 4])
+    def test_fleet_bit_exact_vs_sequential(self, base, b):
+        """THE acceptance case at B=1 and B=4: every member's final state
+        equals its own sequential engine.run, bit for bit."""
+        members = _members(base, b)
+        results = fleet_run(members)
+        for m, r in zip(members, results):
+            ref = run(m.state, m.cfg, m.tp, m.key, m.n_ticks)
+            _assert_states_equal(ref, r.state, m.name)
+            assert r.ticks_run == m.n_ticks and not r.tripped
+
+    def test_score_weight_variants_batch_together(self, base):
+        """P1-P4 weight variants are traced TopicParams rows: they share
+        the jit-static config, batch into ONE group, and stay bit-exact
+        per member."""
+        cfg, tp, st = base
+        variants = [tp, with_score_weights(tp, p2=4.0),
+                    with_score_weights(tp, p3=0.0, p3b=0.0)]
+        members = [FleetMember(cfg, v, st, jax.random.PRNGKey(7 + i),
+                               N_TICKS, name=f"v{i}")
+                   for i, v in enumerate(variants)]
+        results, rep = supervised_fleet_run(members, _sup())
+        plan = next(e for e in rep.events if e["event"] == "fleet_plan")
+        assert plan["groups"] == 1 and plan["sizes"] == [3]
+        for m, r in zip(members, results):
+            _assert_states_equal(run(m.state, m.cfg, m.tp, m.key, N_TICKS),
+                                 r.state, m.name)
+
+    def test_fault_plan_on_one_member_only(self, base):
+        """A FaultPlan member rides its own config group; its siblings'
+        trajectories AND fault_flags are untouched (per-member
+        isolation), and every member still matches its sequential run."""
+        cfg, tp, st = base
+        fcfg, ftp, fst = scenarios.partition_small(
+            n_peers=64, k_slots=8, degree=3, start=2, heal=6)
+        members = [
+            FleetMember(cfg, tp, st, jax.random.PRNGKey(1), N_TICKS, "a"),
+            FleetMember(fcfg, ftp, fst, jax.random.PRNGKey(2), N_TICKS,
+                        "faulty"),
+            FleetMember(cfg, tp, st, jax.random.PRNGKey(3), N_TICKS, "b"),
+        ]
+        results = fleet_run(members, chunk_ticks=4)
+        for m, r in zip(members, results):
+            _assert_states_equal(run(m.state, m.cfg, m.tp, m.key, m.n_ticks),
+                                 r.state, m.name)
+        from go_libp2p_pubsub_tpu.sim.invariants import FAULT_PARTITION
+        assert results[1].fault_flags & FAULT_PARTITION
+        assert "partition" in results[1].flag_names
+        assert results[0].fault_flags == 0 and results[2].fault_flags == 0
+
+    def test_heterogeneous_ticks_compact_finished_members(self, base):
+        """Members finish at their own n_ticks; finished lanes compact out
+        of the batch (the long-tail member does not hold idle lanes) and
+        every trajectory still matches its sequential run."""
+        cfg, tp, st = base
+        members = [FleetMember(cfg, tp, st, jax.random.PRNGKey(20 + i), t,
+                               name=f"t{t}") for i, t in enumerate((3, 7, 12))]
+        results, rep = supervised_fleet_run(members, _sup(chunk_ticks=4))
+        compacts = [e for e in rep.events if e["event"] == "compact"]
+        assert compacts, rep.events          # the batch DID shrink
+        assert compacts[-1]["active"] == 1   # long tail ran alone
+        for m, r in zip(members, results):
+            _assert_states_equal(run(m.state, m.cfg, m.tp, m.key, m.n_ticks),
+                                 r.state, m.name)
+            assert r.ticks_run == m.n_ticks
+
+    def test_sharded_fleet_matches_sequential(self, base):
+        """The fleet axis sharded across the test CPU mesh (conftest
+        forces 8 virtual devices) stays bit-exact — the multi-device
+        scaling path of bench.py's fleet line."""
+        cfg, tp, st = base
+        b, ticks = 8, 5
+        keys = [jax.random.PRNGKey(40 + i) for i in range(b)]
+        states = stack_states([st] * b)
+        tps = stack_states([tp] * b)
+        kw = jnp.stack([jax.random.split(k, ticks) for k in keys], axis=1)
+        assert fleet_devices(b) == jax.local_device_count() == 8
+        sstates, stps, skw = shard_fleet(states, tps, kw)
+        out = fleet_run_keys(sstates, cfg, stps, skw)
+        for i in range(b):
+            ref = run(st, cfg, tp, keys[i], ticks)
+            _assert_states_equal(ref, jax.tree.map(lambda a: a[i], out),
+                                 f"lane{i}")
+
+
+class TestFleetSupervised:
+    def test_kill_resume_bit_identical(self, base, tmp_path):
+        """Interrupt the fleet mid-schedule, re-invoke with the same
+        checkpoint dir: resume from the fleet checkpoint, final states
+        bit-identical to uninterrupted sequential runs."""
+        members = _members(base, 3)
+        ck = str(tmp_path / "ck")
+
+        def kill(info):
+            if info["window_start"] >= 4:
+                raise KeyboardInterrupt("simulated preemption")
+
+        with pytest.raises(KeyboardInterrupt):
+            supervised_fleet_run(members, _sup(checkpoint_dir=ck),
+                                 _chunk_hook=kill)
+        results, rep = supervised_fleet_run(members,
+                                            _sup(checkpoint_dir=ck))
+        assert rep.resumed_tick == 4
+        assert rep.ticks_run == 3 * 4        # only the missing window re-ran
+        for m, r in zip(members, results):
+            _assert_states_equal(run(m.state, m.cfg, m.tp, m.key, m.n_ticks),
+                                 r.state, m.name)
+
+    def test_b4_journal_cannot_resume_into_b8(self, base, tmp_path):
+        """The fleet-axis fingerprint satellite: checkpoints from a B=4
+        run are REJECTED BY NAME when a B=8 run (same config!) tries to
+        resume from the same directory, and the B=8 run completes from
+        scratch."""
+        ck = str(tmp_path / "ck")
+        _, rep4 = supervised_fleet_run(_members(base, 4),
+                                       _sup(checkpoint_dir=ck))
+        assert rep4.checkpoints
+        results, rep8 = supervised_fleet_run(_members(base, 8),
+                                             _sup(checkpoint_dir=ck))
+        skips = [e for e in rep8.events if e["event"] == "resume_skip"]
+        assert skips and "fleet-axis mismatch" in skips[0]["error"]
+        assert rep8.resumed_from is None
+        for m, r in zip(_members(base, 8), results):
+            _assert_states_equal(run(m.state, m.cfg, m.tp, m.key, m.n_ticks),
+                                 r.state, m.name)
+
+    def test_deadline_trip_backoff_then_parity(self, base):
+        """The fleet window watchdog: a deadline overrun on one window is
+        a transient failure (kind=deadline, NOT a KeyError from the
+        supervisor's info schema — the two callers' dicts differ), and
+        the retried fleet lands bit-exact."""
+        import time as _time
+        members = _members(base, 3)
+
+        def slow_once(info):
+            # the SECOND window: the first window of a shape compiles and
+            # runs under the (unbounded) compile deadline by design
+            if info["window_start"] == 4 and info["attempt"] == 0:
+                _time.sleep(1.0)
+
+        results, rep = supervised_fleet_run(
+            members, _sup(deadline_s=0.4, max_retries=2),
+            _chunk_hook=slow_once)
+        assert rep.retries == 1
+        fails = [e for e in rep.events if e["event"] == "chunk_failed"]
+        assert fails and "deadline" in fails[0]["error"]
+        for m, r in zip(members, results):
+            _assert_states_equal(run(m.state, m.cfg, m.tp, m.key, m.n_ticks),
+                                 r.state, m.name)
+
+    def test_retry_ladder_then_parity(self, base):
+        """A transient window failure degrades down the shared supervisor
+        ladder and the fleet still lands bit-exact."""
+        members = _members(base, 2)
+        fails = iter([True])
+
+        def flaky(info):
+            if next(fails, False):
+                raise RuntimeError("transient")
+
+        results, rep = supervised_fleet_run(members, _sup(max_retries=2),
+                                            _chunk_hook=flaky)
+        assert rep.retries == 1
+        assert any(e["event"] == "degrade" for e in rep.events)
+        for m, r in zip(members, results):
+            _assert_states_equal(run(m.state, m.cfg, m.tp, m.key, m.n_ticks),
+                                 r.state, m.name)
+
+    def test_crash_dump_carries_per_member_flags(self, base, tmp_path):
+        import json
+        from go_libp2p_pubsub_tpu.sim.supervisor import SupervisorCrash
+        members = _members(base, 2)
+
+        def boom(info):
+            raise RuntimeError("permanent failure")
+
+        with pytest.raises(SupervisorCrash) as ei:
+            supervised_fleet_run(
+                members, _sup(max_retries=1, crash_dir=str(tmp_path)),
+                _chunk_hook=boom)
+        meta = json.load(open(os.path.join(ei.value.dump_dir, "crash.json")))
+        assert meta["fleet_size"] == 2
+        assert meta["member_names"] == ["m0", "m1"]
+        assert len(meta["fault_flags"]) == 2
+        assert meta["config_fingerprint"] == checkpoint.config_fingerprint(
+            members[0].cfg, fleet=2)
+        # the batched last-good checkpoint restores at the fleet axis
+        like = stack_states([members[0].state, members[1].state])
+        back = checkpoint.restore(os.path.join(ei.value.dump_dir,
+                                               "last_good"), like,
+                                  cfg=members[0].cfg)
+        assert np.asarray(back.tick).shape == (2,)
+
+
+class TestTripIsolation:
+    def test_raise_member_retires_without_killing_siblings(self, base):
+        """An invariant_mode="raise" member whose sentinel fires is
+        retired at the chunk boundary (state frozen, tripped=True); its
+        siblings run to completion bit-exact — one poisoned lane cannot
+        kill or mask B-1 healthy ones."""
+        cfg, tp, st = base
+        rcfg = dataclasses.replace(cfg, invariant_mode="raise")
+        poisoned = st._replace(halo_overflow=jnp.int32(3))
+        members = [
+            FleetMember(cfg, tp, st, jax.random.PRNGKey(1), N_TICKS, "ok0"),
+            FleetMember(rcfg, tp, poisoned, jax.random.PRNGKey(2), N_TICKS,
+                        "poisoned"),
+            FleetMember(cfg, tp, st, jax.random.PRNGKey(3), N_TICKS, "ok1"),
+        ]
+        results, rep = supervised_fleet_run(members, _sup(chunk_ticks=4))
+        assert results[1].tripped
+        assert results[1].ticks_run < N_TICKS      # retired early
+        assert any("VIOLATION" in n for n in results[1].flag_names)
+        assert any(e["event"] == "member_tripped" for e in rep.events)
+        for i in (0, 2):
+            m, r = members[i], results[i]
+            assert not r.tripped and r.fault_flags == 0
+            _assert_states_equal(run(m.state, m.cfg, m.tp, m.key, m.n_ticks),
+                                 r.state, m.name)
+
+    def test_record_member_with_flags_is_not_retired(self, base):
+        """record-mode members carry their flags to completion — only
+        "raise" members are retired on violations."""
+        cfg, tp, st = base
+        poisoned = st._replace(halo_overflow=jnp.int32(3))
+        members = [FleetMember(cfg, tp, poisoned, jax.random.PRNGKey(5),
+                               N_TICKS, "recorded")]
+        results = fleet_run(members)
+        assert not results[0].tripped
+        assert results[0].ticks_run == N_TICKS
+        assert any("VIOLATION" in n for n in results[0].flag_names)
+
+
+class TestScoreWeights:
+    """with_score_weights satellite: the P1-P7 override constructor."""
+
+    def test_topic_level_overrides_broadcast(self):
+        tp = scenarios.default_topic_params(3)
+        out = with_score_weights(tp, p2=4.0, p4=-40.0)
+        np.testing.assert_array_equal(
+            np.asarray(out.first_message_deliveries_weight), [4.0] * 3)
+        np.testing.assert_array_equal(
+            np.asarray(out.invalid_message_deliveries_weight), [-40.0] * 3)
+        # untouched rows are untouched
+        np.testing.assert_array_equal(
+            np.asarray(out.mesh_message_deliveries_weight),
+            np.asarray(tp.mesh_message_deliveries_weight))
+
+    def test_full_field_names_and_per_topic_values(self):
+        tp = scenarios.default_topic_params(2)
+        out = with_score_weights(tp, time_in_mesh_weight=[0.5, 0.25])
+        np.testing.assert_array_equal(
+            np.asarray(out.time_in_mesh_weight), [0.5, 0.25])
+
+    def test_config_level_weights_need_cfg(self):
+        tp = scenarios.default_topic_params(1)
+        with pytest.raises(ValueError, match="pass cfg="):
+            with_score_weights(tp, p7=-40.0)
+        cfg = SimConfig(n_peers=64, k_slots=8)
+        out_tp, out_cfg = with_score_weights(tp, cfg=cfg, p7=-40.0,
+                                             p6=-200.0, p1=0.0)
+        assert out_cfg.behaviour_penalty_weight == -40.0
+        assert out_cfg.ip_colocation_factor_weight == -200.0
+        np.testing.assert_array_equal(
+            np.asarray(out_tp.time_in_mesh_weight), [0.0])
+        # cfg passed but no cfg-level overrides: cfg returned unchanged
+        same_tp, same_cfg = with_score_weights(tp, cfg=cfg, p2=2.0)
+        assert same_cfg is cfg
+
+    def test_unknown_weight_raises(self):
+        tp = scenarios.default_topic_params(1)
+        with pytest.raises(ValueError, match="unknown score weight"):
+            with_score_weights(tp, p9=1.0)
+
+
+class TestFleetCheckpointFingerprint:
+    """checkpoint.save/restore fleet-axis satellite at the unit level."""
+
+    def test_fingerprint_binds_fleet_axis(self, base):
+        cfg, _, _ = base
+        assert checkpoint.config_fingerprint(cfg) \
+            != checkpoint.config_fingerprint(cfg, fleet=4)
+        assert checkpoint.config_fingerprint(cfg, fleet=4) \
+            != checkpoint.config_fingerprint(cfg, fleet=8)
+
+    def test_batched_save_names_mismatch(self, base, tmp_path):
+        cfg, tp, st = base
+        b4 = stack_states([st] * 4)
+        path = str(tmp_path / "fleet_ck")
+        checkpoint.save(path, b4, cfg=cfg)
+        # B=8 `like` → named fleet error, not a shape crash
+        b8 = stack_states([st] * 8)
+        with pytest.raises(ValueError, match="fleet-axis mismatch"):
+            checkpoint.restore(path, b8, cfg=cfg)
+        # unbatched `like` → named fleet error too
+        with pytest.raises(ValueError, match="fleet-axis mismatch"):
+            checkpoint.restore(path, st, cfg=cfg)
+        # matching axis restores cleanly
+        back = checkpoint.restore(path, b4, cfg=cfg)
+        _assert_states_equal(b4, back)
+
+    def test_unbatched_save_rejects_fleet_like(self, base, tmp_path):
+        cfg, tp, st = base
+        path = str(tmp_path / "single_ck")
+        checkpoint.save(path, st, cfg=cfg)
+        with pytest.raises(ValueError, match="fleet-axis mismatch"):
+            checkpoint.restore(path, stack_states([st] * 4), cfg=cfg)
+        _assert_states_equal(st, checkpoint.restore(path, st, cfg=cfg))

@@ -1,0 +1,118 @@
+"""Tiny-grid smoke of the peer-score sweep (scripts/sweep_scores.py,
+ISSUE 7 CI satellite): the grid runs as fleet groups, rows carry
+delivery/resistance/flags, the journal makes a re-invocation skip
+recorded cells verbatim, and the PERF_MODEL frontier-table rewrite is
+idempotent."""
+
+import json
+import os
+
+import pytest
+
+from scripts.sweep_scores import (PERF_BEGIN, PERF_END, VARIANTS, _pareto,
+                                  render_table, run_sweep, write_perf_model)
+
+pytestmark = pytest.mark.fleet
+
+GRID = dict(scenario_names=["sybil_small", "partition_small"],
+            variant_names=["baseline", "p4_harsh"],
+            n=128, ticks=10, seeds=1)
+
+
+@pytest.fixture(scope="module")
+def sweep(tmp_path_factory):
+    td = tmp_path_factory.mktemp("sweep")
+    journal = str(td / "sweep.jsonl")
+    lines = []
+    rows = run_sweep(GRID["scenario_names"], GRID["variant_names"],
+                     n=GRID["n"], ticks=GRID["ticks"], seeds=GRID["seeds"],
+                     journal=journal, emit=lines.append)
+    return journal, rows, lines
+
+
+def test_rows_cover_grid_with_metrics(sweep):
+    _, rows, _ = sweep
+    assert [(r["scenario"], r["variant"]) for r in rows] == [
+        ("sybil_small", "baseline"), ("sybil_small", "p4_harsh"),
+        ("partition_small", "baseline"), ("partition_small", "p4_harsh")]
+    for r in rows:
+        assert 0.0 <= r["delivery"] <= 1.0
+        assert not r["tripped"]
+    # sybil resistance is the mesh-eviction metric, always defined
+    assert all(0.0 <= r["resistance"] <= 1.0 for r in rows
+               if r["scenario"] == "sybil_small")
+    # 10 ticks end before the partition heals (heal=20): the recovery
+    # census is EMPTY and must surface as None, never a silent 0.0
+    assert all(r["resistance"] is None for r in rows
+               if r["scenario"] == "partition_small")
+    # the partition plan fired and self-identified
+    assert all("partition" in r["fault_flag_names"] for r in rows
+               if r["scenario"] == "partition_small")
+
+
+def test_journal_resume_skips_recorded_cells(sweep):
+    journal, rows, _ = sweep
+    n_lines = sum(1 for _ in open(journal))
+    assert n_lines == 4
+    lines2 = []
+    rows2 = run_sweep(GRID["scenario_names"], GRID["variant_names"],
+                      n=GRID["n"], ticks=GRID["ticks"], seeds=GRID["seeds"],
+                      journal=journal, emit=lines2.append)
+    skips = [json.loads(ln) for ln in lines2
+             if json.loads(ln).get("info") == "journal skip"]
+    assert len(skips) == 4
+    assert rows2 == rows
+    assert sum(1 for _ in open(journal)) == n_lines   # nothing re-recorded
+    # no fleet ran at all on the resume
+    assert not any(json.loads(ln).get("info") == "fleet done"
+                   for ln in lines2)
+
+
+def test_env_drift_invalidates_journal(sweep, tmp_path):
+    """A journal recorded at different grid knobs must not stand in."""
+    journal, _, _ = sweep
+    lines = []
+    run_sweep(["sybil_small"], ["baseline"], n=128, ticks=8,
+              seeds=1, journal=journal, emit=lines.append)
+    assert not any(json.loads(ln).get("info") == "journal skip"
+                   for ln in lines)
+
+
+def test_perf_model_rewrite_idempotent(sweep, tmp_path):
+    _, rows, _ = sweep
+    pm = str(tmp_path / "PM.md")
+    with open(pm, "w") as f:
+        f.write("# scratch perf model\n\nexisting text\n")
+    write_perf_model(rows, pm)
+    first = open(pm).read()
+    assert PERF_BEGIN in first and PERF_END in first
+    assert "existing text" in first            # surrounding text preserved
+    write_perf_model(rows, pm)
+    assert open(pm).read() == first            # marker replace, not append
+
+
+def test_pareto_marks_nondominated_only():
+    rows = [{"delivery": 0.9, "resistance": 0.5},
+            {"delivery": 0.8, "resistance": 0.9},
+            {"delivery": 0.7, "resistance": 0.4},    # dominated by both
+            {"delivery": 0.95, "resistance": None}]  # empty census: out
+    assert _pareto(rows) == {0, 1}
+
+
+def test_variant_specs_resolve():
+    """Every shipped variant spec splits cleanly into weight overrides +
+    config overrides and applies to a real scenario build."""
+    from scripts.sweep_scores import apply_variant
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    cfg, tp, _ = scenarios.sybil_small(n_peers=128)
+    for name, spec in VARIANTS.items():
+        out_cfg, out_tp = apply_variant(cfg, tp, spec)
+        assert out_tp.topic_weight.shape == tp.topic_weight.shape, name
+
+
+def test_render_table_has_frontier_column(sweep):
+    _, rows, _ = sweep
+    table = render_table(rows)
+    assert "| scenario | variant | delivery | resistance | frontier |" \
+        in table
+    assert "n/a" in table          # the empty partition census renders n/a
