@@ -81,6 +81,33 @@ def _fetch_rtt():
     return statistics.median(samples)
 
 
+def _memory_record(cfg, fleet: int = 1) -> dict:
+    """Measured per-process device memory next to the modeled estimate, so
+    HBM-wall claims in PERF_MODEL are measured rather than modeled:
+    ``device.memory_stats()`` peak where the backend reports it (TPU), a
+    ``jax.live_arrays()`` byte-sum fallback elsewhere (CPU reports no
+    peak — the sum is live bytes at sample time, an underestimate of peak,
+    and says so in ``memory_source``). A fleet run vmaps ``fleet`` stacked
+    member states (every leaf, message tables included), so the modeled
+    estimate scales by B to stay comparable to the measured peak."""
+    import jax
+    from go_libp2p_pubsub_tpu.sim.state import state_nbytes
+    try:
+        stats = jax.devices()[0].memory_stats()
+    except Exception:
+        stats = None
+    if stats and stats.get("peak_bytes_in_use"):
+        peak, src = int(stats["peak_bytes_in_use"]), "memory_stats.peak"
+    else:
+        try:
+            peak = int(sum(a.nbytes for a in jax.live_arrays()))
+            src = "live_arrays.sum"
+        except Exception:
+            peak, src = -1, "unavailable"
+    return {"device_peak_bytes": peak, "memory_source": src,
+            "state_nbytes": state_nbytes(cfg)["total"] * fleet}
+
+
 def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
     """Run one config and print its JSON metric line; returns the line so
     callers can re-emit the headline last (the one-line-parse contract)."""
@@ -146,6 +173,9 @@ def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
         "requested": {"edge_gather_mode": cfg.edge_gather_mode,
                       "hop_mode": cfg.hop_mode,
                       "selection_mode": cfg.selection_mode},
+        # measured per-process device memory + the modeled state estimate
+        # (ISSUE 8: HBM-wall claims measured, not modeled)
+        **_memory_record(cfg),
     })
     print(line, flush=True)
     return line
@@ -153,7 +183,8 @@ def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
 
 NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
          "50k_churn_gater_px", "100k_sybil20", "100k_floodsub",
-         "100k_randomsub", "100k_gossipsub_sweep", "headline"]
+         "100k_randomsub", "100k_gossipsub_sweep",
+         "frontier_250k", "frontier_500k", "frontier_1m", "headline"]
 # execution order puts headline FIRST (banked before anything can time
 # out — losing it cost round 5 its record, VERDICT r5 weak #2) and its
 # line is re-emitted LAST so the driver's single-line stdout parse still
@@ -169,7 +200,10 @@ NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
 # fleet window kept short: the batched window costs ~B x the 1k per-tick
 # time on a serial host, and the config must fit the per-config deadline
 TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60,
-                 "fleet_256x1k": 10}
+                 "fleet_256x1k": 10,
+                 # frontier family (ROADMAP item 1): short windows — the
+                 # per-tick cost at 250k+ dwarfs the dispatch RTT
+                 "frontier_250k": 10, "frontier_500k": 5, "frontier_1m": 3}
 
 
 def _fleet_b() -> int:
@@ -179,12 +213,20 @@ def _fleet_b() -> int:
     return max(1, int(os.environ.get("GRAFT_FLEET_SIZE", 256)))
 
 
+def _cap_peers(n: int) -> int:
+    """``n`` under the BENCH_MAX_N cap — THE one capping rule, shared by
+    every scenario builder AND every label maker (parent-process safe: no
+    jax import). One rule means a capped reduced-N contract run can never
+    build one shape and bank under another's label."""
+    cap = os.environ.get("BENCH_MAX_N")
+    return min(n, int(cap)) if cap else n
+
+
 def _fleet_n() -> int:
     """Per-member peer count of the fleet bench config: the 1k shape
     under the BENCH_MAX_N cap (shared with _label so a capped fleet line
     can never be banked under the full-size label)."""
-    cap = os.environ.get("BENCH_MAX_N")
-    return min(1024, int(cap)) if cap else 1024
+    return _cap_peers(1024)
 
 
 def bench_fleet(name: str, ticks: int, repeats: int) -> str:
@@ -260,6 +302,7 @@ def bench_fleet(name: str, ticks: int, repeats: int) -> str:
         "requested": {"edge_gather_mode": cfg.edge_gather_mode,
                       "hop_mode": cfg.hop_mode,
                       "selection_mode": cfg.selection_mode},
+        **_memory_record(cfg, fleet=b),
     })
     print(line, flush=True)
     return line
@@ -278,11 +321,9 @@ def run_scenario(name: str) -> str | None:
         # knobs don't apply — the fleet runs the scenario's own modes
         return bench_fleet(name, ticks, repeats)
 
-    def _cap_n(default_n: int) -> int:
-        # BENCH_MAX_N: reduced-N contract runs exercise the WHOLE 8-config
-        # suite on CPU within the total budget (tests/test_bench_contract)
-        cap = os.environ.get("BENCH_MAX_N")
-        return min(default_n, int(cap)) if cap else default_n
+    # BENCH_MAX_N: reduced-N contract runs exercise the WHOLE config
+    # suite on CPU within the total budget (tests/test_bench_contract)
+    _cap_n = _cap_peers
 
     def headline():
         from __graft_entry__ import _build
@@ -294,9 +335,21 @@ def run_scenario(name: str) -> str | None:
                       k_slots=int(os.environ.get("BENCH_K", 32)),
                       degree=12, msg_window=64, publishers=8)
 
+    def _frontier(full_n):
+        # the frontier family's full peer counts live in
+        # scenarios.FRONTIER_NS; BENCH_MAX_N gates them for reduced-N
+        # contract runs exactly like every other scenario
+        return scenarios.frontier(_cap_n(full_n))
+
     builders = {
         "1k_single_topic":
             lambda: scenarios.single_topic_1k(n_peers=_cap_n(1024)),
+        "frontier_250k":
+            lambda: _frontier(scenarios.FRONTIER_NS["frontier_250k"]),
+        "frontier_500k":
+            lambda: _frontier(scenarios.FRONTIER_NS["frontier_500k"]),
+        "frontier_1m":
+            lambda: _frontier(scenarios.FRONTIER_NS["frontier_1m"]),
         "10k_beacon": lambda: scenarios.beacon_10k(n_peers=_cap_n(10_000)),
         "50k_churn_gater_px":
             lambda: scenarios.churn_50k(n_peers=_cap_n(50_000)),
@@ -311,6 +364,8 @@ def run_scenario(name: str) -> str | None:
     }
     assert set(builders) | {"fleet_256x1k"} == set(NAMES), \
         "scenario registry drifted from NAMES"
+    assert FRONTIER_FULL_N == scenarios.FRONTIER_NS, \
+        "bench FRONTIER_FULL_N drifted from scenarios.FRONTIER_NS"
     cfg, tp, st = builders[name]()
     mode = os.environ.get("GRAFT_EDGE_GATHER")
     if mode:
@@ -388,9 +443,15 @@ def _headline_n() -> int:
     the BENCH_MAX_N cap. Shared by the builder and _label so a capped
     reduced-N headline can never be banked (or cited by the
     window-evidence chain) under the full-N label."""
-    n = int(os.environ.get("BENCH_N", 100_000))
-    cap = os.environ.get("BENCH_MAX_N")
-    return min(n, int(cap)) if cap else n
+    return _cap_peers(int(os.environ.get("BENCH_N", 100_000)))
+
+
+# full peer counts of the frontier family — duplicated from
+# sim/scenarios.FRONTIER_NS because the bench PARENT process must not
+# import jax (platform-probe discipline); run_scenario (the child, where
+# jax is live) asserts the two stay in sync
+FRONTIER_FULL_N = {"frontier_250k": 262_144, "frontier_500k": 524_288,
+                   "frontier_1m": 1_048_576}
 
 
 def _label(name: str) -> str:
@@ -401,6 +462,12 @@ def _label(name: str) -> str:
         # the BENCH_MAX_N-capped member size) so a reduced contract run
         # can never be banked under the full-shape label
         return f"fleet_{_fleet_b()}x{_fleet_n() // 1000}k"
+    if name in FRONTIER_FULL_N:
+        # a BENCH_MAX_N-capped frontier line is labeled by what ran —
+        # a reduced-N contract run can never bank under the full label
+        full = FRONTIER_FULL_N[name]
+        n = _cap_peers(full)
+        return name if n == full else f"{name}_capped_{n // 1000}k"
     return name
 
 

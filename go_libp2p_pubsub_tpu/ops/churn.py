@@ -32,19 +32,42 @@ import jax.numpy as jnp
 from ..sim.config import SimConfig, TopicParams
 from ..sim.state import NEVER, SimState
 from .bits import U32, pack_bool
-from .permgather import edge_sort_key, permutation_gather
+from .permgather import edge_sort_key, permutation_gather, resolve_mode
 from .score_ops import apply_prune_penalty, compute_scores
+
+
+def _edge_exchange(state: SimState, x: jnp.ndarray,
+                   mode: str = "auto") -> jnp.ndarray:
+    """One [N, K] payload routed through the reverse-edge involution:
+    ``out[n, k] = x[jn, rk]``. Under a sharded step with
+    ``sharded_route="halo"`` and a sort-resolved mode, the payload rides
+    the per-shard all_to_all halo route instead of the global sort the
+    SPMD partitioner would replicate via a dense [N, K] all-gather —
+    churn's score/PX reconnect exchange was the last engine plane still
+    riding partitioner-inserted collectives (tests/test_hlo_sharded_budget
+    enforces the packed budget over the whole step)."""
+    from ..parallel.kernel_context import current_kernel_mesh
+
+    n, k = state.neighbors.shape
+    ctx = current_kernel_mesh()
+    if ctx is not None and ctx.route == "halo" and \
+            resolve_mode(mode, x.dtype, n, k, have_sort_key=True) == "sort":
+        from ..parallel.halo import route_payloads_halo
+        return route_payloads_halo([x], state.neighbors,
+                                   state.reverse_slot)[0]
+    nbr = jnp.clip(state.neighbors, 0, n - 1)
+    rk = jnp.clip(state.reverse_slot, 0, k - 1)
+    sk = edge_sort_key(state.neighbors, state.reverse_slot, k_major=False)
+    return permutation_gather(x, nbr, rk, mode, sort_key=sk)
 
 
 def _symmetric_value(state: SimState, x: jnp.ndarray,
                      mode: str = "auto") -> jnp.ndarray:
     """[N, K] per-edge values made equal on both directions of each edge: the
     lower-id endpoint's value wins, gathered through reverse_slot."""
-    n, k = state.neighbors.shape
+    n = state.neighbors.shape[0]
     nbr = jnp.clip(state.neighbors, 0, n - 1)
-    rk = jnp.clip(state.reverse_slot, 0, k - 1)
-    sk = edge_sort_key(state.neighbors, state.reverse_slot, k_major=False)
-    x_rev = permutation_gather(x, nbr, rk, mode, sort_key=sk)
+    x_rev = _edge_exchange(state, x, mode)
     mine_wins = jnp.arange(n)[:, None] < nbr
     return jnp.where(mine_wins, x, x_rev)
 
@@ -56,14 +79,12 @@ def _symmetric_bools(state: SimState, bits: list,
     permutation gather — each f32 `_symmetric_value` costs its own N*K
     serialized scalar loads on TPU, so decisions that can be taken locally
     first (draw < prob) and exchanged as bits should be."""
-    n, k = state.neighbors.shape
+    n = state.neighbors.shape[0]
     nbr = jnp.clip(state.neighbors, 0, n - 1)
-    rk = jnp.clip(state.reverse_slot, 0, k - 1)
-    payload = jnp.zeros((n, k), U32)
+    payload = jnp.zeros(state.neighbors.shape, U32)
     for i, b in enumerate(bits):
         payload = payload | jnp.where(b, U32(1) << U32(i), U32(0))
-    sk = edge_sort_key(state.neighbors, state.reverse_slot, k_major=False)
-    g = permutation_gather(payload, nbr, rk, mode, sort_key=sk)
+    g = _edge_exchange(state, payload, mode)
     mine_wins = jnp.arange(n)[:, None] < nbr
     return [jnp.where(mine_wins, b, ((g >> U32(i)) & U32(1)).astype(bool))
             for i, b in enumerate(bits)]

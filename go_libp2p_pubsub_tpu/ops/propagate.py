@@ -85,6 +85,14 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
     """
     p = publishers.shape[0]
     m = cfg.msg_window
+    if p > m:
+        # more publishes than window slots would alias slots WITHIN one
+        # batch: the message-table .set writes become last-writer races
+        # and the packed seen-set scatter-add below carries into adjacent
+        # bits (its exactness rests on distinct slots per batch)
+        raise ValueError(
+            f"publish: {p} publishers per tick exceed msg_window={m}; "
+            "message slots must be distinct within one batch")
     slots = (state.tick * p + jnp.arange(p)) % m
 
     invalid_pub = state.malicious[publishers]
@@ -108,9 +116,16 @@ def publish(state: SimState, cfg: SimConfig, publishers: jnp.ndarray,
         deliver_from = state.deliver_from.at[:, slots].set(-1)
     else:
         deliver_from = state.deliver_from      # dormant buffer, no hot-path op
-    # reset recycled slots, then mark the publisher as having it
-    have = state.have.at[:, slots].set(False)
-    have = have.at[publishers, slots].set(True)
+    # reset recycled slots, then mark the publisher as having it. The
+    # seen-set is stored packed ([N, W] u32, sim/state.py): clearing is a
+    # word-AND against the recycled slots' bit mask (elementwise — shard-
+    # friendly under the peer-sharded step), setting is a scatter-add of
+    # the publisher's slot bit (exact: the bits were just cleared and the
+    # slots of one publish batch are distinct, so added bits never carry)
+    clear_w = pack_bool(jnp.zeros((1, m), bool).at[0, slots].set(True))[0]
+    have = state.have & ~clear_w[None, :]
+    have = have.at[publishers, slots // 32].add(
+        U32(1) << (slots % 32).astype(U32))
     deliver_tick = state.deliver_tick.at[:, slots].set(NEVER)
     deliver_tick = deliver_tick.at[publishers, slots].set(state.tick)
     iwant_pending = state.iwant_pending.at[:, slots].set(-1)
@@ -299,7 +314,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     inv_n = jnp.where(mal[None, :], U32(0), invalid_bits[:, None])      # [W,N]
     ign_n = jnp.where(mal[None, :], U32(0), ignored_bits[:, None])      # [W,N]
 
-    have_bits = pack_words(state.have)                                  # [W,N]
+    have_bits = state.have.T                    # [W,N] (stored packed)
     dlv_bits = pack_words(state.deliver_tick < NEVER)                   # [W,N]
     dlv_start = dlv_bits
     n_have_start = popcount_sum(have_bits, axis=(0, 1))
@@ -715,7 +730,7 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                   t2(tp.invalid_message_deliveries_decay), z) + imd_add
 
     newly_dlv = dlv_bits & ~dlv_start
-    have = unpack_words(have_bits, m)
+    have = have_bits.T                          # store packed ([N, W])
     new_dlv_mask = unpack_words(newly_dlv, m)
     deliver_tick = jnp.where(new_dlv_mask, state.tick, state.deliver_tick)
     delivered = popcount_sum(have_bits, axis=(0, 1)) - n_have_start

@@ -34,6 +34,8 @@ unsharded trajectory either way (tests/test_sharding.py).
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -80,9 +82,6 @@ def required_capacity_factor(neighbors, reverse_slot, n_dev: int) -> int:
     numpy, directly assignable to ``SimConfig.halo_capacity_factor``
     before a run (already ceiled: cap = factor * ceil(Ld/D) >= the worst
     bucket)."""
-    import math
-
-    import numpy as np
     nbr = np.asarray(neighbors)
     rks = np.asarray(reverse_slot)
     n, k = nbr.shape
@@ -160,7 +159,10 @@ def route_words_halo(x_w, neighbors, reverse_slot):
     """Sharded words gather: out[w, k, n] = x_w[w, neighbors[n, k]] via the
     per-shard halo route (k-major destination layout). Inputs are the
     GLOBAL arrays; shard_map applies the sharding."""
-    assert current_kernel_mesh() is not None
+    if current_kernel_mesh() is None:
+        # not assert: -O must not strip the dispatch contract — outside a
+        # kernel mesh there is no axis to all_to_all over
+        raise ValueError("route_words_halo outside a kernel_mesh context")
     w, n = x_w.shape
     k = neighbors.shape[1]
     n_dev = peer_shards()
@@ -196,7 +198,8 @@ def route_payloads_halo(payloads, neighbors, reverse_slot):
     """Sharded packed-edge exchange: out[n, k] = payload[jn[n,k], rk[n,k]]
     for each [N, K] payload plane (n-major destination layout), all planes
     riding one halo."""
-    assert current_kernel_mesh() is not None
+    if current_kernel_mesh() is None:
+        raise ValueError("route_payloads_halo outside a kernel_mesh context")
     n, k = neighbors.shape
     n_dev = peer_shards()
     nl = n // n_dev

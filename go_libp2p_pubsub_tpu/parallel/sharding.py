@@ -45,52 +45,48 @@ def make_mesh_2d(n_hosts: int, devices=None) -> Mesh:
     scaled past one host."""
     devices = devices if devices is not None else jax.devices()
     devices = np.array(devices)
-    assert devices.size % n_hosts == 0, \
-        f"{devices.size} devices do not split over {n_hosts} hosts"
+    if n_hosts <= 0 or devices.size % n_hosts:
+        # not assert: -O must not strip the mesh-shape contract, and a bad
+        # host count must fail by name before any collective compiles
+        raise ValueError(
+            f"make_mesh_2d: {devices.size} devices do not split over "
+            f"{n_hosts} hosts")
     return Mesh(devices.reshape(n_hosts, -1), (DCN_AXIS, PEER_AXIS))
 
 
-def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
-    """A SimState-shaped pytree of NamedShardings: peer-major arrays shard on
-    axis 0, the global message table replicates, scalars replicate."""
-    n = cfg.n_peers
+def state_partition_specs(mesh: Mesh, cfg: SimConfig) -> SimState:
+    """A SimState-shaped pytree of PartitionSpecs derived from the single
+    layout source of truth (``sim.state.state_spec``): peer-major arrays
+    shard their leading axis over the peer mesh axes, the global message
+    table and scalars replicate. The spec form (no mesh binding per leaf)
+    is what ``multihost_utils.host_local_array_to_global_array`` consumes
+    (parallel/multihost.py)."""
+    from ..sim.state import state_spec
+
     # on a 2-D (dcn, peers) mesh the peer axis shards over both axes,
     # hosts-major (see make_mesh_2d)
     peer_axes = (DCN_AXIS, PEER_AXIS) if DCN_AXIS in mesh.axis_names \
         else PEER_AXIS
+    spec = state_spec(cfg)
+    return SimState(**{
+        f: P(peer_axes, *([None] * (len(shape) - 1))) if peer_major
+        else P(*([None] * len(shape)))
+        for f, (shape, _dtype, peer_major) in spec.items()})
 
-    def spec_for(leaf_name: str, ndim: int, leading_n: bool):
-        if leading_n:
-            return NamedSharding(mesh, P(peer_axes, *([None] * (ndim - 1))))
-        return NamedSharding(mesh, P(*([None] * ndim)))
 
-    # field -> (ndim, leading axis is N)
-    layout = dict(
-        tick=(0, False), neighbors=(2, True), connected=(2, True),
-        outbound=(2, True), reverse_slot=(2, True), subscribed=(2, True),
-        nbr_subscribed=(3, True), disconnect_tick=(2, True),
-        direct=(2, True), ip_group=(1, True), app_score=(1, True),
-        malicious=(1, True),
-        mesh=(3, True), fanout=(3, True), fanout_lastpub=(2, True),
-        backoff=(3, True), graft_tick=(3, True), mesh_active=(3, True),
-        first_message_deliveries=(3, True), mesh_message_deliveries=(3, True),
-        mesh_failure_penalty=(3, True), invalid_message_deliveries=(3, True),
-        behaviour_penalty=(2, True),
-        gater_validate=(1, True), gater_throttle=(1, True),
-        gater_last_throttle=(1, True), gater_deliver=(2, True),
-        gater_duplicate=(2, True), gater_ignore=(2, True),
-        gater_reject=(2, True),
-        msg_topic=(1, False),
-        msg_publish_tick=(1, False), msg_invalid=(1, False),
-        msg_ignored=(1, False), msg_publisher=(1, False),
-        have=(2, True), deliver_tick=(2, True), deliver_from=(2, True),
-        iwant_pending=(2, True), delivered_total=(0, False),
-        halo_overflow=(0, False), fault_flags=(0, False),
-    )
-    assert set(layout) == set(SimState._fields), "layout drifted from SimState"
-    assert n % mesh.devices.size == 0, \
-        f"n_peers {n} must divide the {mesh.devices.size}-device mesh"
-    return SimState(**{f: spec_for(f, nd, ln) for f, (nd, ln) in layout.items()})
+def state_shardings(mesh: Mesh, cfg: SimConfig) -> SimState:
+    """A SimState-shaped pytree of NamedShardings (see
+    :func:`state_partition_specs`)."""
+    n = cfg.n_peers
+    if mesh.devices.size <= 0 or n % mesh.devices.size:
+        # fail loudly by name (repo convention): a non-divisible peer count
+        # would otherwise surface as an opaque sharding error mid-trace
+        raise ValueError(
+            f"state_shardings: n_peers {n} must divide the "
+            f"{mesh.devices.size}-device mesh")
+    specs = state_partition_specs(mesh, cfg)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
 
 
 def shard_state(state: SimState, mesh: Mesh, cfg: SimConfig) -> SimState:
@@ -154,6 +150,56 @@ def make_sharded_step(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
     sharded_step.lower = lambda st, k: _step.lower(
         st, tp, jax.device_put(k, key_sh))
     return sharded_step
+
+
+def make_sharded_run_keys(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
+    """jit a whole chunk — ``lax.scan`` of the sharded step over explicit
+    per-tick keys — with the peer-sharded in/out state, the multi-host
+    execution unit (parallel/multihost.py drives supervised chunks through
+    this instead of ``engine.run_keys``, whose unsharded trace would lower
+    the halo routes away). Same key discipline as ``engine.run_keys``:
+    the caller pre-splits one master key and scans contiguous windows, so
+    the chunked sharded trajectory is bit-identical to the single-scan
+    unsharded one (tests/test_sharding.py, tests/test_multihost.py)."""
+    from ..sim.engine import step
+    from .kernel_context import kernel_mesh
+
+    if cfg.sharded_route not in ("replicated", "halo"):
+        raise ValueError(f"unknown sharded_route {cfg.sharded_route!r}; "
+                         "expected 'replicated' or 'halo'")
+    shardings = state_shardings(mesh, cfg)
+    repl = NamedSharding(mesh, P())         # keys and tp both replicate
+    tp_sh = jax.tree.map(lambda _: repl, tp)
+    peer_axes = tuple(ax for ax in (DCN_AXIS, PEER_AXIS)
+                      if ax in mesh.axis_names)
+
+    # tp rides as a traced argument, not a closure, for the same AOT/
+    # dispatch-agreement reason documented on make_sharded_step
+    @partial(jax.jit,
+             in_shardings=(shardings, tp_sh, repl), out_shardings=shardings)
+    def _run(state: SimState, tp_arg: TopicParams,
+             keys: jax.Array) -> SimState:
+        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
+                         capacity_factor=cfg.halo_capacity_factor):
+            def body(carry, k):
+                return step(carry, cfg, tp_arg, k), None
+            out, _ = jax.lax.scan(body, state, keys)
+        return out
+
+    def sharded_run_keys(state: SimState, keys: jax.Array,
+                         tp_arg: TopicParams | None = None) -> SimState:
+        # tp is a traced argument of the compiled scan, so a caller may
+        # swap it per call (the supervisor run_fn hook hands one) without
+        # invalidating the executable; default is the build-time tp
+        return _run(state, tp if tp_arg is None else tp_arg,
+                    jax.device_put(keys, repl))
+
+    # same stale-id protection as make_sharded_step
+    sharded_run_keys._run = _run
+    _LIVE_STEPS.append(_run)
+    sharded_run_keys.lower = lambda st, keys: _run.lower(
+        st, tp, jax.device_put(keys, repl))
+    return sharded_run_keys
 
 
 from collections import deque                                  # noqa: E402

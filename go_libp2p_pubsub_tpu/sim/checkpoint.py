@@ -120,7 +120,13 @@ def save(path: str, state: SimState, cfg=None) -> None:
                 os.remove(stale)
             except OSError:
                 pass
-    if _HAVE_ORBAX and not path.endswith(".npz"):
+    # multi-process runs take the npz branch even with orbax available:
+    # orbax's save path runs its own cross-host sync barriers, and the
+    # rank-0-ONLY write discipline (parallel/multihost.py — the state is
+    # already gathered host-complete, only the coordinator writes) would
+    # deadlock a collective that the other ranks never enter
+    if _HAVE_ORBAX and not path.endswith(".npz") \
+            and jax.process_count() == 1:
         with ocp.StandardCheckpointer() as ckpt:
             ckpt.save(tmp, jax.device_get(state))
         # the context exit waits out any async write; only a fully
@@ -149,9 +155,17 @@ def save(path: str, state: SimState, cfg=None) -> None:
         _replace_path(side_tmp, _sidecar(path))
 
 
+def _dtype_of(x):
+    """dtype WITHOUT materializing values: a multi-process ``like`` leaf
+    spans non-addressable devices and cannot be fetched — but shape/dtype
+    are metadata."""
+    dt = getattr(x, "dtype", None)
+    return np.dtype(dt) if dt is not None else np.asarray(x).dtype
+
+
 def _validate(field: str, got, want) -> None:
-    g_shape, g_dtype = tuple(np.shape(got)), np.asarray(got).dtype
-    w_shape, w_dtype = tuple(np.shape(want)), np.asarray(want).dtype
+    g_shape, g_dtype = tuple(np.shape(got)), _dtype_of(got)
+    w_shape, w_dtype = tuple(np.shape(want)), _dtype_of(want)
     if g_shape != w_shape or g_dtype != w_dtype:
         raise ValueError(
             f"checkpoint field {field!r}: restored {g_dtype}{list(g_shape)} "

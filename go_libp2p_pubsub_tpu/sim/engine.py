@@ -260,7 +260,8 @@ def delivery_fraction(state: SimState, cfg: SimConfig,
     should = state.subscribed[:, t_m] & alive[None, :] & (state.msg_topic >= 0)[None, :]
     if topic is not None:
         should = should & (state.msg_topic == topic)[None, :]
-    got = state.have & should
+    from .state import unpack_have
+    got = unpack_have(state, cfg.msg_window) & should
     return jnp.sum(got) / jnp.maximum(jnp.sum(should), 1)
 
 

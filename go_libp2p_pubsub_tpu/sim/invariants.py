@@ -141,10 +141,12 @@ def violation_flags(state: SimState, cfg: SimConfig) -> jnp.ndarray:
     f = f | _bit(bad_rng, FLAG_SLOT_GARBAGE)
 
     # delivery bookkeeping: no future/negative stamps, delivered => seen
+    # (the seen-set is stored packed — compare words, 8x fewer bytes)
+    from ..ops.bits import pack_words
     dlv = state.deliver_tick < NEVER
     bad_dlv = jnp.any(dlv & (state.deliver_tick > tick)) \
         | jnp.any(dlv & (state.deliver_tick < 0)) \
-        | jnp.any(dlv & ~state.have)
+        | jnp.any(pack_words(dlv) & ~state.have.T)
     f = f | _bit(bad_dlv, FLAG_DELIVER_FUTURE)
 
     # the halo-route overflow counter folds into the flag word: any routed

@@ -25,6 +25,13 @@ plans attached to the config, PR 4):
 7. ``outage_10k``     — 10k peers + churn/PX; 20% of peers go dark for a
    window and return through the churn/backoff/retention path.
 
+Beyond those, the FRONTIER family (ISSUE 8): ``frontier_250k`` /
+``frontier_500k`` / ``frontier_1m`` — the million-peer trajectory slot.
+Sparse random underlay (vectorized builder), K=32, small topic set, and
+the packed-by-construction sharded configuration; ``frontier_spec``
+exposes the host-side inputs so multi-process runs build only their own
+peer rows (parallel/multihost.py).
+
 Seeds are fixed (314159, the reference's test seed —
 validation_builtin_test.go:25-27) so every scenario is deterministic.
 """
@@ -254,6 +261,76 @@ def outage_10k(n_peers: int = 10_000, k_slots: int = 32, degree: int = 12,
     return cfg, default_topic_params(1), init_state(cfg, topo)
 
 
+# --- frontier family: the million-peer trajectory slot (ROADMAP item 1) --
+# Sparse random underlay (the vectorized builder — topology.sparse at 1M
+# is O(N²) Python), K=32, a small topic set, full scoring, and the
+# packed-by-construction sharded configuration: edge_gather_mode="sort" +
+# sharded_route="halo", so a peer-sharded run exchanges capacity-padded
+# bit-packed buckets over one all_to_all instead of dense [N,K] payload
+# all-gathers (tests/test_hlo_sharded_budget.py pins the budget).
+# Peer counts are powers of two: 8-way-mesh divisible and 128-lane
+# friendly at every shard size.
+
+FRONTIER_NS = {"frontier_250k": 262_144, "frontier_500k": 524_288,
+               "frontier_1m": 1_048_576}
+
+
+def frontier_cfg(n_peers: int, k_slots: int = 32, n_topics: int = 2,
+                 msg_window: int = 64) -> SimConfig:
+    """The frontier SimConfig alone — no topology build. Memory accounting
+    (``state_nbytes``) needs only these shapes, so budget checks price the
+    REAL scenario config without minutes of 1M underlay construction
+    (tests/test_multihost.py's HBM-budget acceptance test)."""
+    return SimConfig(
+        n_peers=n_peers, k_slots=k_slots, n_topics=n_topics,
+        msg_window=msg_window, publishers_per_tick=16, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
+        publish_threshold=-200.0, graylist_threshold=-300.0,
+        edge_gather_mode="sort", sharded_route="halo")
+
+
+def frontier_spec(n_peers: int, k_slots: int = 32, degree: int = 8,
+                  n_topics: int = 2, msg_window: int = 64,
+                  subnet_fraction: float = 0.3,
+                  ) -> tuple[SimConfig, TopicParams, "topology.Topology",
+                             np.ndarray]:
+    """The frontier scenario WITHOUT device state: ``(cfg, tp, topo,
+    subscribed)`` — the host-side inputs a multi-process run feeds to
+    ``parallel.multihost.init_state_local`` so each process builds only
+    its own ``[N/P, ...]`` rows (a 1M-peer state never materializes on
+    one host). Single-process callers use :func:`frontier`, which
+    composes this with ``init_state``."""
+    cfg = frontier_cfg(n_peers, k_slots=k_slots, n_topics=n_topics,
+                       msg_window=msg_window)
+    rng = np.random.default_rng(SEED)
+    subscribed = np.zeros((n_peers, n_topics), dtype=bool)
+    subscribed[:, 0] = True                      # one global topic
+    for t in range(1, n_topics):                 # random subnets
+        subscribed[:, t] = rng.random(n_peers) < subnet_fraction
+    topo = topology.sparse_fast(n_peers, k_slots, degree=degree, seed=SEED)
+    return cfg, default_topic_params(n_topics), topo, subscribed
+
+
+def frontier(n_peers: int, **kw) -> tuple[SimConfig, TopicParams, SimState]:
+    """Single-process frontier constructor (bench lines, reduced-N CPU
+    contract runs); the state is the full ``init_state`` build."""
+    cfg, tp, topo, subscribed = frontier_spec(n_peers, **kw)
+    return cfg, tp, init_state(cfg, topo, subscribed=subscribed)
+
+
+def frontier_250k(n_peers: int = FRONTIER_NS["frontier_250k"], **kw):
+    return frontier(n_peers, **kw)
+
+
+def frontier_500k(n_peers: int = FRONTIER_NS["frontier_500k"], **kw):
+    return frontier(n_peers, **kw)
+
+
+def frontier_1m(n_peers: int = FRONTIER_NS["frontier_1m"], **kw):
+    return frontier(n_peers, **kw)
+
+
 # --- small-N attack family (scripts/sweep_scores.py grid cells) ----------
 # The same adversarial shapes as their big siblings, sized so a
 # weight-variant × seed fleet of them batches into one vmapped scan on any
@@ -293,4 +370,7 @@ SCENARIOS = {
     "sybil_small": sybil_small,
     "partition_small": partition_small,
     "outage_small": outage_small,
+    "frontier_250k": frontier_250k,
+    "frontier_500k": frontier_500k,
+    "frontier_1m": frontier_1m,
 }

@@ -103,7 +103,19 @@ class SimState(NamedTuple):
                                       #   (dropped + seen, no P4, gater
                                       #   counts ignore — validation.go:344-370)
     msg_publisher: jnp.ndarray        # [M] int32 origin peer, -1 idle
-    have: jnp.ndarray                 # [N, M] bool (seen/validated)
+    have: jnp.ndarray                 # [N, ceil(M/32)] u32 seen-set, bit
+                                      #   m%32 of word m//32 (ops/bits.py
+                                      #   little-endian order, pack_bool
+                                      #   compatible). Stored PACKED — the
+                                      #   hop loop consumes [W, N] words
+                                      #   anyway (have.T), so the per-tick
+                                      #   pack_words/unpack_words round
+                                      #   trip is gone and the plane is 8x
+                                      #   smaller than the old [N, M] bool
+                                      #   (the 1M-peer budget line in
+                                      #   PERF_MODEL.md). Read it through
+                                      #   unpack_have(); set single bits
+                                      #   with have_set_bit()
     deliver_tick: jnp.ndarray         # [N, M] int32, NEVER if not delivered
     deliver_from: jnp.ndarray         # [N, M] int32 neighbor slot the first
                                       #   delivery came from, -1 (self/none);
@@ -128,6 +140,91 @@ class SimState(NamedTuple):
                                       #   suspect). Sticky across the scan;
                                       #   emitted with every bench metric
                                       #   line and trace export
+
+
+def n_msg_words(cfg: SimConfig) -> int:
+    """Words of the packed per-peer message seen-set (``have``)."""
+    return (cfg.msg_window + 31) // 32
+
+
+def unpack_have(state: SimState, m: int) -> jnp.ndarray:
+    """The seen-set as [N, M] bool (census/observability reads; the hot
+    path consumes the packed words directly)."""
+    from ..ops.bits import unpack_words
+    return unpack_words(state.have.T, m)
+
+
+def have_set_bit(have: jnp.ndarray, peer, slot) -> jnp.ndarray:
+    """``have`` with bit ``slot`` of row ``peer`` set (trace replay's
+    single-delivery updates; indices may be traced scalars)."""
+    w = jnp.asarray(slot) // 32
+    bit = jnp.uint32(1) << (jnp.asarray(slot) % 32).astype(jnp.uint32)
+    return have.at[peer, w].set(have[peer, w] | bit)
+
+
+def state_spec(cfg: SimConfig) -> dict:
+    """field -> (shape, dtype, peer_major): the single source of truth for
+    the SimState layout. ``peer_major`` fields shard their leading N axis
+    over the peer mesh (parallel/sharding.state_shardings); the rest
+    (message tables, scalars) replicate. state_nbytes prices exactly these
+    shapes; init builds them."""
+    n, k, t, m = cfg.n_peers, cfg.k_slots, cfg.n_topics, cfg.msg_window
+    w = n_msg_words(cfg)
+    i32, f32, b, u32 = np.int32, np.float32, np.bool_, np.uint32
+    spec = dict(
+        tick=((), i32, False),
+        neighbors=((n, k), i32, True), connected=((n, k), b, True),
+        outbound=((n, k), b, True), reverse_slot=((n, k), i32, True),
+        subscribed=((n, t), b, True), nbr_subscribed=((n, t, k), b, True),
+        disconnect_tick=((n, k), i32, True), direct=((n, k), b, True),
+        ip_group=((n,), i32, True), app_score=((n,), f32, True),
+        malicious=((n,), b, True),
+        mesh=((n, t, k), b, True), fanout=((n, t, k), b, True),
+        fanout_lastpub=((n, t), i32, True), backoff=((n, t, k), i32, True),
+        graft_tick=((n, t, k), i32, True), mesh_active=((n, t, k), b, True),
+        first_message_deliveries=((n, t, k), f32, True),
+        mesh_message_deliveries=((n, t, k), f32, True),
+        mesh_failure_penalty=((n, t, k), f32, True),
+        invalid_message_deliveries=((n, t, k), f32, True),
+        behaviour_penalty=((n, k), f32, True),
+        gater_validate=((n,), f32, True), gater_throttle=((n,), f32, True),
+        gater_last_throttle=((n,), i32, True),
+        gater_deliver=((n, k), f32, True),
+        gater_duplicate=((n, k), f32, True),
+        gater_ignore=((n, k), f32, True), gater_reject=((n, k), f32, True),
+        msg_topic=((m,), i32, False), msg_publish_tick=((m,), i32, False),
+        msg_invalid=((m,), b, False), msg_ignored=((m,), b, False),
+        msg_publisher=((m,), i32, False),
+        have=((n, w), u32, True), deliver_tick=((n, m), i32, True),
+        deliver_from=((n, m), i32, True), iwant_pending=((n, m), i32, True),
+        delivered_total=((), f32, False), halo_overflow=((), i32, False),
+        fault_flags=((), u32, False),
+    )
+    if set(spec) != set(SimState._fields):
+        raise RuntimeError("state_spec drifted from SimState._fields")
+    return spec
+
+
+def state_nbytes(cfg: SimConfig, n_dev: int = 1) -> dict:
+    """Host-side accounting of the SimState HBM footprint: per-field bytes,
+    the global total, and the per-shard bytes on an ``n_dev``-way peer
+    sharding (peer-major fields divide their leading N; message tables and
+    scalars replicate onto every shard). This is the number a frontier
+    config must fit under the per-chip HBM budget BEFORE anything is
+    allocated — bench.py records it next to the measured peak."""
+    n = cfg.n_peers
+    if n_dev <= 0 or n % n_dev:
+        raise ValueError(
+            f"state_nbytes: n_peers={n} must divide evenly over "
+            f"n_dev={n_dev} (the peer sharding raises the same)")
+    fields, total, per_shard = {}, 0, 0
+    for f, (shape, dtype, peer_major) in state_spec(cfg).items():
+        nbytes = int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+        fields[f] = nbytes
+        total += nbytes
+        per_shard += nbytes // n_dev if peer_major else nbytes
+    return {"total": total, "per_shard": per_shard, "n_dev": n_dev,
+            "fields": fields}
 
 
 def init_state(cfg: SimConfig, topo: Topology,
@@ -164,13 +261,23 @@ def refresh_nbr_subscribed(state: SimState) -> SimState:
     return state._replace(nbr_subscribed=view)
 
 
-@partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg", "n_rows"))
 def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
-                 subscribed, ip_group, app_score, malicious) -> SimState:
-    n, k, t, m = cfg.n_peers, cfg.k_slots, cfg.n_topics, cfg.msg_window
+                 subscribed, ip_group, app_score, malicious,
+                 nbr_subscribed=None, n_rows: int | None = None) -> SimState:
+    # n_rows < n_peers builds a host-local shard: only that many peer rows
+    # of every peer-major plane (parallel/multihost.init_state_local), with
+    # the receiver view arriving PRECOMPUTED (it indexes the full
+    # subscription table, which only exists host-side there)
+    n = cfg.n_peers if n_rows is None else n_rows
+    k, t, m = cfg.k_slots, cfg.n_topics, cfg.msg_window
     f32 = lambda *shape: jnp.zeros(shape, jnp.float32)  # noqa: E731
     i32 = lambda *shape, fill=0: jnp.full(shape, fill, jnp.int32)  # noqa: E731
     b = lambda *shape: jnp.zeros(shape, bool)  # noqa: E731
+    if nbr_subscribed is None:
+        nbr_subscribed = jnp.transpose(
+            subscribed[jnp.clip(neighbors, 0, cfg.n_peers - 1)], (0, 2, 1)) \
+            & (neighbors >= 0)[:, None, :]
     return SimState(
         tick=jnp.int32(0),
         neighbors=neighbors,
@@ -178,9 +285,7 @@ def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
         outbound=outbound,
         reverse_slot=reverse_slot,
         subscribed=subscribed,
-        nbr_subscribed=jnp.transpose(
-            subscribed[jnp.clip(neighbors, 0, n - 1)], (0, 2, 1))
-        & (neighbors >= 0)[:, None, :],
+        nbr_subscribed=nbr_subscribed,
         disconnect_tick=i32(n, k, fill=int(NEVER)),
         direct=b(n, k),
         ip_group=ip_group,
@@ -209,7 +314,7 @@ def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
         msg_invalid=b(m),
         msg_ignored=b(m),
         msg_publisher=i32(m, fill=-1),
-        have=b(n, m),
+        have=jnp.zeros((n, n_msg_words(cfg)), jnp.uint32),
         deliver_tick=i32(n, m, fill=int(NEVER)),
         deliver_from=i32(n, m, fill=-1),
         iwant_pending=i32(n, m, fill=-1),

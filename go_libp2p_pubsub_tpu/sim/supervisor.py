@@ -116,6 +116,33 @@ class SupervisorConfig:
     sinks: tuple = ()                 # trace sinks hard_flush()ed on failure
     # injectable for tests/smoke (real backoff sleeps are pointless there)
     sleep: Callable[[float], None] = time.sleep
+    # --- multi-process hooks (parallel/multihost.py) ---
+    # custom chunk runner (state, exec_cfg, tp, keys) -> state, replacing
+    # engine.run_keys: the multihost launcher dispatches the SHARDED scan
+    # (parallel.sharding.make_sharded_run_keys) here, whose trace keeps
+    # the halo routes; the degrade ladder still swaps exec_cfg modes, so
+    # the runner must honor the config it is handed. With >1 process a
+    # chunk failure is FATAL (no rank-local retry/degrade — the ladder
+    # cannot be rank-symmetric; see supervised_run): recovery is
+    # relaunch-all-ranks + checkpoint resume
+    run_fn: Callable | None = None
+    # state -> host-complete state for checkpoint/crash writes. COLLECTIVE
+    # when set (multihost.gather_state all-gathers non-addressable
+    # shards): every process must reach the checkpoint boundary, while
+    # only write_files=True processes (rank 0) touch the filesystem
+    state_to_host: Callable | None = None
+    # host-complete state -> this process's sharded state (resume path:
+    # every process restores rank 0's checkpoint from the shared
+    # filesystem, slices its rows, and re-assembles)
+    state_from_host: Callable | None = None
+    # False on non-coordinator ranks: checkpoint/crash-dump writes are
+    # skipped (rank-0-only write discipline), resume still READS
+    write_files: bool = True
+    # window-bounded execution: stop cleanly after this many successful
+    # chunks (checkpoint written if a dir is set) and return the partial
+    # state — run as much as fits a bounded TPU window, resume the SAME
+    # (key, n_ticks) schedule next window. None = run to n_ticks.
+    max_chunks: int | None = None
 
     @staticmethod
     def from_env(**overrides) -> "SupervisorConfig":
@@ -151,6 +178,16 @@ class SupervisorReport:
 
     def log(self, event: str, **info) -> None:
         self.events.append({"event": event, **info})
+
+
+def _fetch_scalar(x) -> np.ndarray:
+    """Host value of a (possibly multi-process global) scalar array: a
+    replicated leaf of a multihost state is not fully addressable, so
+    ``np.asarray`` raises — read the local replica instead (every process
+    holds the same value by construction)."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    return np.asarray(x.addressable_shards[0].data)
 
 
 def _key_data(keys) -> np.ndarray:
@@ -226,7 +263,13 @@ def _try_resume(sup: SupervisorConfig, cfg: SimConfig, like: SimState,
         except ValueError as e:     # CheckpointCorrupt or mismatch
             report.log("resume_skip", path=path, error=str(e)[:200])
             continue
-        done = int(np.asarray(st.tick)) - start_tick
+        if sup.state_from_host is not None:
+            # multihost: the checkpoint restores host-complete; every
+            # process re-slices its rows and re-assembles the global
+            # sharded state (collective — all ranks walk the same
+            # shared-filesystem checkpoint list, so they agree)
+            st = sup.state_from_host(st)
+        done = int(_fetch_scalar(st.tick)) - start_tick
         if done != tick - start_tick:   # name/state tick disagreement
             report.log("resume_skip", path=path,
                        error=f"state tick {done + start_tick} != {tick}")
@@ -281,7 +324,7 @@ def _write_crash_dump(sup: SupervisorConfig, cfg: SimConfig,
     dump = os.path.join(base, f"crash_{stamp}_p{os.getpid()}")
     os.makedirs(dump, exist_ok=True)
     checkpoint.save(os.path.join(dump, "last_good"), last_good, cfg=cfg)
-    flags = int(np.asarray(last_good.fault_flags))
+    flags = int(_fetch_scalar(last_good.fault_flags))
     meta = {
         "error": str(err)[:2000],
         "error_type": type(err).__name__,
@@ -377,7 +420,8 @@ def _run_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
     """One chunk attempt: compile (its own deadline) then run (the
     watchdog deadline)."""
     exe = None
-    if not traced and exec_cfg.invariant_mode != "raise":
+    if not traced and exec_cfg.invariant_mode != "raise" \
+            and sup.run_fn is None:
         exe = _with_deadline(
             lambda: _chunk_executable(exec_cfg, state, tp, keys_chunk),
             sup.compile_deadline_s, "compile", info)
@@ -385,7 +429,11 @@ def _run_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
     def worker():
         if chunk_hook is not None:      # test/smoke fault-injection point
             chunk_hook(info)
-        if traced:
+        if sup.run_fn is not None:
+            # custom chunk runner (multihost sharded scan); it owns its
+            # own compile caching, so first use rides the run deadline
+            out = sup.run_fn(state, exec_cfg, tp, keys_chunk)
+        elif traced:
             from .trace_export import run_traced
             out, evs = run_traced(state, exec_cfg, tp, None, 0,
                                   health_out=chunk_health, keys=keys_chunk)
@@ -400,7 +448,7 @@ def _run_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
         # real sync by value fetch: async dispatch (and the axon tunnel,
         # which block_until_ready does not block through) must not let a
         # wedged chunk slide past the deadline
-        np.asarray(out.tick)
+        _fetch_scalar(out.tick)
         return out
 
     return _with_deadline(worker, sup.deadline_s, "chunk", info)
@@ -430,7 +478,7 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
     """
     sup = sup or SupervisorConfig.from_env()
     report = SupervisorReport()
-    start_tick = int(np.asarray(state.tick))
+    start_tick = int(_fetch_scalar(state.tick))
     all_keys = jax.random.split(key, n_ticks)   # run's exact discipline
 
     done = 0
@@ -443,6 +491,18 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
     every = sup.checkpoint_every_ticks or chunk_ticks
     next_ckpt = done + every
     failures = 0            # consecutive; reset on every successful chunk
+    # multihost: the newest HOST-COMPLETE copy and the tick offset it was
+    # gathered at, refreshed at every checkpoint-cadence boundary (where
+    # state_to_host — a collective — legally runs on every rank; NEVER in
+    # the error path, where a one-rank failure would deadlock it). The
+    # crash path dumps THIS with its key window re-anchored to the
+    # gathered tick, so last_good + keys stay a replayable pair even when
+    # the gather is chunks old.
+    last_host_state, last_host_done = None, done
+    if sup.state_to_host is not None:
+        # run-start gather: a first-window crash still has a dumpable
+        # copy (and a run with no checkpoint_dir dumps at all)
+        last_host_state = sup.state_to_host(state)
     while done < n_ticks:
         this_chunk = min(chunk_ticks, n_ticks - done)
         keys_chunk = all_keys[done:done + this_chunk]
@@ -456,12 +516,33 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         except Exception as e:
             _hard_flush(sup.sinks)
             failures += 1
-            if _is_invariant_trip(e) or failures > sup.max_retries:
+            # a MULTI-PROCESS run fails fast: the retry/degrade ladder is
+            # rank-LOCAL, so one rank re-dispatching a degraded (different
+            # collective sequence) or re-sized program while its peers sit
+            # in the original chunk's collectives would deadlock or pair
+            # wrong collectives. Recovery that IS rank-symmetric by
+            # construction: crash, relaunch every rank, resume from the
+            # last checkpoint (scripts/run_multihost.py).
+            multiproc = sup.run_fn is not None and jax.process_count() > 1
+            if _is_invariant_trip(e) or multiproc \
+                    or failures > sup.max_retries:
                 # invariant trips are never retried: the trajectory itself
                 # is poisoned and would trip again on the same keys
-                dump = _write_crash_dump(sup, cfg, state, keys_chunk,
-                                         start_tick, done, this_chunk,
-                                         n_ticks, e, report)
+                dump = None
+                if sup.write_files and sup.state_to_host is None:
+                    dump = _write_crash_dump(sup, cfg, state,
+                                             keys_chunk, start_tick, done,
+                                             this_chunk, n_ticks, e, report)
+                elif sup.write_files and last_host_state is not None:
+                    # the gathered copy may be chunks old: re-anchor the
+                    # dumped window to ITS tick so replay_crash.py feeds
+                    # last_good exactly the keys that advance it into the
+                    # failure
+                    w0, w1 = last_host_done, done + this_chunk
+                    dump = _write_crash_dump(sup, cfg, last_host_state,
+                                             all_keys[w0:w1], start_tick,
+                                             w0, w1 - w0, n_ticks, e,
+                                             report)
                 report.crash_dump = dump
                 raise SupervisorCrash(
                     f"supervised run gave up at tick {start_tick + done} "
@@ -488,12 +569,35 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
             events_out.extend(chunk_events)
         if health_out is not None:
             health_out.extend(chunk_health)
-        if sup.checkpoint_dir and (done >= next_ckpt or done >= n_ticks):
-            path = _ckpt_path(sup.checkpoint_dir, start_tick + done)
-            os.makedirs(sup.checkpoint_dir, exist_ok=True)
-            checkpoint.save(path, state, cfg=cfg)   # crash-atomic
-            report.checkpoints.append(path)
-            report.log("checkpoint", tick=start_tick + done, path=path)
-            _prune_checkpoints(sup.checkpoint_dir, sup.keep_checkpoints)
+        window_end = sup.max_chunks is not None \
+            and report.chunks_run >= sup.max_chunks and done < n_ticks
+        # a window end is ALWAYS a boundary: the max_chunks contract says
+        # "stop cleanly (checkpoint written if a dir is set)" — without
+        # this, a stop off the checkpoint cadence would discard the whole
+        # window's progress on resume
+        at_boundary = done >= next_ckpt or done >= n_ticks or window_end
+        if at_boundary and sup.state_to_host is not None:
+            # collective on EVERY rank (multihost.gather_state) at the
+            # checkpoint cadence even with no checkpoint_dir — the crash
+            # dump's freshness rides this; only write_files ranks then
+            # touch the filesystem
+            last_host_state, last_host_done = sup.state_to_host(state), done
+        if at_boundary and sup.checkpoint_dir:
+            to_save = state if sup.state_to_host is None else last_host_state
+            if sup.write_files:
+                path = _ckpt_path(sup.checkpoint_dir, start_tick + done)
+                os.makedirs(sup.checkpoint_dir, exist_ok=True)
+                checkpoint.save(path, to_save, cfg=cfg)   # crash-atomic
+                report.checkpoints.append(path)
+                report.log("checkpoint", tick=start_tick + done, path=path)
+                _prune_checkpoints(sup.checkpoint_dir, sup.keep_checkpoints)
+        if at_boundary:
             next_ckpt = done + every
+        if window_end:
+            # clean window end: the caller resumes the same (key, n_ticks)
+            # schedule later — the per-tick keys are a function of BOTH,
+            # so a resumed run must re-request the full n_ticks
+            report.log("window_end", chunks=report.chunks_run,
+                       tick=start_tick + done)
+            break
     return state, report

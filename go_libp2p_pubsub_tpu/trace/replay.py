@@ -414,15 +414,17 @@ def _make_branches(cfg: SimConfig, tp: TopicParams):
             st._replace(subscribed=st.subscribed.at[a, c].set(False)))
 
     def publish_op(st, a, b, c):
+        from ..sim.state import have_set_bit
         return st._replace(
             msg_topic=st.msg_topic.at[b].set(c),
             msg_publish_tick=st.msg_publish_tick.at[b].set(st.tick),
-            have=st.have.at[a, b].set(True),
+            have=have_set_bit(st.have, a, b),
             deliver_tick=st.deliver_tick.at[a, b].set(st.tick))
 
     def deliver(st, a, b, c):
+        from ..sim.state import have_set_bit
         return st._replace(
-            have=st.have.at[a, b].set(True),
+            have=have_set_bit(st.have, a, b),
             deliver_tick=st.deliver_tick.at[a, b].set(
                 jnp.minimum(st.deliver_tick[a, b], st.tick)))
 

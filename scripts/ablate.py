@@ -21,7 +21,7 @@ from go_libp2p_pubsub_tpu.ops.gater import gater_decay
 from go_libp2p_pubsub_tpu.ops.heartbeat import heartbeat, edge_gather
 from go_libp2p_pubsub_tpu.ops.propagate import (
     _edge_forward_mask, _edge_topic_bits, forward_tick, publish)
-from go_libp2p_pubsub_tpu.ops.bits import gather_words_rows, pack_words, n_words
+from go_libp2p_pubsub_tpu.ops.bits import gather_words_rows, n_words
 from go_libp2p_pubsub_tpu.ops.score_ops import compute_scores, decay_counters
 from go_libp2p_pubsub_tpu.sim import scenarios
 from go_libp2p_pubsub_tpu.sim.engine import step
@@ -155,7 +155,8 @@ def main():
     nbr = jnp.clip(st.neighbors, 0, n - 1)
 
     def ph_gather(s, k_):
-        hv = pack_words(s.have)
+        hv = s.have.T                       # seen-set stored packed
+
         g = gather_words_rows(hv, nbr, m)     # [W,K,N] the per-hop gather
         return s._replace(behaviour_penalty=s.behaviour_penalty
                           + 0.0 * g.sum().astype(jnp.float32))
@@ -214,7 +215,7 @@ def main():
         re_ = resolve_mode(mode, jnp.uint32, n, k, have_sort_key=True)
 
         def ph_g(s, k_, mode=mode):
-            hv = pack_words(s.have)
+            hv = s.have.T                   # seen-set stored packed
             return fold(s, gather_words_rows(hv, nbr, m, mode,
                                              sort_key=sk_w))
         scan_time(ph_g, st, iters,

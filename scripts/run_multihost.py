@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Multi-process launcher: one supervised sharded run per host.
+
+Each participating host runs ONE copy of this script with the same
+coordinator address and its own rank; together they form the
+``make_mesh_2d`` (dcn × peers) mesh, each host building ONLY its
+contiguous ``[N/P, ...]`` block of the SimState
+(``parallel.multihost.init_state_local`` — a 1M-peer state never
+materializes on one host), assembled into the global sharded state via
+``host_local_array_to_global_array`` and advanced in supervised chunks of
+the SHARDED scan (``parallel.sharding.make_sharded_run_keys``, halo
+routes intact). Rank 0 alone writes checkpoints, the journal, and metric
+lines; checkpoint *gathers* are collective, so every rank participates in
+the boundary (sim/supervisor.py ``state_to_host``/``write_files``).
+
+Trajectory contract: bit-identical to the single-process
+``engine.run(state, cfg, tp, PRNGKey(seed), ticks)`` at any process
+count (tests/test_multihost.py pins the 2-process CPU run).
+
+Typical 2-host invocation (same for both, differing only in rank):
+
+    GRAFT_COORDINATOR=host0:9911 GRAFT_NUM_PROCESSES=2 \
+    GRAFT_PROCESS_ID=<0|1> python scripts/run_multihost.py \
+        --scenario frontier_1m --ticks 600 \
+        --checkpoint-dir /shared/ckpt --journal /shared/journal.jsonl
+
+CPU smoke (localhost, two terminals or a driver spawning both):
+
+    JAX_PLATFORMS=cpu python scripts/run_multihost.py \
+        --coordinator localhost:9911 --num-processes 2 --process-id <r> \
+        --scenario frontier_250k --n 128 --ticks 4 --dump-state /tmp/out.npz
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--coordinator", default=None,
+                    help="host:port of rank 0 (or $GRAFT_COORDINATOR)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
+    ap.add_argument("--scenario", default="frontier_250k",
+                    help="frontier family member (frontier_250k/500k/1m)")
+    ap.add_argument("--n", type=int, default=None,
+                    help="peer-count override (smoke runs)")
+    ap.add_argument("--ticks", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chunk-ticks", type=int, default=None)
+    ap.add_argument("--max-chunks", type=int, default=None,
+                    help="window-bounded execution: stop cleanly after N "
+                         "chunks; a later invocation with the SAME "
+                         "--ticks/--seed resumes from the checkpoint")
+    ap.add_argument("--checkpoint-dir", default=None,
+                    help="SHARED filesystem path (all ranks read, rank 0 "
+                         "writes)")
+    ap.add_argument("--journal", default=None,
+                    help="rank-0 JSONL journal of run/chunk outcomes")
+    ap.add_argument("--dump-state", default=None,
+                    help="rank-0 .npz of the final host-complete state "
+                         "(parity smoke)")
+    args = ap.parse_args()
+
+    from go_libp2p_pubsub_tpu.parallel import multihost
+    # MUST precede any backend touch (device discovery happens at init)
+    multihost.initialize(args.coordinator, args.num_processes,
+                         args.process_id)
+
+    import jax
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu.parallel.sharding import (
+        make_mesh_2d, make_sharded_run_keys)
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    from go_libp2p_pubsub_tpu.sim.state import state_nbytes
+    from go_libp2p_pubsub_tpu.sim.supervisor import (
+        SupervisorConfig, supervised_run)
+
+    n_proc = jax.process_count()
+    rank = jax.process_index()
+    coord = multihost.is_coordinator()
+
+    if not args.scenario.startswith("frontier"):
+        raise SystemExit(
+            f"--scenario {args.scenario!r}: the multihost launcher drives "
+            "the frontier family (frontier_250k/500k/1m), whose spec-level "
+            "constructor builds host-local shards; other scenarios "
+            "construct full device states")
+    n = args.n or scenarios.FRONTIER_NS[args.scenario]
+    cfg, tp, topo, subscribed = scenarios.frontier_spec(n)
+
+    # hosts-major device order so each host's contiguous peer block lands
+    # on its own chips (make_mesh_2d layout contract)
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    mesh = make_mesh_2d(n_proc, devs)
+    budget = state_nbytes(cfg, len(devs))
+    if coord:
+        print(json.dumps({
+            "info": "multihost run", "scenario": args.scenario, "n_peers": n,
+            "processes": n_proc, "devices": len(devs),
+            "state_nbytes_total": budget["total"],
+            "state_nbytes_per_shard": budget["per_shard"]}), flush=True)
+
+    local = multihost.init_state_local(cfg, topo, rank, n_proc,
+                                       subscribed=subscribed)
+    state = multihost.global_state(local, mesh, cfg)
+
+    # sharded chunk runner: one compiled scan per (exec_cfg, chunk shape),
+    # cached so retries and steady-state chunks re-dispatch the same
+    # executable (the degrade ladder swaps exec_cfg, landing a new entry)
+    _runs: dict = {}
+
+    def run_fn(st, exec_cfg, tp_arg, keys):
+        # the cache keys on exec_cfg (what the degrade ladder swaps); the
+        # TopicParams the supervisor hands us ride as a per-call traced
+        # argument, so a cached runner can never serve a stale tp
+        fn = _runs.get(exec_cfg)
+        if fn is None:
+            fn = _runs[exec_cfg] = make_sharded_run_keys(mesh, exec_cfg,
+                                                         tp_arg)
+        return fn(st, keys, tp_arg)
+
+    def state_from_host(host_state):
+        loc = multihost.local_rows_state(host_state, cfg, rank, n_proc)
+        return multihost.global_state(loc, mesh, cfg)
+
+    sup = SupervisorConfig.from_env(
+        scenario=args.scenario,
+        run_fn=run_fn,
+        state_to_host=multihost.gather_state,
+        state_from_host=state_from_host,
+        write_files=coord,
+        **({"chunk_ticks": args.chunk_ticks} if args.chunk_ticks else {}),
+        **({"max_chunks": args.max_chunks} if args.max_chunks else {}),
+        **({"checkpoint_dir": args.checkpoint_dir}
+           if args.checkpoint_dir else {}),
+    )
+
+    t0 = time.perf_counter()
+    state, report = supervised_run(state, cfg, tp,
+                                   jax.random.PRNGKey(args.seed),
+                                   args.ticks, sup)
+    wall = time.perf_counter() - t0
+
+    # final host-complete copy: collective gather on every rank, writes on
+    # rank 0 only (the same discipline the checkpoint boundaries use)
+    host = multihost.gather_state(state)
+    if coord:
+        from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction
+        from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+        flags = int(np.asarray(host.fault_flags))
+        line = {
+            "metric": f"multihost_run@{args.scenario}"
+                      f"[{jax.devices()[0].platform}x{n_proc}p]",
+            "n_peers": n, "ticks": args.ticks, "wall_s": round(wall, 2),
+            "hbps": round(args.ticks / max(wall, 1e-9), 3),
+            "chunks": report.chunks_run, "retries": report.retries,
+            "resumed_from": report.resumed_from,
+            "delivery_fraction": round(
+                float(delivery_fraction(host, cfg)), 4),
+            "fault_flags": flags, "fault_flag_names": decode_flags(flags),
+            "state_nbytes_per_shard": budget["per_shard"],
+        }
+        print(json.dumps(line), flush=True)
+        if args.journal:
+            with open(args.journal, "a") as f:
+                f.write(json.dumps(line) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        if args.dump_state:
+            np.savez(args.dump_state,
+                     **{f: np.asarray(v) for f, v in
+                        zip(host._fields, host)})
+    # all ranks exit together (the gather above already synchronized)
+
+
+if __name__ == "__main__":
+    main()

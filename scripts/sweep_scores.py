@@ -109,7 +109,9 @@ def _recovery_fraction(state, cfg, heal_tick: int) -> float | None:
     denom = int(jnp.sum(should))
     if denom == 0:
         return None
-    return float(jnp.sum(state.have & should) / denom)
+    from go_libp2p_pubsub_tpu.sim.state import unpack_have
+    have = unpack_have(state, cfg.msg_window)
+    return float(jnp.sum(have & should) / denom)
 
 
 def _heal_tick(cfg) -> int:

@@ -49,13 +49,15 @@ def test_bench_emits_driver_parseable_json():
 
 
 def test_full_suite_fits_budget_at_reduced_n():
-    """All 9 configs at reduced N must complete, rc=0, within
+    """All 12 configs at reduced N must complete, rc=0, within
     BENCH_TOTAL_BUDGET on CPU — the structural guarantee that the r5
     timeout (rc=124, headline line missing) cannot recur. Every metric
     line must be present, the 100k_default headline first AND last.
     GRAFT_FLEET_SIZE=4 keeps the batched-fleet line (ISSUE 7) at
-    contract scale; its label reflects the reduced shape."""
-    budget = 600
+    contract scale; the frontier family (ISSUE 8) rides the same
+    BENCH_MAX_N cap with capped-N labels — reduced runs can never bank
+    under the full frontier labels."""
+    budget = 900
     res, metrics, _, elapsed = _run_bench({
         "BENCH_N": "256", "BENCH_MAX_N": "256", "BENCH_TICKS": "2",
         "BENCH_REPEATS": "1", "BENCH_TOTAL_BUDGET": str(budget),
@@ -63,17 +65,21 @@ def test_full_suite_fits_budget_at_reduced_n():
         timeout=budget + 120)
     assert res.returncode == 0, res.stderr[-500:]
     assert elapsed < budget, f"suite blew the budget: {elapsed:.0f}s"
-    # 9 configs + the headline re-emit
-    assert len(metrics) == 10, [m["metric"] for m in metrics]
+    # 12 configs + the headline re-emit
+    assert len(metrics) == 13, [m["metric"] for m in metrics]
     for m in metrics:
         assert m["value"] > 0, m
+        # every record carries the memory accounting (ISSUE 8 satellite)
+        assert m["state_nbytes"] > 0 and "memory_source" in m, m
     assert _is_headline(metrics[0]["metric"])
     assert _is_headline(metrics[-1]["metric"])
     names = {m["metric"].split("@")[1].split("[")[0] for m in metrics}
     assert names == {"0k_default", "1k_single_topic", "fleet_4x0k",
                      "10k_beacon", "50k_churn_gater_px", "100k_sybil20",
                      "100k_floodsub", "100k_randomsub",
-                     "100k_gossipsub_sweep"}
+                     "100k_gossipsub_sweep",
+                     "frontier_250k_capped_0k", "frontier_500k_capped_0k",
+                     "frontier_1m_capped_0k"}
     fleet = next(m for m in metrics if "fleet_4x0k" in m["metric"])
     assert fleet["fleet_size"] == 4
     assert fleet["per_member_hbps"] > 0

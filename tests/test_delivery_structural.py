@@ -71,7 +71,8 @@ class TestSybilDeliveryDecomposition:
         mt = np.asarray(st.msg_topic)
         mp = np.asarray(st.msg_publish_tick)
         inv = np.asarray(st.msg_invalid)
-        have = np.asarray(st.have)
+        from go_libp2p_pubsub_tpu.sim.state import unpack_have
+        have = np.asarray(unpack_have(st, cfg.msg_window))
         sub = np.asarray(st.subscribed)
         alive = (tick - mp) < cfg.history_length
         # like the beacon test: skip messages young enough to be
@@ -105,7 +106,8 @@ class TestBeaconDeliveryIsStructural:
         msg_topic = np.asarray(st.msg_topic)
         msg_pub = np.asarray(st.msg_publish_tick)
         msg_from = np.asarray(st.msg_publisher)
-        have = np.asarray(st.have)
+        from go_libp2p_pubsub_tpu.sim.state import unpack_have
+        have = np.asarray(unpack_have(st, cfg.msg_window))
         sub = np.asarray(st.subscribed)
         nbr = np.asarray(st.neighbors)
         conn = np.asarray(st.connected).astype(bool)

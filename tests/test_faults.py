@@ -298,8 +298,9 @@ class TestSentinel:
     def test_deliver_future_flagged(self):
         cfg, tp, st = _cfg()
         # slot 10 is not recycled at tick 0 (publish rotates slots 0..P-1)
+        from go_libp2p_pubsub_tpu.sim.state import have_set_bit
         bad = st._replace(deliver_tick=st.deliver_tick.at[0, 10].set(500),
-                          have=st.have.at[0, 10].set(True))
+                          have=have_set_bit(st.have, 0, 10))
         out = step_jit(bad, cfg, tp, jax.random.PRNGKey(0))
         assert int(out.fault_flags) & invariants.FLAG_DELIVER_FUTURE
 

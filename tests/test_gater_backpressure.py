@@ -147,7 +147,9 @@ class TestIgnoreVerdict:
         dlv = np.asarray(st.deliver_tick) < 2**30
         assert dlv[:, live].sum(axis=0).max() <= 1
         # but neighbors did SEE them (marked seen)
-        assert np.asarray(st.have)[:, live].sum() > dlv[:, live].sum()
+        from go_libp2p_pubsub_tpu.sim.state import unpack_have
+        have = np.asarray(unpack_have(st, cfg.msg_window))
+        assert have[:, live].sum() > dlv[:, live].sum()
         assert float(jnp.sum(st.invalid_message_deliveries)) == 0.0
         assert float(jnp.sum(st.gater_ignore)) > 0.0
 

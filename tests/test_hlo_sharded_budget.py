@@ -1,0 +1,110 @@
+"""HLO-inspection guard for the SHARDED step (SNIPPETS [1]/[2] grep-the-IR
+pattern, the sharded sibling of test_hlo_gatherfree.py): with
+``edge_gather_mode="sort"`` + ``sharded_route="halo"`` the compiled
+8-device step contains NO all-gather or dynamic-slice whose result exceeds
+the packed bit-table budget — the property that keeps the per-tick
+exchange at ~bit-table bytes over ICI instead of dense [N,K]/[N,T,K]
+payload all-gathers (PERF_MODEL's ~10 MB/tick packed vs ~140 MB/tick
+dense at 1M peers). If a dense collective sneaks back into any seam (a
+new exchange bypassing the halo route, a partitioner regression), this
+fails by op.
+
+Budget: 4·N·⌈K/32⌉ 32-bit words of result elements. The legitimate
+collectives stay well under it — the replicated [W, N] message tables
+(W·N ≤ 2N at the bench window), the [N, T] subscribed gather for
+publisher choice (T·N), per-bucket all_to_all sends (capacity-padded
+local shapes) — while any replicated global sort or dense payload
+all-gather carries N·K = 8N+ elements and trips it. The threshold is
+checked against a positive control: the ``replicated`` route at the same
+shape MUST trip, so the grep can never silently match nothing.
+
+The guard config deliberately turns on every plane that exchanges
+cross-peer state — scoring, churn + PX, flood publish, the gater — so
+each wired seam (heartbeat packed exchange, forward/gossip word routes,
+churn symmetric bits, flood score exchange) is inside the lowered
+program.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.parallel.sharding import (
+    make_mesh, make_sharded_step, shard_state)
+from go_libp2p_pubsub_tpu.sim import SimConfig, TopicParams, init_state, topology
+
+N, K, T, M = 256, 16, 2, 64
+
+
+def _build(route: str):
+    cfg = SimConfig(
+        n_peers=N, k_slots=K, n_topics=T, msg_window=M,
+        publishers_per_tick=4, prop_substeps=4,
+        scoring_enabled=True, behaviour_penalty_weight=-1.0,
+        gossip_threshold=-10.0, publish_threshold=-20.0,
+        graylist_threshold=-30.0,
+        churn_disconnect_prob=0.02, churn_reconnect_prob=0.2,
+        px_enabled=True, accept_px_threshold=-5.0, retain_score_ticks=10,
+        flood_publish=True, gater_enabled=True,
+        edge_gather_mode="sort", sharded_route=route)
+    tp = TopicParams.disabled(T)
+    st = init_state(cfg, topology.sparse(N, K, degree=6, seed=11))
+    return cfg, tp, st
+
+
+def _dense_collectives(text: str, thresh: int) -> list:
+    """(result_elems, snippet) of every all-gather / dynamic-slice in the
+    compiled HLO whose result exceeds ``thresh`` elements. Tuple-shaped
+    results (variadic all-gather) count each component."""
+    out = []
+    for m in re.finditer(
+            r"= *\(?((?:[a-z][a-z0-9]*\[[0-9,]*\][^ ,()]*(?:, *)?)+)\)? "
+            r"(all-gather|dynamic-slice)\(", text):
+        elems = 0
+        for shape in re.findall(r"\[([0-9,]*)\]", m.group(1)):
+            dims = [int(d) for d in shape.split(",") if d]
+            elems += int(np.prod(dims)) if dims else 1
+        if elems > thresh:
+            out.append((elems, m.group(0)[:160]))
+    return out
+
+
+def _compiled_step_text(route: str) -> str:
+    cfg, tp, st = _build(route)
+    mesh = make_mesh(jax.devices()[:8])
+    sharded_step = make_sharded_step(mesh, cfg, tp)
+    st_sh = shard_state(st, mesh, cfg)
+    return sharded_step.lower(st_sh, jax.random.PRNGKey(0)).compile().as_text()
+
+
+BUDGET = 4 * N * ((K + 31) // 32)       # packed bit-table words
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices (conftest XLA_FLAGS)")
+    return jax.devices()[:8]
+
+
+def test_halo_step_within_packed_budget(eight_devices):
+    """The acceptance guard: every all-gather/dynamic-slice result in the
+    halo-routed sharded step fits the packed budget."""
+    text = _compiled_step_text("halo")
+    bad = _dense_collectives(text, BUDGET)
+    assert not bad, (
+        f"dense collectives above the packed budget ({BUDGET} words) "
+        f"sneaked into the halo-routed step: {bad[:5]}")
+
+
+def test_replicated_control_trips_the_grep(eight_devices):
+    """Positive control: the replicated route's global sorts all-gather
+    full [N*K] payloads — they MUST exceed the budget, or the grep is
+    matching nothing."""
+    text = _compiled_step_text("replicated")
+    bad = _dense_collectives(text, BUDGET)
+    assert bad, ("control failed: the replicated-route step shows no "
+                 "dense collective to the grep")
+    assert max(e for e, _ in bad) >= N * K

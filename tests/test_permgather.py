@@ -183,7 +183,7 @@ class TestShardedStepParity:
             for i in range(3):
                 out = step(out, jax.random.PRNGKey(i))
             out.tick.block_until_ready()
-            obs = (int(out.tick), int(np.asarray(out.have).sum()),
+            obs = (int(out.tick), int(np.asarray(out.have).astype(np.uint64).sum()),
                    float(np.asarray(out.first_message_deliveries).sum()))
             if ref is None:
                 ref = obs

@@ -276,6 +276,61 @@ def test_lower_then_call_same_instance(eight_devices):
     assert int(out.tick) == 1
 
 
+def test_halo_mixed_dtype_payloads_bit_exact():
+    """route_payloads_halo's by_dtype branch: payloads of MIXED dtypes
+    (f32 + u32 + i32) stack into one all_to_all per dtype and must land
+    bit-exact against the direct unsharded permutation at a ragged N
+    (96 = 12 rows/shard, nothing 128-friendly). Valid slots route the
+    involution value; invalid slots keep their local identity — the same
+    contract the sort formulation pins. Runs in a FRESH subprocess (the
+    second mesh in one process hits the backend multi-mesh poison the 2-D
+    test documents)."""
+    import os
+    import subprocess
+    import sys
+
+    from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
+
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from go_libp2p_pubsub_tpu.sim import topology
+from go_libp2p_pubsub_tpu.parallel.kernel_context import kernel_mesh
+from go_libp2p_pubsub_tpu.parallel.halo import route_payloads_halo
+from go_libp2p_pubsub_tpu.parallel.sharding import make_mesh
+
+n, k = 96, 8
+topo = topology.sparse(n, k, degree=4, seed=13)
+nbr, rks = topo.neighbors, topo.reverse_slot
+rng = np.random.default_rng(5)
+payloads = [rng.random((n, k)).astype(np.float32),
+            rng.integers(0, 2**32, (n, k), dtype=np.uint32),
+            rng.random((n, k)).astype(np.float32),
+            rng.integers(-2**31, 2**31, (n, k)).astype(np.int32)]
+valid = (nbr >= 0) & (rks >= 0)
+jn = np.clip(nbr, 0, n - 1)
+rk = np.clip(rks, 0, k - 1)
+expect = [np.where(valid, p[jn, rk], p) for p in payloads]
+
+mesh = make_mesh(jax.devices()[:8])
+fn = jax.jit(lambda *ps: tuple(route_payloads_halo(
+    list(ps), jnp.asarray(nbr), jnp.asarray(rks))))
+with kernel_mesh(mesh, ("peers",), route="halo", capacity_factor=4):
+    got = fn(*[jnp.asarray(p) for p in payloads])
+for i, (e, g) in enumerate(zip(expect, got)):
+    np.testing.assert_array_equal(e, np.asarray(g), err_msg=f"payload {i}")
+print("MIXED_DTYPE_OK")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = cpu_mesh_env(dict(os.environ), 8)
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=420,
+                         cwd=repo)
+    assert "MIXED_DTYPE_OK" in res.stdout, res.stderr[-2000:]
+
+
 def test_halo_capacity_rule_on_bench_underlays():
     """The CAPACITY RULE (parallel/halo.py): required_capacity_factor — the
     exact worst bucket of an underlay over the uniform mean — must sit

@@ -20,6 +20,7 @@ from go_libp2p_pubsub_tpu.ops.heartbeat import heartbeat
 from go_libp2p_pubsub_tpu.ops.propagate import forward_tick, publish
 from go_libp2p_pubsub_tpu.ops.score_ops import compute_scores
 from go_libp2p_pubsub_tpu.sim import SimConfig, TopicParams, init_state, run, topology
+from go_libp2p_pubsub_tpu.sim.state import have_set_bit, unpack_have
 
 
 def strict_tp():
@@ -56,7 +57,7 @@ class TestBrokenPromises:
         assert bp[0, slot] == 1.0
         assert bp.sum() == 1.0
         # the message was not delivered
-        assert not bool(st2.have[0, 0])
+        assert not bool(st2.have[0, 0] & 1)
 
     def test_answered_iwant_no_penalty(self):
         cfg = SimConfig(n_peers=8, k_slots=4, msg_window=8,
@@ -69,14 +70,14 @@ class TestBrokenPromises:
         st = st._replace(
             msg_topic=st.msg_topic.at[0].set(0),
             msg_publish_tick=st.msg_publish_tick.at[0].set(0),
-            have=st.have.at[peer, 0].set(True),
+            have=have_set_bit(st.have, peer, 0),
             deliver_tick=st.deliver_tick.at[peer, 0].set(0),
             iwant_pending=st.iwant_pending.at[0, 0].set(0))
         scores = jnp.zeros((8, 4), jnp.float32)
         st2 = forward_tick(st, cfg, tp, jnp.zeros((8, 1, 4), bool), scores,
                            jax.random.PRNGKey(0))
         assert np.asarray(st2.behaviour_penalty).sum() == 0.0
-        assert bool(st2.have[0, 0])
+        assert bool(st2.have[0, 0] & 1)
         # first-delivery credit went to the answering slot
         assert float(st2.first_message_deliveries[0, 0, 0]) == 1.0
 
@@ -116,7 +117,7 @@ class TestIWantBudget:
         st = st._replace(
             msg_topic=st.msg_topic.at[:7].set(0),
             msg_publish_tick=st.msg_publish_tick.at[:7].set(0),
-            have=st.have.at[honest, 6].set(True),
+            have=have_set_bit(st.have, honest, 6),
             deliver_tick=st.deliver_tick.at[honest, 6].set(0))
         scores = jnp.zeros((8, 4), jnp.float32)
         st2 = forward_tick(st, cfg, tp, jnp.ones((8, 1, 4), bool), scores,
@@ -189,7 +190,7 @@ class TestSybilIsolation:
         # the last forward pass)
         settled = valid & ((int(st.tick) - np.asarray(st.msg_publish_tick)) >= 2)
         if settled.any():
-            frac = np.asarray(st.have)[~mal][:, settled].mean()
+            frac = np.asarray(unpack_have(st, cfg.msg_window))[~mal][:, settled].mean()
             assert frac > 0.9
 
         # invalid messages were never *delivered* at honest peers
@@ -222,7 +223,7 @@ class TestFanout:
         assert int(st.fanout_lastpub[0, 0]) == 0
         for i in range(4):
             st = self._tick(st, cfg, tp, jax.random.PRNGKey(i))
-        have = np.asarray(st.have)[:, 0]
+        have = np.asarray(unpack_have(st, cfg.msg_window))[:, 0]
         assert have[np.asarray(st.subscribed)[:, 0]].mean() > 0.9
         # fanout degree bounded by D while alive
         fdeg = np.asarray(st.fanout).sum(axis=-1)

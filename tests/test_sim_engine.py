@@ -365,7 +365,8 @@ class TestFloodPublish:
             scores = jnp.zeros(st.behaviour_penalty.shape, jnp.float32)
             st = forward_tick(st, cfg, TopicParams.disabled(1), gossip_sel,
                               scores, jax.random.PRNGKey(0))
-            return int(np.asarray(st.have)[:, 0].sum())
+            from go_libp2p_pubsub_tpu.sim.state import unpack_have
+            return int(np.asarray(unpack_have(st, cfg.msg_window))[:, 0].sum())
 
         assert one_tick(flood=False) == 1     # only the publisher holds it
         assert one_tick(flood=True) == 32     # everyone got the origin copy

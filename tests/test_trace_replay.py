@@ -167,7 +167,8 @@ class TestTraceReplayDifferential:
 
     def test_delivery_state_matches(self, diff_setup):
         _, nodes, hosts, _, st, cfg, tp, topo, peer_index, feed = diff_setup
-        have = np.asarray(st.have)
+        from go_libp2p_pubsub_tpu.sim.state import unpack_have
+        have = np.asarray(unpack_have(st, cfg.msg_window))
         # every subscribed node saw every message (dense net, full delivery)
         n_msgs = len(feed.mid_slot)
         assert n_msgs == 8
