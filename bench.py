@@ -184,7 +184,8 @@ def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
 NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
          "50k_churn_gater_px", "100k_sybil20", "100k_floodsub",
          "100k_randomsub", "100k_gossipsub_sweep",
-         "frontier_250k", "frontier_500k", "frontier_1m", "headline"]
+         "frontier_250k", "frontier_500k", "frontier_1m",
+         "telemetry_1k", "telemetry_10k", "headline"]
 # execution order puts headline FIRST (banked before anything can time
 # out — losing it cost round 5 its record, VERDICT r5 weak #2) and its
 # line is re-emitted LAST so the driver's single-line stdout parse still
@@ -203,7 +204,11 @@ TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60,
                  "fleet_256x1k": 10,
                  # frontier family (ROADMAP item 1): short windows — the
                  # per-tick cost at 250k+ dwarfs the dispatch RTT
-                 "frontier_250k": 10, "frontier_500k": 5, "frontier_1m": 3}
+                 "frontier_250k": 10, "frontier_500k": 5, "frontier_1m": 3,
+                 # tracing-overhead A/B (ROADMAP item 5): windows long
+                 # enough that the per-chunk journal write is amortized
+                 # the way a real supervised stream amortizes it
+                 "telemetry_1k": 120, "telemetry_10k": 20}
 
 
 def _fleet_b() -> int:
@@ -308,12 +313,153 @@ def bench_fleet(name: str, ticks: int, repeats: int) -> str:
     return line
 
 
+# full peer counts of the tracing-overhead pair — ONE dict shared by the
+# builder (_telemetry_n) and the label maker (_label), the same lockstep
+# discipline as FRONTIER_FULL_N (a capped contract run must never bank
+# under the full label)
+TELEMETRY_FULL_N = {"telemetry_1k": 1024, "telemetry_10k": 10_000}
+
+
+def _telemetry_n(name: str) -> int:
+    return _cap_peers(TELEMETRY_FULL_N[name])
+
+
+def bench_telemetry(name: str, ticks: int, repeats: int) -> str:
+    """The tracing-overhead A/B (ROADMAP item 5 success metric): the SAME
+    window measured four ways — untraced scan, device-side health
+    reduction streamed through the Python encoder, the same records
+    through the native codec, and the legacy per-tick JSON event sink
+    (``run_traced`` + JSONTracer, the pre-telemetry bottleneck). ``value``
+    is the streaming path's hb/s (native encoder when it loads); the
+    ``*_overhead_pct`` fields are the numbers PERF_MODEL's "Tracing
+    overhead" table tracks against the <10% target."""
+    import tempfile
+
+    import jax
+    import numpy as np
+    from go_libp2p_pubsub_tpu.sim import scenarios, telemetry
+    from go_libp2p_pubsub_tpu.sim.engine import run_keys
+
+    n = _telemetry_n(name)
+    if name == "telemetry_1k":
+        cfg, tp, st = scenarios.single_topic_1k(n_peers=n)
+    else:
+        cfg, tp, st = scenarios.beacon_10k(n_peers=n)
+    windows = [jax.random.split(jax.random.PRNGKey(1000 + w), ticks)
+               for w in range(1 + repeats)]
+    rtt = None
+
+    def measure(fn, n_ticks):
+        """Median hb/s of ``fn(keys)`` over the repeat windows; every leg
+        starts from the SAME state and warms on window 0."""
+        nonlocal rtt
+        fn(windows[0][:n_ticks])            # compile + warm
+        if rtt is None:
+            rtt = _fetch_rtt()
+        rates = []
+        for kw in windows[1:]:
+            t0 = time.perf_counter()
+            fn(kw[:n_ticks])
+            raw = time.perf_counter() - t0
+            dt = max(raw - rtt, raw * 0.05)
+            rates.append(n_ticks / dt)
+        return statistics.median(rates)
+
+    def untraced(keys):
+        out = run_keys(st, cfg, tp, keys)
+        np.asarray(out.tick)
+
+    tmp = tempfile.mkdtemp(prefix="graft_telemetry_bench_")
+
+    def streaming(prefer_native):
+        path = os.path.join(tmp, f"health_{prefer_native}.jsonl")
+        def leg(keys):
+            out, health = run_keys(st, cfg, tp, keys, telemetry=True)
+            with telemetry.HealthJournal(path,
+                                         prefer_native=prefer_native) as hj:
+                hj.append_records(health, ticks=int(keys.shape[0]))
+            np.asarray(out.tick)
+            return hj.encoder
+        return leg
+
+    from go_libp2p_pubsub_tpu.trace.native import \
+        encode_health_json as _native_probe
+    native_ok = _native_probe(np.zeros((1, 2)), [("a", True),
+                                                 ("b", False)]) is not None
+
+    untraced_hbps = measure(untraced, ticks)
+    py_leg = streaming(prefer_native=False)
+    device_hbps = measure(py_leg, ticks)
+    native_hbps = measure(streaming(prefer_native=True), ticks) \
+        if native_ok else None
+
+    # legacy comparator: per-tick host-stepped event export into the
+    # NDJSON sink — the Python-JSON-sink bottleneck the device reduction
+    # replaces. Few ticks suffice (per-tick cost dominates; rate scales)
+    import dataclasses
+    from go_libp2p_pubsub_tpu.sim.trace_export import run_traced
+    from go_libp2p_pubsub_tpu.trace.sinks import JSONTracer
+    sink_ticks = min(ticks, 8)
+    traced_cfg = dataclasses.replace(cfg, record_provenance=True)
+
+    def json_sink(keys):
+        sink = JSONTracer(os.path.join(tmp, "events.jsonl"))
+        out, events = run_traced(st, traced_cfg, tp, None, 0, keys=keys)
+        for ev in events:
+            sink.trace(ev)
+        sink.hard_flush()
+        sink.close()
+        np.asarray(out.tick)
+
+    json_hbps = measure(json_sink, sink_ticks)
+    # the measurement journals/event files are evidence only while being
+    # timed; recheck cycles must not accumulate orphan temp dirs
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    def pct(traced_rate):
+        return round((untraced_hbps / traced_rate - 1.0) * 100.0, 2) \
+            if traced_rate else None
+
+    value = native_hbps if native_hbps is not None else device_hbps
+    platform = jax.devices()[0].platform
+    line = json.dumps({
+        "metric": f"network_heartbeats_per_sec@{_label(name)}[{platform}]",
+        "value": round(value, 2),
+        "unit": "heartbeats/s",
+        "platform": platform,
+        "vs_baseline": round(value / TARGET_HBPS, 4),
+        "repeats": repeats,
+        "ticks_per_window": ticks,
+        "fetch_rtt_ms": round(rtt * 1e3, 1),
+        "n_peers": cfg.n_peers,
+        "untraced_hbps": round(untraced_hbps, 2),
+        "device_py_hbps": round(device_hbps, 2),
+        "device_native_hbps": round(native_hbps, 2)
+        if native_hbps is not None else None,
+        "json_sink_hbps": round(json_hbps, 2),
+        "json_sink_ticks": sink_ticks,
+        "device_py_overhead_pct": pct(device_hbps),
+        "device_native_overhead_pct": pct(native_hbps),
+        "json_sink_overhead_pct": pct(json_hbps),
+        "native_codec": native_ok,
+        **_memory_record(cfg),
+    })
+    print(line, flush=True)
+    return line
+
+
 def run_scenario(name: str) -> str | None:
     from go_libp2p_pubsub_tpu.sim import scenarios
 
     env_ticks = os.environ.get("BENCH_TICKS")
     ticks = int(env_ticks) if env_ticks else TICKS_DEFAULT.get(name, 10)
     repeats = max(1, int(os.environ.get("BENCH_REPEATS", 3)))
+
+    if name in ("telemetry_1k", "telemetry_10k"):
+        # the tracing-overhead A/B rides its own four-way measurement
+        # path; the kernel-mode sweep knobs don't apply
+        return bench_telemetry(name, ticks, repeats)
 
     if name == "fleet_256x1k":
         # the batched-fleet line rides its own measurement path (aggregate
@@ -362,7 +508,8 @@ def run_scenario(name: str) -> str | None:
             "gossipsub", n_peers=_cap_n(100_000)),
         "headline": headline,
     }
-    assert set(builders) | {"fleet_256x1k"} == set(NAMES), \
+    assert set(builders) | {"fleet_256x1k", "telemetry_1k",
+                            "telemetry_10k"} == set(NAMES), \
         "scenario registry drifted from NAMES"
     assert FRONTIER_FULL_N == scenarios.FRONTIER_NS, \
         "bench FRONTIER_FULL_N drifted from scenarios.FRONTIER_NS"
@@ -466,6 +613,11 @@ def _label(name: str) -> str:
         # a BENCH_MAX_N-capped frontier line is labeled by what ran —
         # a reduced-N contract run can never bank under the full label
         full = FRONTIER_FULL_N[name]
+        n = _cap_peers(full)
+        return name if n == full else f"{name}_capped_{n // 1000}k"
+    if name in TELEMETRY_FULL_N:
+        # same capped-label discipline as the frontier family
+        full = TELEMETRY_FULL_N[name]
         n = _cap_peers(full)
         return name if n == full else f"{name}_capped_{n // 1000}k"
     return name

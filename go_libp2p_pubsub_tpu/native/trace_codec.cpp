@@ -14,7 +14,9 @@
 // Contract: byte-for-byte identical op streams to the Python tensorizer
 // (tests/test_native_codec.py enforces array equality).
 
+#include <cmath>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
@@ -438,5 +440,65 @@ void trace_codec_free(void* p) { free(p); }
 
 // Encode helper: frame a pre-encoded TraceEvent blob stream is trivial in
 // Python; the native side only ships the parse/tensorize hot path.
+
+// Health-row NDJSON encoder (sim/telemetry.py hot sink path): format a
+// whole chunk's [n_rows, n_cols] float64 row matrix as one NDJSON blob in
+// a single call — the per-row Python dict + json.dumps overhead is the
+// encoder-side cost the streaming plane removes. names_blob uses the
+// split_blob convention (uint32 LE length + raw bytes per column name);
+// is_int marks columns printed as integers. Doubles print as %.17g
+// (round-trips every finite double bit-exactly through a JSON parser);
+// non-finite values print as null (NaN is not JSON — a degraded row must
+// stay machine-readable). Returns 0; caller frees *out via
+// trace_codec_free.
+int trace_codec_health_json(const double* vals, long n_rows, long n_cols,
+                            const char* names_blob, long names_len,
+                            const unsigned char* is_int,
+                            char** out, long* out_len) {
+  std::vector<std::string> names;
+  names.reserve(n_cols);
+  {
+    const char* p = names_blob;
+    (void)names_len;
+    for (long i = 0; i < n_cols; i++) {
+      uint32_t l;
+      memcpy(&l, p, 4);
+      p += 4;
+      names.emplace_back(p, l);
+      p += l;
+    }
+  }
+  // pre-render the '"name":' fragments once; rows reuse them
+  std::vector<std::string> keys;
+  keys.reserve(n_cols);
+  for (long c = 0; c < n_cols; c++)
+    keys.push_back(std::string(c ? ",\"" : "{\"kind\":\"health\",\"")
+                   + names[c] + "\":");
+  std::string buf;
+  buf.reserve((size_t)n_rows * n_cols * 24 + 64);
+  char num[40];
+  for (long r = 0; r < n_rows; r++) {
+    const double* row = vals + r * n_cols;
+    for (long c = 0; c < n_cols; c++) {
+      buf += keys[c];
+      double v = row[c];
+      if (!std::isfinite(v)) {
+        buf += "null";
+      } else if (is_int[c]) {
+        snprintf(num, sizeof num, "%lld", (long long)v);
+        buf += num;
+      } else {
+        snprintf(num, sizeof num, "%.17g", v);
+        buf += num;
+      }
+    }
+    buf += "}\n";
+  }
+  char* p = (char*)malloc(buf.size() ? buf.size() : 1);
+  memcpy(p, buf.data(), buf.size());
+  *out = p;
+  *out_len = (long)buf.size();
+  return 0;
+}
 
 }  // extern "C"

@@ -152,7 +152,8 @@ def make_sharded_step(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
     return sharded_step
 
 
-def make_sharded_run_keys(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
+def make_sharded_run_keys(mesh: Mesh, cfg: SimConfig, tp: TopicParams,
+                          telemetry: bool = False):
     """jit a whole chunk — ``lax.scan`` of the sharded step over explicit
     per-tick keys — with the peer-sharded in/out state, the multi-host
     execution unit (parallel/multihost.py drives supervised chunks through
@@ -160,8 +161,17 @@ def make_sharded_run_keys(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
     the halo routes away). Same key discipline as ``engine.run_keys``:
     the caller pre-splits one master key and scans contiguous windows, so
     the chunked sharded trajectory is bit-identical to the single-scan
-    unsharded one (tests/test_sharding.py, tests/test_multihost.py)."""
+    unsharded one (tests/test_sharding.py, tests/test_multihost.py).
+
+    ``telemetry=True`` is the sharded flavor of the streaming-telemetry
+    lane (sim/telemetry.py): the scan stacks per-tick ``HealthRecord``
+    aggregates whose reductions the SPMD partitioner lowers over the
+    same peer sharding as the step (cross-shard sums become the scan's
+    collectives), emitted REPLICATED — every rank holds the full ``[C]``
+    record buffer, so rank 0 can journal without any extra gather. The
+    runner then returns ``(state, HealthRecord)``."""
     from ..sim.engine import step
+    from ..sim.telemetry import health_record
     from .kernel_context import kernel_mesh
 
     if cfg.sharded_route not in ("replicated", "halo"):
@@ -172,22 +182,26 @@ def make_sharded_run_keys(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
     tp_sh = jax.tree.map(lambda _: repl, tp)
     peer_axes = tuple(ax for ax in (DCN_AXIS, PEER_AXIS)
                       if ax in mesh.axis_names)
+    # health aggregates replicate (repl is a pytree PREFIX spec for the
+    # whole HealthRecord subtree)
+    out_sh = (shardings, repl) if telemetry else shardings
 
     # tp rides as a traced argument, not a closure, for the same AOT/
     # dispatch-agreement reason documented on make_sharded_step
     @partial(jax.jit,
-             in_shardings=(shardings, tp_sh, repl), out_shardings=shardings)
-    def _run(state: SimState, tp_arg: TopicParams,
-             keys: jax.Array) -> SimState:
+             in_shardings=(shardings, tp_sh, repl), out_shardings=out_sh)
+    def _run(state: SimState, tp_arg: TopicParams, keys: jax.Array):
         with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
                          capacity_factor=cfg.halo_capacity_factor):
             def body(carry, k):
-                return step(carry, cfg, tp_arg, k), None
-            out, _ = jax.lax.scan(body, state, keys)
-        return out
+                nxt = step(carry, cfg, tp_arg, k)
+                return nxt, health_record(nxt, cfg, tp_arg) \
+                    if telemetry else None
+            out, health = jax.lax.scan(body, state, keys)
+        return (out, health) if telemetry else out
 
     def sharded_run_keys(state: SimState, keys: jax.Array,
-                         tp_arg: TopicParams | None = None) -> SimState:
+                         tp_arg: TopicParams | None = None):
         # tp is a traced argument of the compiled scan, so a caller may
         # swap it per call (the supervisor run_fn hook hands one) without
         # invalidating the executable; default is the build-time tp

@@ -10,6 +10,8 @@ _FLEET_EXPORTS = ("FleetMember", "FleetResult", "fleet_run",
                   "supervised_fleet_run", "fleet_run_keys", "stack_states",
                   "member_state")
 _CONFIG_EXPORTS = ("with_score_weights", "SCORE_WEIGHT_KEYS")
+_TELEMETRY_EXPORTS = ("HealthRecord", "HealthJournal", "health_record",
+                      "read_journal")
 
 
 def __getattr__(name):
@@ -27,4 +29,7 @@ def __getattr__(name):
     if name in _CONFIG_EXPORTS:
         from . import config
         return getattr(config, name)
+    if name in _TELEMETRY_EXPORTS:
+        from . import telemetry
+        return getattr(telemetry, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -161,20 +161,29 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
 
 
 def _run_keys_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
-                   keys: jax.Array) -> SimState:
+                   keys: jax.Array, telemetry: bool = False):
     """Advance one tick per row of ``keys`` on device — the chunkable core
     of ``run``. ``run`` pre-splits ONE master key into per-tick keys and
     scans them all; a caller that performs the same split and scans any
     contiguous windows of the key array (sim/supervisor.py chunked
     execution) lands on the bit-identical trajectory, because the per-tick
     key sequence — the only thing the scan consumes besides the carried
-    state — is unchanged."""
+    state — is unchanged.
+
+    ``telemetry=True`` (static) is the streaming-telemetry lane
+    (sim/telemetry.py): the scan additionally stacks one per-tick
+    :class:`~.telemetry.HealthRecord` — the device-side reduction, so
+    only ``[C]``-stacked aggregates ever leave the chip — and the return
+    becomes ``(state, HealthRecord)``. The carried state math is
+    UNCHANGED: telemetry reads the post-step state, it never writes."""
+    from .telemetry import health_record
 
     def body(carry, k):
-        return step(carry, cfg, tp, k), None
+        nxt = step(carry, cfg, tp, k)
+        return nxt, health_record(nxt, cfg, tp) if telemetry else None
 
-    state, _ = jax.lax.scan(body, state, keys)
-    return state
+    state, health = jax.lax.scan(body, state, keys)
+    return (state, health) if telemetry else state
 
 
 def _run_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
@@ -190,8 +199,10 @@ run_donated = jax.jit(_run_impl, static_argnames=("cfg", "n_ticks"),
                       donate_argnums=(0,))
 
 # explicit per-tick keys (the supervisor's chunk unit; n_ticks is carried
-# by keys.shape[0], a jit shape dimension rather than a static argument)
-run_keys = jax.jit(_run_keys_impl, static_argnames=("cfg",))
+# by keys.shape[0], a jit shape dimension rather than a static argument).
+# telemetry is a static lane flag: the default program is byte-identical
+# to the historical one, telemetry=True returns (state, HealthRecord)
+run_keys = jax.jit(_run_keys_impl, static_argnames=("cfg", "telemetry"))
 
 step_jit = jax.jit(step, static_argnames=("cfg",))
 
@@ -215,16 +226,17 @@ def run_checked(state: SimState, cfg: SimConfig, tp: TopicParams,
 
 
 def run_checked_keys(state: SimState, cfg: SimConfig, tp: TopicParams,
-                     keys: jax.Array) -> SimState:
+                     keys: jax.Array, telemetry: bool = False):
     """``run_keys`` with the invariant sentinel escalated to host
     exceptions (see :func:`run_checked`) — the supervisor's execution path
     under ``invariant_mode="raise"`` and the replay path of
     ``scripts/replay_crash.py`` (which re-runs a crash dump's exact
-    failing tick window from its recorded per-tick keys)."""
+    failing tick window from its recorded per-tick keys). ``telemetry``
+    mirrors ``run_keys``' lane: ``(state, HealthRecord)`` when set."""
     from jax.experimental import checkify
 
     def f(state, tp, keys):
-        return _run_keys_impl(state, cfg, tp, keys)
+        return _run_keys_impl(state, cfg, tp, keys, telemetry=telemetry)
 
     err, out = jax.jit(checkify.checkify(f, errors=checkify.user_checks))(
         state, tp, keys)

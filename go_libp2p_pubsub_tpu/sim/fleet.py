@@ -100,26 +100,36 @@ class FleetResult:
 
 
 def _fleet_run_keys_impl(states: SimState, cfg: SimConfig, tps: TopicParams,
-                         keys: jax.Array) -> SimState:
+                         keys: jax.Array, telemetry: bool = False):
     """Advance B stacked members one tick per row of ``keys`` ([C, B]
     per-tick-major, so the scan consumes one tick across all lanes per
     iteration). The vmapped step is the UNCHANGED ``engine.step`` — the
-    fleet adds a batch axis, not semantics."""
+    fleet adds a batch axis, not semantics.
+
+    ``telemetry=True`` (static) stacks the per-member device-side health
+    reduction alongside: the vmapped ``telemetry.health_record`` over the
+    post-step lanes, scanned into ``[C, B]``-leaved records, returned as
+    ``(states, HealthRecord)`` — the fleet flavor of ``engine.run_keys``'
+    telemetry lane (sim/telemetry.py)."""
     from .engine import step
+    from .telemetry import health_record
 
     vstep = jax.vmap(lambda s, t, k: step(s, cfg, t, k))
+    vhealth = jax.vmap(lambda s, t: health_record(s, cfg, t))
 
     def body(carry, keys_t):
-        return vstep(carry, tps, keys_t), None
+        nxt = vstep(carry, tps, keys_t)
+        return nxt, vhealth(nxt, tps) if telemetry else None
 
-    out, _ = jax.lax.scan(body, states, keys)
-    return out
+    out, health = jax.lax.scan(body, states, keys)
+    return (out, health) if telemetry else out
 
 
-fleet_run_keys = jax.jit(_fleet_run_keys_impl, static_argnames=("cfg",))
+fleet_run_keys = jax.jit(_fleet_run_keys_impl,
+                         static_argnames=("cfg", "telemetry"))
 # the bench path: donating the batched state halves peak fleet memory
 fleet_run_keys_donated = jax.jit(_fleet_run_keys_impl,
-                                 static_argnames=("cfg",),
+                                 static_argnames=("cfg", "telemetry"),
                                  donate_argnums=(0,))
 
 
@@ -210,18 +220,23 @@ def _exec_cfg(cfg: SimConfig) -> SimConfig:
 _FLEET_COMPILED: set = set()
 
 
-def _run_window(states, exec_cfg, tps, keys_win, sup, hook, info):
-    """One window attempt under the supervisor's deadlines."""
+def _run_window(states, exec_cfg, tps, keys_win, sup, hook, info,
+                telemetry: bool = False):
+    """One window attempt under the supervisor's deadlines. Returns
+    ``(states, HealthRecord | None)`` — records when the telemetry lane
+    is on (``sup.health_path``)."""
     cache_key = (exec_cfg, int(keys_win.shape[0]), int(keys_win.shape[1]),
-                 str(keys_win.dtype))
+                 str(keys_win.dtype), telemetry)
     first_use = cache_key not in _FLEET_COMPILED
 
     def worker():
         if hook is not None:            # test/smoke fault-injection point
             hook(info)
-        out = fleet_run_keys(states, exec_cfg, tps, keys_win)
+        res = fleet_run_keys(states, exec_cfg, tps, keys_win,
+                             telemetry=telemetry)
+        out, health = res if telemetry else (res, None)
         np.asarray(out.tick)            # real sync by value fetch
-        return out
+        return out, health
 
     # a first-use window compiles AND runs: bound it by the compile
     # deadline (unbounded by default — compile time is not execution
@@ -285,7 +300,8 @@ def _try_resume_fleet(sup, ckpt_dir, group_cfg, full, starts, n_ticks,
 
 
 def _write_fleet_crash_dump(sup, group_cfg, full, keys_win, gi, active,
-                            names, done, this_win, err, report) -> str:
+                            names, idxs, done, this_win, err,
+                            report) -> str:
     from .invariants import decode_flags
 
     base = sup.crash_dir or os.environ.get("GRAFT_CRASH_DIR") \
@@ -301,6 +317,10 @@ def _write_fleet_crash_dump(sup, group_cfg, full, keys_win, gi, active,
         "fleet_group": gi,
         "fleet_size": len(names),
         "member_names": names,
+        # the members' INPUT indices, group-position-ordered: a mixed-
+        # config fleet splits into groups, so group position != input
+        # index — replay_crash maps --member (input index) through this
+        "member_ids": [int(i) for i in idxs],
         "active_members": active,
         "window_start": done,
         "window_end": done + this_win,
@@ -328,7 +348,8 @@ def _write_fleet_crash_dump(sup, group_cfg, full, keys_win, gi, active,
 # the driver
 
 
-def _drive_group(gi, idxs, members, sup, report, dumps, hook) -> dict:
+def _drive_group(gi, idxs, members, sup, report, dumps, hook,
+                 journal=None) -> dict:
     """Run one config group to completion; {input_index: FleetResult}."""
     from .invariants import VIOLATION_MASK, decode_flags
 
@@ -354,6 +375,12 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook) -> dict:
             sup, ckpt_dir, group_cfg, full, starts, n_ticks, escalate,
             report, gi)
 
+    if journal is not None:
+        # per-group header: a mixed-config fleet writes one journal with
+        # groups interleaved; the member ids bind rows back to input order
+        journal.header(group_cfg, plane="fleet", group=gi,
+                       member_ids=list(map(int, idxs)), member_names=names,
+                       n_ticks=n_ticks, resumed_done=done)
     exec_cfg = group_cfg
     chunk_ticks = max(1, int(sup.chunk_ticks))
     every = sup.checkpoint_every_ticks or chunk_ticks
@@ -381,8 +408,9 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook) -> dict:
                 "b_active": len(active), "attempt": failures,
                 "degrade_level": report.degrade_level}
         try:
-            out = _run_window(sub, exec_cfg, sub_tps, keys_win, sup, hook,
-                              info)
+            out, health = _run_window(sub, exec_cfg, sub_tps, keys_win, sup,
+                                      hook, info,
+                                      telemetry=journal is not None)
         except Exception as e:
             if not dumps:
                 raise       # plain fleet_run: no retry net, no dumps
@@ -390,8 +418,11 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook) -> dict:
             if failures > sup.max_retries:
                 dump = _write_fleet_crash_dump(
                     sup, group_cfg, full, keys_win, gi, active, names,
-                    done, this_win, e, report)
+                    idxs, done, this_win, e, report)
                 report.crash_dump = dump
+                if journal is not None:
+                    journal.note("crash", group=gi, dump=dump,
+                                 error=str(e)[:200])
                 raise SupervisorCrash(
                     f"fleet group {gi} gave up at window start {done} "
                     f"({failures} consecutive failure(s)); crash dump: "
@@ -411,6 +442,13 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook) -> dict:
         report.chunks_run += 1
         report.ticks_run += this_win * len(active)      # member-ticks
         report.log("chunk_ok", **info)
+        if journal is not None and health is not None:
+            # [C, B_active] records, one device fetch, rows bound to the
+            # members' INPUT indices (compaction changes lane positions,
+            # never ids); a failed attempt's records never reach here
+            journal.append_records(
+                health, member_ids=[int(idxs[j]) for j in active],
+                group=gi, window_start=done - this_win, ticks=this_win)
         # per-member sentinel surfacing: a raise-mode lane whose violation
         # bits lit retires HERE, its siblings keep running
         if any(escalate):
@@ -430,6 +468,8 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook) -> dict:
             checkpoint.save(path, full, cfg=group_cfg)  # fleet-axis bound
             report.checkpoints.append(path)
             report.log("checkpoint", group=gi, done=done, path=path)
+            if journal is not None:
+                journal.note("checkpoint", group=gi, done=done, path=path)
             _prune_checkpoints(ckpt_dir, sup.keep_checkpoints)
             next_ckpt = done + every
 
@@ -459,10 +499,20 @@ def _drive(members, sup, dumps, hook):
         groups.setdefault(_exec_cfg(m.cfg), []).append(i)
     report.log("fleet_plan", members=len(members), groups=len(groups),
                sizes=[len(v) for v in groups.values()])
+    # streaming-telemetry lane (sim/telemetry.py): one journal for the
+    # whole fleet, rows [B]-batched per window and bound to input indices
+    journal = None
+    if sup.health_path and sup.write_files:
+        from .telemetry import HealthJournal
+        journal = HealthJournal(sup.health_path)
     results: dict = {}
-    for gi, idxs in enumerate(groups.values()):
-        results.update(_drive_group(gi, idxs, members, sup, report, dumps,
-                                    hook))
+    try:
+        for gi, idxs in enumerate(groups.values()):
+            results.update(_drive_group(gi, idxs, members, sup, report,
+                                        dumps, hook, journal=journal))
+    finally:
+        if journal is not None:
+            journal.close()
     return [results[i] for i in range(len(members))], report
 
 

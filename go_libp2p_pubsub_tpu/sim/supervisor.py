@@ -143,6 +143,15 @@ class SupervisorConfig:
     # state — run as much as fits a bounded TPU window, resume the SAME
     # (key, n_ticks) schedule next window. None = run to n_ticks.
     max_chunks: int | None = None
+    # streaming-telemetry lane (sim/telemetry.py): when set, chunks run
+    # with the device-side health reduction ON (engine.run_keys
+    # telemetry=True — aggregates stacked on device, ONE fetch per chunk
+    # boundary) and every successful chunk's records stream crash-
+    # atomically to this fsync'd NDJSON journal, which
+    # scripts/dashboard.py tails live. write_files=False ranks compute
+    # the (collective) reduction but skip the journal — the multihost
+    # rank-0-only write discipline. Env: GRAFT_HEALTH_STREAM=path.
+    health_path: str | None = None
 
     @staticmethod
     def from_env(**overrides) -> "SupervisorConfig":
@@ -155,6 +164,8 @@ class SupervisorConfig:
             kw["crash_dir"] = os.environ["GRAFT_CRASH_DIR"]
         if os.environ.get("GRAFT_CHECKPOINT_DIR"):
             kw["checkpoint_dir"] = os.environ["GRAFT_CHECKPOINT_DIR"]
+        if os.environ.get("GRAFT_HEALTH_STREAM"):
+            kw["health_path"] = os.environ["GRAFT_HEALTH_STREAM"]
         kw.update(overrides)
         return SupervisorConfig(**kw)
 
@@ -365,12 +376,14 @@ _AOT_CACHE: dict = {}
 
 
 def _chunk_executable(exec_cfg: SimConfig, state: SimState, tp: TopicParams,
-                      keys_chunk):
+                      keys_chunk, telemetry: bool = False):
     from .engine import run_keys
-    cache_key = (exec_cfg, int(keys_chunk.shape[0]), str(keys_chunk.dtype))
+    cache_key = (exec_cfg, int(keys_chunk.shape[0]), str(keys_chunk.dtype),
+                 telemetry)
     exe = _AOT_CACHE.get(cache_key)
     if exe is None:
-        exe = run_keys.lower(state, exec_cfg, tp, keys_chunk).compile()
+        exe = run_keys.lower(state, exec_cfg, tp, keys_chunk,
+                             telemetry=telemetry).compile()
         _AOT_CACHE[cache_key] = exe
     return exe
 
@@ -416,40 +429,60 @@ def _with_deadline(fn, deadline_s, what: str, info: dict):
 def _run_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
                keys_chunk, sup: SupervisorConfig, traced: bool,
                chunk_events: list, chunk_health: list,
-               chunk_hook, info: dict) -> SimState:
+               chunk_hook, info: dict) -> tuple:
     """One chunk attempt: compile (its own deadline) then run (the
-    watchdog deadline)."""
+    watchdog deadline). Returns ``(state, HealthRecord | None)`` — the
+    chunk's device-stacked telemetry records when ``sup.health_path``
+    turned the lane on (sim/telemetry.py); the traced path keeps its
+    per-tick dict rows in ``chunk_health`` instead."""
+    telemetry = sup.health_path is not None and not traced
     exe = None
     if not traced and exec_cfg.invariant_mode != "raise" \
             and sup.run_fn is None:
         exe = _with_deadline(
-            lambda: _chunk_executable(exec_cfg, state, tp, keys_chunk),
+            lambda: _chunk_executable(exec_cfg, state, tp, keys_chunk,
+                                      telemetry=telemetry),
             sup.compile_deadline_s, "compile", info)
 
     def worker():
         if chunk_hook is not None:      # test/smoke fault-injection point
             chunk_hook(info)
+        health = None
         if sup.run_fn is not None:
             # custom chunk runner (multihost sharded scan); it owns its
-            # own compile caching, so first use rides the run deadline
+            # own compile caching, so first use rides the run deadline.
+            # A telemetry-aware runner (scripts/run_multihost.py with a
+            # health stream) returns (state, HealthRecord); a plain one
+            # returns the state alone — both are honored
             out = sup.run_fn(state, exec_cfg, tp, keys_chunk)
+            # EXACT tuple check: SimState itself is a NamedTuple (a tuple
+            # subclass), so isinstance would mis-unpack a plain runner's
+            # bare state into 2-of-30 fields
+            if type(out) is tuple:
+                out, health = out
         elif traced:
             from .trace_export import run_traced
             out, evs = run_traced(state, exec_cfg, tp, None, 0,
                                   health_out=chunk_health, keys=keys_chunk)
             chunk_events.extend(evs)
         elif exe is not None:
-            out = exe(state, tp, keys_chunk)
+            if telemetry:
+                out, health = exe(state, tp, keys_chunk)
+            else:
+                out = exe(state, tp, keys_chunk)
         else:
             # "raise" mode: per-call checkify transform (the debugging
             # path — compile rides the run deadline here)
             from .engine import run_checked_keys
-            out = run_checked_keys(state, exec_cfg, tp, keys_chunk)
+            out = run_checked_keys(state, exec_cfg, tp, keys_chunk,
+                                   telemetry=telemetry)
+            if telemetry:
+                out, health = out
         # real sync by value fetch: async dispatch (and the axon tunnel,
         # which block_until_ready does not block through) must not let a
         # wedged chunk slide past the deadline
         _fetch_scalar(out.tick)
-        return out
+        return out, health
 
     return _with_deadline(worker, sup.deadline_s, "chunk", info)
 
@@ -486,6 +519,17 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         state, done = _try_resume(sup, cfg, state, start_tick, n_ticks,
                                   report)
 
+    # streaming-telemetry journal (sim/telemetry.py): rank-0-only under
+    # multihost (write_files); rank>0 still EXECUTES the telemetry lane —
+    # the reduction is part of the compiled program all ranks share
+    journal = None
+    if sup.health_path and sup.write_files:
+        from .telemetry import HealthJournal
+        journal = HealthJournal(sup.health_path)
+        journal.header(cfg, scenario=sup.scenario, start_tick=start_tick,
+                       n_ticks=n_ticks, resumed_tick=report.resumed_tick,
+                       traced=traced)
+
     exec_cfg = cfg
     chunk_ticks = max(1, int(sup.chunk_ticks))
     every = sup.checkpoint_every_ticks or chunk_ticks
@@ -503,101 +547,143 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         # run-start gather: a first-window crash still has a dumpable
         # copy (and a run with no checkpoint_dir dumps at all)
         last_host_state = sup.state_to_host(state)
-    while done < n_ticks:
-        this_chunk = min(chunk_ticks, n_ticks - done)
-        keys_chunk = all_keys[done:done + this_chunk]
-        info = {"chunk_start": start_tick + done, "chunk_ticks": this_chunk,
-                "attempt": failures, "degrade_level": report.degrade_level}
-        chunk_events: list = []
-        chunk_health: list = []
-        try:
-            out = _run_chunk(state, exec_cfg, tp, keys_chunk, sup, traced,
-                             chunk_events, chunk_health, _chunk_hook, info)
-        except Exception as e:
-            _hard_flush(sup.sinks)
-            failures += 1
-            # a MULTI-PROCESS run fails fast: the retry/degrade ladder is
-            # rank-LOCAL, so one rank re-dispatching a degraded (different
-            # collective sequence) or re-sized program while its peers sit
-            # in the original chunk's collectives would deadlock or pair
-            # wrong collectives. Recovery that IS rank-symmetric by
-            # construction: crash, relaunch every rank, resume from the
-            # last checkpoint (scripts/run_multihost.py).
-            multiproc = sup.run_fn is not None and jax.process_count() > 1
-            if _is_invariant_trip(e) or multiproc \
-                    or failures > sup.max_retries:
-                # invariant trips are never retried: the trajectory itself
-                # is poisoned and would trip again on the same keys
-                dump = None
-                if sup.write_files and sup.state_to_host is None:
-                    dump = _write_crash_dump(sup, cfg, state,
-                                             keys_chunk, start_tick, done,
-                                             this_chunk, n_ticks, e, report)
-                elif sup.write_files and last_host_state is not None:
-                    # the gathered copy may be chunks old: re-anchor the
-                    # dumped window to ITS tick so replay_crash.py feeds
-                    # last_good exactly the keys that advance it into the
-                    # failure
-                    w0, w1 = last_host_done, done + this_chunk
-                    dump = _write_crash_dump(sup, cfg, last_host_state,
-                                             all_keys[w0:w1], start_tick,
-                                             w0, w1 - w0, n_ticks, e,
-                                             report)
-                report.crash_dump = dump
-                raise SupervisorCrash(
-                    f"supervised run gave up at tick {start_tick + done} "
-                    f"({failures} consecutive failure(s)); crash dump: "
-                    f"{dump}", dump_dir=dump, report=report) from e
-            report.retries += 1
-            report.log("chunk_failed",
-                       kind="deadline" if isinstance(e, ChunkDeadline)
-                       else "error", error=str(e)[:200], **info)
-            exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup,
-                                             report)
-            delay = min(sup.backoff_cap_s, sup.backoff_base_s
-                        * sup.backoff_factor ** (failures - 1))
-            report.log("backoff", delay_s=round(delay, 3))
-            sup.sleep(delay)
-            continue
-        failures = 0
-        state = out
-        done += this_chunk
-        report.chunks_run += 1
-        report.ticks_run += this_chunk
-        report.log("chunk_ok", **info)
-        if events_out is not None:
-            events_out.extend(chunk_events)
-        if health_out is not None:
-            health_out.extend(chunk_health)
-        window_end = sup.max_chunks is not None \
-            and report.chunks_run >= sup.max_chunks and done < n_ticks
-        # a window end is ALWAYS a boundary: the max_chunks contract says
-        # "stop cleanly (checkpoint written if a dir is set)" — without
-        # this, a stop off the checkpoint cadence would discard the whole
-        # window's progress on resume
-        at_boundary = done >= next_ckpt or done >= n_ticks or window_end
-        if at_boundary and sup.state_to_host is not None:
-            # collective on EVERY rank (multihost.gather_state) at the
-            # checkpoint cadence even with no checkpoint_dir — the crash
-            # dump's freshness rides this; only write_files ranks then
-            # touch the filesystem
-            last_host_state, last_host_done = sup.state_to_host(state), done
-        if at_boundary and sup.checkpoint_dir:
-            to_save = state if sup.state_to_host is None else last_host_state
-            if sup.write_files:
-                path = _ckpt_path(sup.checkpoint_dir, start_tick + done)
-                os.makedirs(sup.checkpoint_dir, exist_ok=True)
-                checkpoint.save(path, to_save, cfg=cfg)   # crash-atomic
-                report.checkpoints.append(path)
-                report.log("checkpoint", tick=start_tick + done, path=path)
-                _prune_checkpoints(sup.checkpoint_dir, sup.keep_checkpoints)
-        if at_boundary:
-            next_ckpt = done + every
-        if window_end:
-            # clean window end: the caller resumes the same (key, n_ticks)
-            # schedule later — the per-tick keys are a function of BOTH,
-            # so a resumed run must re-request the full n_ticks
-            report.log("window_end", chunks=report.chunks_run,
-                       tick=start_tick + done)
-            break
+    try:
+        while done < n_ticks:
+            this_chunk = min(chunk_ticks, n_ticks - done)
+            keys_chunk = all_keys[done:done + this_chunk]
+            info = {"chunk_start": start_tick + done, "chunk_ticks": this_chunk,
+                    "attempt": failures, "degrade_level": report.degrade_level}
+            chunk_events: list = []
+            chunk_health: list = []
+            try:
+                out, chunk_records = _run_chunk(state, exec_cfg, tp, keys_chunk,
+                                                sup, traced, chunk_events,
+                                                chunk_health, _chunk_hook, info)
+            except Exception as e:
+                _hard_flush(sup.sinks)
+                failures += 1
+                # a MULTI-PROCESS run fails fast: the retry/degrade ladder is
+                # rank-LOCAL, so one rank re-dispatching a degraded (different
+                # collective sequence) or re-sized program while its peers sit
+                # in the original chunk's collectives would deadlock or pair
+                # wrong collectives. Recovery that IS rank-symmetric by
+                # construction: crash, relaunch every rank, resume from the
+                # last checkpoint (scripts/run_multihost.py).
+                multiproc = sup.run_fn is not None and jax.process_count() > 1
+                if _is_invariant_trip(e) or multiproc \
+                        or failures > sup.max_retries:
+                    # invariant trips are never retried: the trajectory itself
+                    # is poisoned and would trip again on the same keys
+                    dump = None
+                    if sup.write_files and sup.state_to_host is None:
+                        dump = _write_crash_dump(sup, cfg, state,
+                                                 keys_chunk, start_tick, done,
+                                                 this_chunk, n_ticks, e, report)
+                    elif sup.write_files and last_host_state is not None:
+                        # the gathered copy may be chunks old: re-anchor the
+                        # dumped window to ITS tick so replay_crash.py feeds
+                        # last_good exactly the keys that advance it into the
+                        # failure
+                        w0, w1 = last_host_done, done + this_chunk
+                        dump = _write_crash_dump(sup, cfg, last_host_state,
+                                                 all_keys[w0:w1], start_tick,
+                                                 w0, w1 - w0, n_ticks, e,
+                                                 report)
+                    report.crash_dump = dump
+                    if journal is not None:
+                        # the dashboard's post-mortem hook: the journal ends
+                        # with WHERE it died and which dump replays it
+                        journal.note("crash", tick=start_tick + done,
+                                     dump=dump, error=str(e)[:200])
+                    raise SupervisorCrash(
+                        f"supervised run gave up at tick {start_tick + done} "
+                        f"({failures} consecutive failure(s)); crash dump: "
+                        f"{dump}", dump_dir=dump, report=report) from e
+                report.retries += 1
+                report.log("chunk_failed",
+                           kind="deadline" if isinstance(e, ChunkDeadline)
+                           else "error", error=str(e)[:200], **info)
+                exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup,
+                                                 report)
+                delay = min(sup.backoff_cap_s, sup.backoff_base_s
+                            * sup.backoff_factor ** (failures - 1))
+                report.log("backoff", delay_s=round(delay, 3))
+                sup.sleep(delay)
+                continue
+            failures = 0
+            state = out
+            done += this_chunk
+            report.chunks_run += 1
+            report.ticks_run += this_chunk
+            report.log("chunk_ok", **info)
+            if events_out is not None:
+                events_out.extend(chunk_events)
+            if health_out is not None:
+                health_out.extend(chunk_health)
+            if journal is not None:
+                # stream the SUCCESSFUL chunk (a failed attempt's records died
+                # with its discarded output — the journal never double-counts
+                # a retried tick): one fetch of the [C]-stacked device buffer,
+                # encoded native-first, fsync'd before the loop moves on
+                if chunk_records is not None:
+                    journal.append_records(chunk_records,
+                                           tick_start=start_tick + done
+                                           - this_chunk, ticks=this_chunk)
+                elif traced and chunk_health:
+                    journal.append_dicts(chunk_health,
+                                         tick_start=start_tick + done
+                                         - this_chunk, ticks=this_chunk)
+                else:
+                    # a runner that yields no records (a plain custom
+                    # run_fn) still marks progress: the dashboard's hb/s
+                    # and chunk cadence come from these markers
+                    journal.note("chunk", rows=0,
+                                 tick_start=start_tick + done - this_chunk,
+                                 ticks=this_chunk)
+            window_end = sup.max_chunks is not None \
+                and report.chunks_run >= sup.max_chunks and done < n_ticks
+            # a window end is ALWAYS a boundary: the max_chunks contract says
+            # "stop cleanly (checkpoint written if a dir is set)" — without
+            # this, a stop off the checkpoint cadence would discard the whole
+            # window's progress on resume
+            at_boundary = done >= next_ckpt or done >= n_ticks or window_end
+            if at_boundary and sup.state_to_host is not None:
+                # collective on EVERY rank (multihost.gather_state) at the
+                # checkpoint cadence even with no checkpoint_dir — the crash
+                # dump's freshness rides this; only write_files ranks then
+                # touch the filesystem
+                last_host_state, last_host_done = sup.state_to_host(state), done
+            if at_boundary and sup.checkpoint_dir:
+                to_save = state if sup.state_to_host is None else last_host_state
+                if sup.write_files:
+                    path = _ckpt_path(sup.checkpoint_dir, start_tick + done)
+                    os.makedirs(sup.checkpoint_dir, exist_ok=True)
+                    checkpoint.save(path, to_save, cfg=cfg)   # crash-atomic
+                    report.checkpoints.append(path)
+                    report.log("checkpoint", tick=start_tick + done, path=path)
+                    if journal is not None:
+                        journal.note("checkpoint", tick=start_tick + done,
+                                     path=path)
+                    _prune_checkpoints(sup.checkpoint_dir, sup.keep_checkpoints)
+            if at_boundary:
+                next_ckpt = done + every
+            if window_end:
+                # clean window end: the caller resumes the same (key, n_ticks)
+                # schedule later — the per-tick keys are a function of BOTH,
+                # so a resumed run must re-request the full n_ticks
+                report.log("window_end", chunks=report.chunks_run,
+                           tick=start_tick + done)
+                break
+        if journal is not None:
+            # terminal marker: a bounded-window stop (max_chunks) is a
+            # PAUSE the caller resumes — the dashboard keeps tailing a
+            # "window_end" journal; only true completion is "run_end"
+            journal.note("window_end" if done < n_ticks else "run_end",
+                         tick=start_tick + done, chunks=report.chunks_run)
+    finally:
+        # close no matter how the loop left — a checkpoint-save error or
+        # a KeyboardInterrupt in a backoff sleep must not leak the fd
+        # (the crash branch already noted its marker before raising)
+        if journal is not None:
+            journal.close()
     return state, report

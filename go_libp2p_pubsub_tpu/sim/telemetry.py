@@ -1,0 +1,382 @@
+"""Streaming telemetry plane: device-side health reduction + journal sink.
+
+ROADMAP item 5: a 100k-peer run at the 1000 hb/s bar emits far more trace
+events than the Python JSON sinks can swallow, so analysis has been
+post-hoc files and unattended TPU windows ran blind. This module turns L5
+into a streaming pipeline built on one idea: **reduce on device, ship
+aggregates**. A :class:`HealthRecord` — per-topic delivery fraction, mesh
+degree min/mean/max, backoff/graylist census, score stats, publish and
+deliver counters, ``halo_overflow`` and the ``fault_flags`` health word —
+is computed INSIDE the scan for every tick and stacked into a ``[C, ...]``
+device buffer, so one ``device_get`` per chunk boundary replaces a
+per-tick state diff (the ``run_traced`` event export syncs the host every
+tick; PERF_MODEL.md "Tracing overhead" prices the difference).
+
+The wiring (one record schema, every execution plane):
+
+- ``engine.run_keys(..., telemetry=True)`` / ``run_checked_keys`` return
+  ``(state, HealthRecord)`` with ``[C]``-stacked leaves;
+- ``sim.fleet`` stacks a fleet axis: ``[C, B]`` leaves, per-member rows;
+- ``parallel.sharding.make_sharded_run_keys(..., telemetry=True)`` emits
+  the records REPLICATED from the sharded scan (the reductions ride the
+  same collectives as the step; every rank holds the aggregates, only
+  rank 0 writes — the multihost journal discipline);
+- ``sim.supervisor`` streams each successful chunk's records to a fsync'd
+  ``health.jsonl`` journal (``SupervisorConfig.health_path`` /
+  ``GRAFT_HEALTH_STREAM``), with run/chunk/checkpoint marker lines, so a
+  crashed run leaves a readable stream up to its last good chunk;
+- ``scripts/dashboard.py`` tails that journal live (``--once`` for a
+  snapshot).
+
+The sink hot path rides the native codec (``native/trace_codec.cpp``
+``trace_codec_health_json``) when it loads — one C call formats a whole
+chunk's rows to NDJSON — with the pure-Python encoder as fallback
+(identical parsed values; tests pin parity).
+
+Parity contract (tests/test_telemetry.py): the streamed records are
+bit-identical to :func:`health_record` applied post-hoc to the state
+trajectory — same function, same inputs, whether the scan stacked it or
+vmap batched it. Under the SPMD-sharded step one column is exempt:
+``score_mean`` sums arbitrary f32 values across shards, and per-shard
+partial sums reassociate (~1 ulp vs the unsharded order). Every other
+column stays exact even sharded — the censuses are integer counts, the
+delivery/mesh sums are integer-valued f32 accumulations (exact below
+2^24 regardless of order), and min/max are order-free.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import SimConfig, TopicParams
+from .state import SimState, unpack_have
+
+# sentinels as numpy scalars (module-level jnp constants leak stale
+# tracers across fleet-group retraces — sim/state.py NEVER rationale)
+_BIG_I32 = np.int32(2**30)
+_BIG_F32 = np.float32(3.0e38)
+
+
+class HealthRecord(NamedTuple):
+    """Per-tick device-side aggregates. Every leaf is a scalar except
+    ``delivery_frac`` (``[T]``); the scan stacks a leading ``[C]`` axis
+    and the fleet plane a ``[C, B]`` axis. ``tick`` is the tick that RAN
+    (the record describes the state AFTER that tick — the same numbering
+    ``run_traced``'s health rows always used)."""
+
+    tick: jnp.ndarray             # i32: the tick this record closes
+    delivery_frac: jnp.ndarray    # [T] f32 per-topic settled delivery
+    mesh_deg_min: jnp.ndarray     # i32 over subscribed (peer, topic) pairs
+    mesh_deg_mean: jnp.ndarray    # f32
+    mesh_deg_max: jnp.ndarray     # i32
+    backoff_count: jnp.ndarray    # i32 live backoff entries (expiry > tick)
+    graylist_count: jnp.ndarray   # i32 connected edges scored below
+                                  #   graylist_threshold (AcceptFrom gate)
+    score_mean: jnp.ndarray       # f32 over connected slots
+    score_min: jnp.ndarray        # f32
+    published_window: jnp.ndarray  # i32 live slots of the message window
+    delivered_total: jnp.ndarray  # f32 cumulative delivery counter
+    halo_overflow: jnp.ndarray    # i32 (poisoned-route counter)
+    fault_flags: jnp.ndarray      # u32 health word (sim/invariants.py)
+
+
+def health_record(state: SimState, cfg: SimConfig,
+                  tp: TopicParams) -> HealthRecord:
+    """The device-side reduction: one :class:`HealthRecord` for the state
+    a just-completed tick left behind. Pure jnp over arrays the tick
+    already touched — the cost is one fused reduce pass per plane plus
+    one ``compute_scores`` read (the telemetry analogue of the heartbeat's
+    own score pass; measured in PERF_MODEL.md "Tracing overhead"). The
+    SAME function is the post-hoc path: applied to a stored trajectory it
+    must reproduce the streamed records bit for bit."""
+    from ..ops.score_ops import compute_scores
+
+    n, t_topics, k = state.mesh.shape
+    tick = state.tick
+
+    # --- per-topic settled delivery fraction (delivery_fraction, split
+    # by topic via a segment-sum over the message window) ---
+    age = tick - state.msg_publish_tick                       # [M]
+    alive = (age < cfg.history_length) & (age >= 0)
+    valid = state.msg_topic >= 0
+    t_m = jnp.clip(state.msg_topic, 0, t_topics - 1)
+    should = state.subscribed[:, t_m] & (alive & valid)[None, :]   # [N, M]
+    got = unpack_have(state, cfg.msg_window) & should
+    got_m = jnp.sum(got, axis=0).astype(jnp.float32)          # [M]
+    should_m = jnp.sum(should, axis=0).astype(jnp.float32)
+    zeros_t = jnp.zeros((t_topics,), jnp.float32)
+    got_t = zeros_t.at[t_m].add(jnp.where(valid, got_m, 0.0))
+    should_t = zeros_t.at[t_m].add(jnp.where(valid, should_m, 0.0))
+    delivery_frac = got_t / jnp.maximum(should_t, 1.0)
+
+    # --- mesh degree over subscribed (peer, topic) pairs ---
+    deg = jnp.sum(state.mesh, axis=-1).astype(jnp.int32)      # [N, T]
+    sub = state.subscribed
+    n_sub = jnp.sum(sub)
+    any_sub = n_sub > 0
+    deg_min = jnp.where(
+        any_sub, jnp.min(jnp.where(sub, deg, _BIG_I32)), 0).astype(jnp.int32)
+    deg_max = jnp.where(
+        any_sub, jnp.max(jnp.where(sub, deg, -1)), 0).astype(jnp.int32)
+    deg_mean = jnp.sum(jnp.where(sub, deg, 0)).astype(jnp.float32) \
+        / jnp.maximum(n_sub, 1).astype(jnp.float32)
+
+    # --- backoff / graylist census ---
+    backoff_count = jnp.sum(state.backoff > tick, dtype=jnp.int32)
+    scores = compute_scores(state, cfg, tp, apply_decay=True)  # [N, K]
+    gray = state.connected & (scores < cfg.graylist_threshold)
+    graylist_count = jnp.sum(gray, dtype=jnp.int32)
+
+    # --- score stats over connected slots ---
+    conn = state.connected
+    n_conn = jnp.sum(conn)
+    any_conn = n_conn > 0
+    score_mean = jnp.sum(jnp.where(conn, scores, 0.0)) \
+        / jnp.maximum(n_conn, 1).astype(jnp.float32)
+    score_min = jnp.where(
+        any_conn, jnp.min(jnp.where(conn, scores, _BIG_F32)), 0.0
+    ).astype(jnp.float32)
+
+    return HealthRecord(
+        tick=(tick - 1).astype(jnp.int32),   # the tick that ran
+        delivery_frac=delivery_frac,
+        mesh_deg_min=deg_min,
+        mesh_deg_mean=deg_mean,
+        mesh_deg_max=deg_max,
+        backoff_count=backoff_count,
+        graylist_count=graylist_count,
+        score_mean=score_mean,
+        score_min=score_min,
+        published_window=jnp.sum(valid, dtype=jnp.int32),
+        delivered_total=state.delivered_total,
+        halo_overflow=state.halo_overflow,
+        fault_flags=state.fault_flags,
+    )
+
+
+health_record_jit = jax.jit(health_record, static_argnames=("cfg",))
+
+
+# ---------------------------------------------------------------------------
+# row schema: one FLAT numeric row per (tick[, member]) — the same columns
+# whether the run was plain, chunked, fleet-batched, or multihost, so one
+# encoder (native or Python) and one dashboard read every journal
+
+_INT_COLS = {"tick", "member", "mesh_deg_min", "mesh_deg_max",
+             "backoff_count", "graylist_count", "published_window",
+             "halo_overflow", "fault_flags"}
+
+
+def health_columns(n_topics: int) -> list:
+    """Ordered ``(name, is_int)`` column schema of a journal health row.
+    ``member`` is the fleet input index (-1 for an unbatched run);
+    ``delivery_frac`` flattens to one column per topic."""
+    names = ["tick", "member"] \
+        + [f"delivery_frac_t{j}" for j in range(n_topics)] \
+        + ["mesh_deg_min", "mesh_deg_mean", "mesh_deg_max", "backoff_count",
+           "graylist_count", "score_mean", "score_min", "published_window",
+           "delivered_total", "halo_overflow", "fault_flags"]
+    return [(nm, nm in _INT_COLS) for nm in names]
+
+
+def _fetch(x) -> np.ndarray:
+    """Host value of a record leaf; a multi-process replicated global
+    array is not fully addressable — read the local replica (every
+    process holds the same aggregates by construction)."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    return np.asarray(x.addressable_shards[0].data)
+
+
+def records_to_rows(records: HealthRecord,
+                    member_ids=None) -> tuple[np.ndarray, list]:
+    """ONE host transfer for a whole chunk: fetch the stacked record
+    leaves and lay them out as a float64 row matrix (tick-major; fleet
+    members interleave within a tick). ``member_ids`` maps the fleet lane
+    position to the member's input index (rows of an unbatched run carry
+    member=-1). Returns ``(matrix [R, ncols], columns)``."""
+    leaves = jax.tree.map(_fetch, records)
+    tick = leaves.tick
+    batched = tick.ndim == 2                    # [C, B] vs [C]
+    c = tick.shape[0]
+    b = tick.shape[1] if batched else 1
+    t_topics = leaves.delivery_frac.shape[-1]
+    cols = health_columns(t_topics)
+    if member_ids is None:
+        member_ids = list(range(b)) if batched else [-1]
+    if len(member_ids) != b:
+        raise ValueError(
+            f"records_to_rows: {len(member_ids)} member ids for a "
+            f"B={b} record batch")
+
+    mat = np.empty((c * b, len(cols)), np.float64)
+    # [C] and [C, B] both flatten tick-major (members interleave in-tick)
+    mat[:, 0] = np.asarray(tick, np.float64).reshape(-1)
+    mat[:, 1] = np.tile(np.asarray(member_ids, np.float64), c)
+    mat[:, 2:2 + t_topics] = np.asarray(
+        leaves.delivery_frac, np.float64).reshape(c * b, t_topics)
+    scalar_fields = ["mesh_deg_min", "mesh_deg_mean", "mesh_deg_max",
+                     "backoff_count", "graylist_count", "score_mean",
+                     "score_min", "published_window", "delivered_total",
+                     "halo_overflow", "fault_flags"]
+    for i, f in enumerate(scalar_fields):
+        mat[:, 2 + t_topics + i] = np.asarray(
+            getattr(leaves, f), np.float64).reshape(c * b)
+    return mat, cols
+
+
+def record_to_row(record: HealthRecord, member: int = -1) -> dict:
+    """One unstacked record as a flat row dict (run_traced's per-tick
+    host path; the streamed path goes through :func:`records_to_rows`)."""
+    stacked = jax.tree.map(lambda x: jnp.asarray(x)[None], record)
+    mat, cols = records_to_rows(stacked, member_ids=[member])
+    return rows_to_dicts(mat, cols)[0]
+
+
+def rows_to_dicts(matrix: np.ndarray, columns: list) -> list:
+    """Row matrix -> list of plain dicts (tests, dashboard, fallbacks)."""
+    out = []
+    for r in np.asarray(matrix, np.float64):
+        out.append({nm: (int(v) if is_int else float(v))
+                    for (nm, is_int), v in zip(columns, r)})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NDJSON encoders: native hot path, Python fallback
+
+
+def encode_rows_py(matrix: np.ndarray, columns: list) -> bytes:
+    """Pure-Python NDJSON encoder (the fallback sink). Non-finite floats
+    encode as null — NaN is not JSON and a reader must never choke on a
+    degraded row."""
+    lines = []
+    for d in rows_to_dicts(matrix, columns):
+        for k, v in d.items():
+            if isinstance(v, float) and not np.isfinite(v):
+                d[k] = None
+        lines.append(json.dumps({"kind": "health", **d}))
+    return ("\n".join(lines) + "\n").encode() if lines else b""
+
+
+def encode_rows(matrix: np.ndarray, columns: list,
+                prefer_native: bool = True) -> tuple[bytes, str]:
+    """Encode a chunk's rows; ``(payload, encoder_name)``. The native
+    codec formats the whole matrix in one C call; values parse back equal
+    to the Python encoder's (float text differs — %.17g vs repr — but
+    round-trips to the same doubles)."""
+    if prefer_native:
+        from ..trace.native import encode_health_json
+        payload = encode_health_json(matrix, columns)
+        if payload is not None:
+            return payload, "native"
+    return encode_rows_py(matrix, columns), "python"
+
+
+# ---------------------------------------------------------------------------
+# the journal sink
+
+
+class HealthJournal:
+    """Append-only fsync'd NDJSON health journal.
+
+    Line kinds: ``run`` (header: config fingerprint, shape, schema),
+    ``chunk`` (one per streamed chunk: window bounds + wall-clock stamp —
+    the dashboard's hb/s source), ``health`` (the record rows),
+    ``checkpoint`` / ``crash`` markers. Every append ends in
+    flush+fsync, so a kill leaves at most one torn tail line —
+    :func:`read_journal` skips it and a resume keeps appending (readers
+    dedup health rows by ``(member, tick)``, last wins)."""
+
+    def __init__(self, path: str, prefer_native: bool = True):
+        self.path = path
+        self.prefer_native = prefer_native
+        self.encoder = "python"
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "ab")
+
+    def _write(self, payload: bytes) -> None:
+        self._fh.write(payload)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def note(self, kind: str, **meta) -> None:
+        self._write((json.dumps({"kind": kind, "wall": time.time(),
+                                 **meta}) + "\n").encode())
+
+    def header(self, cfg: SimConfig, **meta) -> None:
+        from . import checkpoint
+        self.note("run",
+                  fingerprint=checkpoint.config_fingerprint(cfg),
+                  n_peers=cfg.n_peers, n_topics=cfg.n_topics,
+                  invariant_mode=cfg.invariant_mode,
+                  columns=[nm for nm, _ in health_columns(cfg.n_topics)],
+                  **meta)
+
+    def append_records(self, records: HealthRecord, member_ids=None,
+                       **chunk_meta) -> int:
+        """Stream one chunk: a ``chunk`` marker then the health rows,
+        one fsync'd write each. Returns the row count."""
+        mat, cols = records_to_rows(records, member_ids=member_ids)
+        payload, self.encoder = encode_rows(mat, cols, self.prefer_native)
+        self.note("chunk", rows=int(mat.shape[0]), encoder=self.encoder,
+                  **chunk_meta)
+        self._write(payload)
+        return int(mat.shape[0])
+
+    def append_dicts(self, rows: list, **chunk_meta) -> int:
+        """Pre-built row dicts (the traced path's per-tick host records
+        ride this; ``None`` values pass through as JSON null)."""
+        self.note("chunk", rows=len(rows), encoder="python", **chunk_meta)
+        if rows:
+            self._write(("\n".join(
+                json.dumps({"kind": "health", **r}) for r in rows)
+                + "\n").encode())
+        return len(rows)
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_journal(path: str) -> dict:
+    """Tolerant journal read: ``{"runs", "chunks", "notes", "rows"}``.
+    Torn tail lines (kill mid-append) are skipped; health rows dedup by
+    ``(member, tick)`` with the LAST occurrence winning (a resumed run
+    legitimately re-streams ticks after its restore point)."""
+    runs, chunks, notes = [], [], []
+    rows: dict = {}
+    if not os.path.exists(path):
+        return {"runs": runs, "chunks": chunks, "notes": notes, "rows": []}
+    with open(path, "rb") as f:
+        for raw in f:
+            try:
+                d = json.loads(raw)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue                        # torn tail line
+            kind = d.get("kind")
+            if kind == "health":
+                rows[(d.get("member", -1), d.get("tick"))] = d
+            elif kind == "run":
+                runs.append(d)
+            elif kind == "chunk":
+                chunks.append(d)
+            else:
+                notes.append(d)
+    ordered = sorted(rows.values(),
+                     key=lambda r: (r.get("tick", 0), r.get("member", -1)))
+    return {"runs": runs, "chunks": chunks, "notes": notes, "rows": ordered}

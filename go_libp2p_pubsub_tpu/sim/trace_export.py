@@ -116,13 +116,20 @@ def run_traced(state: SimState, cfg: SimConfig, tp: TopicParams, key,
     for differential testing and trace tooling at diagnostic scale — the
     per-tick host sync makes it unfit for benchmarking.
 
-    ``health_out``: optional list that receives one record per tick —
-    ``{"tick", "fault_flags", "flags"}`` (sim/invariants.py bit layout,
-    decoded names included) — so an exported trace always travels with its
-    health word and a poisoned or fault-injected run can never be analyzed
-    as a clean one. Kept OUT of the event stream itself: the pb/trace wire
-    schema (pb/codec.py) has no health message, and replay consumers must
-    keep round-tripping byte-exact.
+    ``health_out``: optional list that receives one row dict per tick:
+    the full telemetry aggregates (sim/telemetry.py ``health_record``
+    columns — per-topic delivery, mesh degree, backoff/graylist census,
+    score stats, counters) plus the legacy ``{"tick", "fault_flags",
+    "flags"}`` keys (sim/invariants.py bit layout, decoded names) — so an
+    exported trace always travels with its health word and a poisoned or
+    fault-injected run can never be analyzed as a clean one. The row is
+    emitted for EVERY tick regardless of ``invariant_mode``:
+    delivery/mesh metrics don't need the flag word, so under ``"off"``
+    the record still streams with ``fault_flags``/``flags`` set to None
+    (nothing tracked, as opposed to 0 = tracked-and-clean). Kept OUT of
+    the event stream itself: the pb/trace wire schema (pb/codec.py) has
+    no health message, and replay consumers must keep round-tripping
+    byte-exact.
 
     ``keys``: optional explicit per-tick key array (``key``/``n_ticks``
     are then ignored). Passing ``jax.random.split(key, n_ticks)`` puts the
@@ -134,6 +141,7 @@ def run_traced(state: SimState, cfg: SimConfig, tp: TopicParams, key,
     assert cfg.record_provenance, "run_traced needs cfg.record_provenance"
     from .engine import step_jit
     from .invariants import decode_flags
+    from .telemetry import health_record_jit, record_to_row
 
     events: list[dict] = []
     for i in range(n_ticks if keys is None else len(keys)):
@@ -143,10 +151,18 @@ def run_traced(state: SimState, cfg: SimConfig, tp: TopicParams, key,
             k = keys[i]
         nxt = step_jit(state, cfg, tp, k)
         events.extend(export_events(state, nxt))
-        if health_out is not None and cfg.invariant_mode != "off":
-            flags = int(np.asarray(nxt.fault_flags))
-            health_out.append({"tick": int(np.asarray(state.tick)),
-                               "fault_flags": flags,
-                               "flags": decode_flags(flags)})
+        if health_out is not None:
+            # the record streams ALWAYS: delivery/mesh aggregates don't
+            # need the sentinel; with invariants off the flag keys are
+            # None (not tracked) instead of a misleading clean 0
+            row = record_to_row(health_record_jit(nxt, cfg, tp))
+            if cfg.invariant_mode != "off":
+                flags = int(np.asarray(nxt.fault_flags))
+                row["fault_flags"] = flags
+                row["flags"] = decode_flags(flags)
+            else:
+                row["fault_flags"] = None
+                row["flags"] = None
+            health_out.append(row)
         state = nxt
     return state, events

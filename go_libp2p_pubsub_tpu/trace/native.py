@@ -69,6 +69,21 @@ def load():
             ctypes.POINTER(ctypes.c_long),
         ]
         lib.trace_codec_free.argtypes = [ctypes.c_void_p]
+        # the health-row NDJSON encoder (sim/telemetry.py hot sink path);
+        # a stale .so built before the symbol existed degrades to the
+        # Python encoder instead of failing the load
+        try:
+            lib.trace_codec_health_json.restype = ctypes.c_int
+            lib.trace_codec_health_json.argtypes = [
+                ctypes.POINTER(ctypes.c_double),      # vals [rows*cols]
+                ctypes.c_long, ctypes.c_long,         # n_rows, n_cols
+                ctypes.c_char_p, ctypes.c_long,       # names blob, len
+                ctypes.c_char_p,                      # is_int per col
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+                ctypes.POINTER(ctypes.c_long),
+            ]
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -154,3 +169,40 @@ def tensorize_file(path: str, peer_index: dict, topic_index: dict,
                    **kw) -> ReplayFeed:
     with open(path, "rb") as f:
         return tensorize_bytes(f.read(), peer_index, topic_index, **kw)
+
+
+def encode_health_json(matrix, columns) -> bytes | None:
+    """Format a telemetry row matrix as NDJSON in ONE native call — the
+    hot sink path of the streaming health journal (sim/telemetry.py).
+    ``matrix`` is ``[n_rows, n_cols]`` float64, ``columns`` the ordered
+    ``(name, is_int)`` schema. Returns None when the native library (or
+    the symbol, in a stale pre-telemetry .so) is unavailable — the caller
+    falls back to the pure-Python encoder, which parses to identical
+    values."""
+    lib = load()
+    if lib is None or not hasattr(lib, "trace_codec_health_json"):
+        return None
+    mat = np.ascontiguousarray(matrix, np.float64)
+    if mat.ndim != 2 or mat.shape[1] != len(columns):
+        raise ValueError(
+            f"encode_health_json: matrix {mat.shape} does not match "
+            f"{len(columns)} columns")
+    if mat.shape[0] == 0:
+        return b""
+    blob = bytearray()
+    for name, _is_int in columns:
+        raw = name.encode()
+        blob += len(raw).to_bytes(4, "little") + raw
+    is_int = bytes(1 if i else 0 for _n, i in columns)
+    out = ctypes.POINTER(ctypes.c_char)()
+    out_len = ctypes.c_long()
+    rc = lib.trace_codec_health_json(
+        mat.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        mat.shape[0], mat.shape[1], bytes(blob), len(blob), is_int,
+        ctypes.byref(out), ctypes.byref(out_len))
+    if rc != 0:
+        lib.trace_codec_free(out)
+        return None
+    payload = ctypes.string_at(out, out_len.value)
+    lib.trace_codec_free(out)
+    return payload

@@ -1,0 +1,370 @@
+#!/usr/bin/env python
+"""Live dashboard over a streaming health journal (sim/telemetry.py).
+
+Tails the fsync'd ``health.jsonl`` a supervised run streams
+(``GRAFT_HEALTH_STREAM=path`` / ``SupervisorConfig.health_path``, fleet
+and multihost included) and renders the run's vitals without ever
+touching the device — the watch-an-unattended-TPU-window tool ROADMAP
+item 5 asks for:
+
+- progress: last completed tick / scheduled ticks, chunk cadence
+- throughput: heartbeats/sec from consecutive chunk markers' wall stamps
+  (recent median), a number comparable to bench.py's metric lines
+- delivery fraction per topic (+ sparkline of the recent trend)
+- mesh degree min/mean/max, backoff + graylist census, score mean/min
+- the decoded ``fault_flags`` health word (a poisoned run shows its
+  VIOLATION bits here the moment the chunk that lit them lands)
+- checkpoint ticks and crash markers (post-mortem starts here: the crash
+  line names the dump directory ``scripts/replay_crash.py`` replays)
+- fleet journals: per-member summary (worst delivery / tripped flags)
+
+Usage:
+    python scripts/dashboard.py HEALTH_JSONL            # live (2s refresh)
+    python scripts/dashboard.py HEALTH_JSONL --once     # one snapshot
+    python scripts/dashboard.py HEALTH_JSONL --once --json   # machine form
+
+The journal is read tolerantly (``telemetry.read_journal``): torn tail
+lines from a kill mid-append are skipped, resumed runs dedup by tick.
+Exit: 0 on a readable journal (even mid-run), 1 when the file never
+appears within ``--wait`` seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the dashboard is a host-only tool: it must never grab the (exclusive,
+# wedgeable) remote TPU just to pretty-print a journal
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_SPARK = " ▁▂▃▄▅▆▇█"
+
+
+def _decode_flags(flags):
+    if not flags:
+        return []
+    try:
+        from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+        return decode_flags(int(flags))
+    except Exception:
+        return [f"0x{int(flags):x}"]
+
+
+def _sparkline(vals, width: int = 40) -> str:
+    if not vals:
+        return ""
+    vals = vals[-width:]
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    return "".join(_SPARK[int((v - lo) / span * (len(_SPARK) - 1))]
+                   for v in vals)
+
+
+def _topic_fracs(row: dict) -> list:
+    out = []
+    t = 0
+    while f"delivery_frac_t{t}" in row:
+        out.append(row[f"delivery_frac_t{t}"])
+        t += 1
+    return out
+
+
+def _hbps(chunks: list, window: int = 8):
+    """Recent heartbeats/sec from consecutive chunk markers: each marker
+    stamps wall time at append, so rows/(wall delta) prices the chunk
+    INCLUDING its journal write. ``rows`` is member-ticks (ticks × active
+    members under fleet, == ticks unbatched), so the number is the
+    AGGREGATE rate — comparable to bench.py's metric lines, fleet
+    included. Median of the last few deltas."""
+    rates = []
+    for a, b in list(zip(chunks, chunks[1:]))[-window:]:
+        dt = b.get("wall", 0) - a.get("wall", 0)
+        ticks = b.get("rows") or b.get("ticks") or 0
+        if dt > 0 and ticks:
+            rates.append(ticks / dt)
+    if not rates:
+        return None
+    rates.sort()
+    return rates[len(rates) // 2]
+
+
+class _Tailer:
+    """Incremental journal reader for live mode: O(new bytes) per poll
+    and bounded memory regardless of run length — a multi-day unattended
+    window's journal grows one row per member-tick, and re-parsing the
+    whole file every refresh would lag the interval and grow RSS without
+    bound. Keeps exactly the bounded recent window the render uses."""
+
+    MAX_ROWS = 4096
+
+    def __init__(self, path: str):
+        import collections
+        self.path = path
+        self.offset = 0
+        self.buf = b""
+        self.runs: list = []
+        self.chunks = collections.deque(maxlen=64)
+        self.chunk_count = 0
+        self.notes = collections.deque(maxlen=256)
+        self.rows = collections.OrderedDict()
+
+    def poll(self) -> None:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size < self.offset:              # truncated/rotated: restart
+            self.offset, self.buf = 0, b""
+        with open(self.path, "rb") as f:
+            f.seek(self.offset)
+            data = self.buf + f.read()
+            self.offset = f.tell()
+        lines = data.split(b"\n")
+        self.buf = lines.pop()              # torn tail rides to next poll
+        for ln in lines:
+            if not ln.strip():
+                continue
+            try:
+                d = json.loads(ln)
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue
+            kind = d.get("kind")
+            if kind == "health":
+                key = (d.get("member", -1), d.get("tick"))
+                self.rows.pop(key, None)    # resume overlap: last wins
+                self.rows[key] = d
+                while len(self.rows) > self.MAX_ROWS:
+                    self.rows.popitem(last=False)
+            elif kind == "run":
+                self.runs = self.runs[-7:] + [d]
+            elif kind == "chunk":
+                self.chunks.append(d)
+                self.chunk_count += 1
+            else:
+                self.notes.append(d)
+
+    def journal(self) -> dict:
+        return {"runs": self.runs, "chunks": list(self.chunks),
+                "notes": list(self.notes),
+                "rows": sorted(self.rows.values(),
+                               key=lambda r: (r.get("tick", 0),
+                                              r.get("member", -1))),
+                "chunks_total": self.chunk_count}
+
+
+def snapshot(path: str) -> dict:
+    """One machine-readable view of the journal (the --json form; the
+    text renderer formats exactly this). Reads the whole file — the
+    --once path; live mode feeds :func:`_snapshot_of` from a bounded
+    incremental :class:`_Tailer` instead."""
+    from go_libp2p_pubsub_tpu.sim.telemetry import read_journal
+
+    return _snapshot_of(read_journal(path), path)
+
+
+def _snapshot_of(j: dict, path: str) -> dict:
+    rows = j["rows"]
+    run = j["runs"][-1] if j["runs"] else {}
+    # terminal markers count only AFTER the newest run header: a resumed
+    # run must not inherit its previous window's run_end/window_end
+    run_wall = run.get("wall", 0)
+    current = [n for n in j["notes"] if n.get("wall", 0) >= run_wall]
+    snap: dict = {
+        "path": path,
+        "run": {k: run.get(k) for k in ("scenario", "n_peers", "n_topics",
+                                        "n_ticks", "invariant_mode",
+                                        "plane", "group", "member_names")
+                if run.get(k) is not None},
+        "chunks": j.get("chunks_total", len(j["chunks"])),
+        "rows": len(rows),
+        "hbps": _hbps(j["chunks"]),
+        "checkpoints": [n.get("tick", n.get("done"))
+                        for n in j["notes"] if n.get("kind") == "checkpoint"],
+        "crashes": [{"tick": n.get("tick"), "dump": n.get("dump"),
+                     "error": n.get("error")}
+                    for n in current if n.get("kind") == "crash"],
+        "done": any(n.get("kind") == "run_end" for n in current),
+        # a bounded TPU window stopped cleanly and will resume the same
+        # schedule (supervisor max_chunks) — live-tail keeps tailing
+        "paused": any(n.get("kind") == "window_end" for n in current),
+    }
+    if not rows:
+        return snap
+    members = sorted({r.get("member", -1) for r in rows})
+    fleet = members != [-1]
+    last_tick = max(r["tick"] for r in rows)
+    latest = [r for r in rows if r["tick"] == last_tick]
+    head = latest[0]
+    fracs = [_topic_fracs(r) for r in latest]
+    flat = [f for fr in fracs for f in fr]
+    snap.update({
+        "tick": last_tick,
+        "fleet_members": len(members) if fleet else None,
+        "delivery_frac": (sum(flat) / len(flat)) if flat else None,
+        "delivery_frac_topics": fracs[0] if not fleet else None,
+        "mesh_deg": {k: head.get(f"mesh_deg_{k}")
+                     for k in ("min", "mean", "max")},
+        "backoff_count": head.get("backoff_count"),
+        "graylist_count": head.get("graylist_count"),
+        "score_mean": head.get("score_mean"),
+        "score_min": head.get("score_min"),
+        "published_window": head.get("published_window"),
+        "delivered_total": head.get("delivered_total"),
+        "halo_overflow": max((r.get("halo_overflow") or 0) for r in latest),
+        "fault_flags": None if head.get("fault_flags") is None else
+        int(max((r.get("fault_flags") or 0) for r in latest)),
+    })
+    if snap["run"].get("invariant_mode") == "off":
+        # the numeric row schema streams 0 when the sentinel is off, but
+        # an untracked run must never read as verified-clean (the same
+        # not-tracked ≠ clean rule run_traced's None flags encode)
+        snap["fault_flags"] = None
+    snap["fault_flag_names"] = _decode_flags(snap["fault_flags"])
+    # recent trend for the sparkline: mean delivery per tick
+    trend: dict = {}
+    for r in rows:
+        fr = _topic_fracs(r)
+        if fr:
+            trend.setdefault(r["tick"], []).append(sum(fr) / len(fr))
+    snap["trend"] = [sum(v) / len(v)
+                     for _t, v in sorted(trend.items())[-60:]]
+    if fleet:
+        worst = min(latest,
+                    key=lambda r: (sum(_topic_fracs(r)) /
+                                   max(len(_topic_fracs(r)), 1)))
+        wf = _topic_fracs(worst)
+        snap["worst_member"] = {
+            "member": worst.get("member"),
+            "delivery_frac": sum(wf) / len(wf) if wf else None,
+            "fault_flags": worst.get("fault_flags")}
+    return snap
+
+
+def render(snap: dict) -> str:
+    out = []
+    run = snap.get("run", {})
+    title = run.get("scenario") or os.path.basename(snap["path"])
+    shape = f"{run.get('n_peers', '?')} peers"
+    if snap.get("fleet_members"):
+        shape += f" x {snap['fleet_members']} members"
+    status = "ENDED" if snap.get("done") else (
+        "CRASHED" if snap.get("crashes") else
+        "PAUSED (resumable)" if snap.get("paused") else "live")
+    out.append(f"== graft telemetry :: {title} ({shape}) [{status}] ==")
+    if "tick" not in snap:
+        # a first-chunk crash journals no health rows — the crash pointer
+        # (the post-mortem entry point) must still render
+        out.append("  (no health rows yet)")
+        for c in snap.get("crashes", []):
+            out.append(f"  CRASH @ tick {c.get('tick')}: {c.get('error')}")
+            out.append(f"    replay: python scripts/replay_crash.py "
+                       f"{c.get('dump')}")
+        return "\n".join(out)
+    n_ticks = run.get("n_ticks")
+    prog = f"tick {snap['tick'] + 1}"
+    if isinstance(n_ticks, int):
+        prog += f" / {n_ticks}"
+    elif isinstance(n_ticks, list):
+        prog += f" / {max(n_ticks)}"
+    hb = snap.get("hbps")
+    out.append(f"  {prog}   chunks {snap['chunks']}   "
+               f"hb/s {hb:.2f}" if hb else f"  {prog}   "
+               f"chunks {snap['chunks']}   hb/s ?")
+    df = snap.get("delivery_frac")
+    line = f"  delivery {df:.4f}" if df is not None else "  delivery ?"
+    if snap.get("delivery_frac_topics") and \
+            len(snap["delivery_frac_topics"]) > 1:
+        line += " [" + " ".join(f"{f:.3f}"
+                                for f in snap["delivery_frac_topics"]) + "]"
+    out.append(line + "   " + _sparkline(snap.get("trend", [])))
+    def num(key, spec=""):
+        # a partial or degraded row may miss columns; render "?" rather
+        # than crash the one tool meant to survive degraded runs
+        v = snap.get(key)
+        return "?" if v is None else format(v, spec)
+
+    deg = snap.get("mesh_deg", {})
+    out.append(f"  mesh degree min/mean/max "
+               f"{deg.get('min')}/{deg.get('mean'):.2f}/{deg.get('max')}"
+               if deg.get("mean") is not None else "  mesh degree ?")
+    out.append(f"  backoff {num('backoff_count')}   "
+               f"graylist {num('graylist_count')}   "
+               f"score mean/min {num('score_mean', '.3f')}/"
+               f"{num('score_min', '.3f')}")
+    out.append(f"  window msgs {num('published_window')}   "
+               f"delivered(total) {num('delivered_total', '.0f')}   "
+               f"halo_overflow {num('halo_overflow')}")
+    ff = snap.get("fault_flags")
+    if ff is None:
+        out.append("  flags: (invariants off)")
+    elif ff:
+        out.append(f"  flags: 0x{ff:x} " + " ".join(
+            snap.get("fault_flag_names", [])))
+    else:
+        out.append("  flags: clean")
+    if snap.get("worst_member"):
+        w = snap["worst_member"]
+        out.append(f"  worst member #{w['member']}: "
+                   f"delivery {w['delivery_frac']:.4f} "
+                   f"flags {w['fault_flags']}")
+    if snap.get("checkpoints"):
+        out.append("  checkpoints @ " + ", ".join(
+            str(t) for t in snap["checkpoints"][-4:]))
+    for c in snap.get("crashes", []):
+        out.append(f"  CRASH @ tick {c.get('tick')}: {c.get('error')}")
+        out.append(f"    replay: python scripts/replay_crash.py "
+                   f"{c.get('dump')}")
+    return "\n".join(out)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("journal", help="health.jsonl path (GRAFT_HEALTH_STREAM)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit (test/script mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the snapshot as one JSON object")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="live-mode refresh seconds (default 2)")
+    ap.add_argument("--wait", type=float, default=0.0,
+                    help="seconds to wait for the journal to appear")
+    args = ap.parse_args()
+
+    deadline = time.time() + args.wait
+    while not os.path.exists(args.journal):
+        if time.time() >= deadline:
+            print(f"no journal at {args.journal}", file=sys.stderr)
+            return 1
+        time.sleep(0.2)
+
+    if args.once:
+        snap = snapshot(args.journal)
+        try:
+            print(json.dumps(snap) if args.json else render(snap),
+                  flush=True)
+        except BrokenPipeError:         # `... --once | head` is fine
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+    tailer = _Tailer(args.journal)
+    try:
+        while True:
+            tailer.poll()
+            snap = _snapshot_of(tailer.journal(), args.journal)
+            body = json.dumps(snap) if args.json else render(snap)
+            sys.stdout.write("\x1b[2J\x1b[H" + body + "\n")
+            sys.stdout.flush()
+            if snap.get("done") or snap.get("crashes"):
+                return 0            # run over: leave the last frame up
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

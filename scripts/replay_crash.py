@@ -9,9 +9,17 @@ decoded health word. Replay restores the state, swaps
 re-execution of precisely the ticks that killed the run, with every
 violation escalated to a host exception naming its flags.
 
+FLEET dumps (sim/fleet.py `_write_fleet_crash_dump`, ``crash_fleet_*``
+directories) carry a [B]-batched last-good state and [C, B_active]
+per-tick keys; pass ``--member i`` to restore member ``i`` out of the
+batch and replay ITS window alone — the single-lane reproduction of a
+batched failure. Fleet dumps carry no scenario metadata (members may mix
+configs), so ``--scenario``/``--kwargs`` (or ``replay_fleet()`` with
+like/cfg/tp objects) must describe the member being replayed.
+
 Usage:
     python scripts/replay_crash.py CRASH_DIR [--scenario NAME]
-        [--record] [--kwargs '{"n_peers": 512}']
+        [--record] [--kwargs '{"n_peers": 512}'] [--member I]
 
 The scenario (a ``sim.scenarios.SCENARIOS`` key) and its kwargs default to
 what the supervisor stamped into crash.json; pass them explicitly for
@@ -96,6 +104,107 @@ def replay(crash_dir: str, like=None, cfg=None, tp=None,
     return result
 
 
+def is_fleet_dump(meta: dict) -> bool:
+    return "fleet_size" in meta
+
+
+def replay_fleet(crash_dir: str, member: int, like=None, cfg=None, tp=None,
+                 invariant_mode: str = "raise") -> dict:
+    """Restore member ``member`` (INPUT index, as named in the dump's
+    ``member_names``) out of a fleet crash dump's batched last-good state
+    and re-run its slice of the failing window.
+
+    ``like``/``cfg``/``tp`` describe ONE member (the same objects a
+    ``FleetMember`` carried); fleet dumps stamp no scenario metadata, so
+    they are required — from the caller directly or rebuilt by ``main``
+    from ``--scenario``/``--kwargs``. The restore verifies the dump's
+    fleet-axis-bound fingerprint against the rebuilt config (raise-mode
+    members executed in "record" — sim/fleet.py ``_exec_cfg`` — so the
+    config is normalized the same way before fingerprinting)."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from go_libp2p_pubsub_tpu.sim import checkpoint
+    from go_libp2p_pubsub_tpu.sim.engine import run_checked_keys, run_keys
+    from go_libp2p_pubsub_tpu.sim.fleet import (_exec_cfg, member_state,
+                                                stack_states)
+    from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+
+    meta = load_meta(crash_dir)
+    if not is_fleet_dump(meta):
+        raise SystemExit(f"{crash_dir!r} is not a fleet dump; run without "
+                         "--member")
+    if like is None or cfg is None or tp is None:
+        raise SystemExit(
+            "fleet dumps carry no scenario metadata (members may mix "
+            "configs); pass --scenario/--kwargs or call replay_fleet() "
+            "with like/cfg/tp objects for the member being replayed")
+    b = int(meta["fleet_size"])
+    # --member is the member's INPUT index; a mixed-config fleet splits
+    # into groups (one dump per group), so the dump's member_ids map
+    # input indices to group positions. Dumps written before member_ids
+    # existed fall back to treating --member as the group position.
+    ids = meta.get("member_ids")
+    if ids is not None:
+        if member not in ids:
+            raise SystemExit(
+                f"--member {member} is not in this dump's config group "
+                f"(member_ids: {ids}, names: {meta.get('member_names')}) — "
+                "a mixed-config fleet writes one dump per group; this "
+                "member crashed (or finished) under a different group")
+        gpos = ids.index(member)
+    else:
+        gpos = member
+    if not 0 <= gpos < b:
+        raise SystemExit(f"--member {member} outside fleet of {b} "
+                         f"(members: {meta.get('member_names')})")
+    group_cfg = _exec_cfg(cfg)
+    want = meta.get("config_fingerprint")
+    got = checkpoint.config_fingerprint(group_cfg, fleet=b)
+    if want and got != want:
+        raise SystemExit(
+            f"rebuilt fleet config fingerprint {got[:12]}… does not match "
+            f"the dump's {want[:12]}… — wrong scenario/kwargs (or a "
+            "weight-variant member needing explicit cfg/tp); replaying "
+            "under a drifted config would not reproduce the crash")
+    batched_like = stack_states([like] * b)
+    full = checkpoint.restore(os.path.join(crash_dir, "last_good"),
+                              batched_like, cfg=group_cfg)
+    state = member_state(full, gpos)
+    active = meta.get("active_members", list(range(b)))
+    if gpos not in active:
+        raise SystemExit(
+            f"member {member} was not active in the failing window "
+            f"(active group positions: {active}) — it had finished or was "
+            "retired; its keys are not in the dump")
+    pos = active.index(gpos)
+    keys = jnp.asarray(np.asarray(meta["window_key_data"],
+                                  dtype=np.uint32)[:, pos])
+    replay_cfg = _dc.replace(group_cfg, invariant_mode=invariant_mode)
+    result = {"crash_dir": crash_dir, "member": member,
+              "member_name": (meta.get("member_names") or [None] * b)[gpos],
+              "tick_start": meta.get("window_start"),
+              "tick_end": meta.get("window_end"),
+              "ticks": int(keys.shape[0]),
+              "invariant_mode": invariant_mode,
+              "original_error": meta.get("error", "")[:200]}
+    try:
+        if invariant_mode == "raise":
+            out = run_checked_keys(state, replay_cfg, tp, keys)
+        else:
+            out = run_keys(state, replay_cfg, tp, keys)
+        flags = int(np.asarray(out.fault_flags))
+        result.update(tripped=False, fault_flags=flags,
+                      fault_flag_names=decode_flags(flags))
+    except Exception as e:
+        if "invariant violation" not in str(e):
+            raise               # a replay-infra failure, not the trip
+        result.update(tripped=True, error=str(e)[:500])
+    return result
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("crash_dir")
@@ -105,8 +214,38 @@ def main() -> int:
                     help="JSON dict of scenario builder kwargs")
     ap.add_argument("--record", action="store_true",
                     help="replay in record mode (collect flags, no raise)")
+    ap.add_argument("--member", type=int, default=None,
+                    help="fleet dumps: which member (input index) to "
+                         "restore and replay")
     args = ap.parse_args()
     mode = "record" if args.record else "raise"
+    meta = load_meta(args.crash_dir)
+    if is_fleet_dump(meta) and args.member is None:
+        print(json.dumps({
+            "error": "fleet crash dump: pass --member to pick the lane",
+            "fleet_size": meta.get("fleet_size"),
+            "member_names": meta.get("member_names"),
+            "active_members": meta.get("active_members")}), flush=True)
+        return 1
+    if args.member is not None:
+        if not args.scenario:
+            print(json.dumps({"error": "--member needs --scenario (fleet "
+                              "dumps carry no scenario metadata)"}),
+                  flush=True)
+            return 1
+        from go_libp2p_pubsub_tpu.sim import scenarios
+        if args.scenario not in scenarios.SCENARIOS:
+            print(json.dumps({"error": f"unknown scenario "
+                              f"{args.scenario!r}",
+                              "known": sorted(scenarios.SCENARIOS)}),
+                  flush=True)
+            return 1
+        kwargs = json.loads(args.kwargs) if args.kwargs else {}
+        cfg, tp, like = scenarios.SCENARIOS[args.scenario](**kwargs)
+        result = replay_fleet(args.crash_dir, args.member, like=like,
+                              cfg=cfg, tp=tp, invariant_mode=mode)
+        print(json.dumps(result), flush=True)
+        return 3 if result.get("tripped") else 0
     if args.scenario:
         # command-line override of the dump's scenario metadata (the dump
         # itself is never mutated): rebuild the objects here and hand them

@@ -62,6 +62,12 @@ def main() -> None:
                          "writes)")
     ap.add_argument("--journal", default=None,
                     help="rank-0 JSONL journal of run/chunk outcomes")
+    ap.add_argument("--health", default=None,
+                    help="rank-0 streaming health journal "
+                         "(sim/telemetry.py; or $GRAFT_HEALTH_STREAM): "
+                         "the sharded scan computes per-tick aggregates "
+                         "on device, rank 0 streams them for "
+                         "scripts/dashboard.py to tail")
     ap.add_argument("--dump-state", default=None,
                     help="rank-0 .npz of the final host-complete state "
                          "(parity smoke)")
@@ -113,7 +119,11 @@ def main() -> None:
 
     # sharded chunk runner: one compiled scan per (exec_cfg, chunk shape),
     # cached so retries and steady-state chunks re-dispatch the same
-    # executable (the degrade ladder swaps exec_cfg, landing a new entry)
+    # executable (the degrade ladder swaps exec_cfg, landing a new entry).
+    # With a health stream the runner returns (state, HealthRecord) —
+    # EVERY rank runs the telemetry program (the reduction's collectives
+    # are part of it), only rank 0 journals (write_files below)
+    health = args.health or os.environ.get("GRAFT_HEALTH_STREAM") or None
     _runs: dict = {}
 
     def run_fn(st, exec_cfg, tp_arg, keys):
@@ -122,8 +132,8 @@ def main() -> None:
         # argument, so a cached runner can never serve a stale tp
         fn = _runs.get(exec_cfg)
         if fn is None:
-            fn = _runs[exec_cfg] = make_sharded_run_keys(mesh, exec_cfg,
-                                                         tp_arg)
+            fn = _runs[exec_cfg] = make_sharded_run_keys(
+                mesh, exec_cfg, tp_arg, telemetry=health is not None)
         return fn(st, keys, tp_arg)
 
     def state_from_host(host_state):
@@ -136,6 +146,7 @@ def main() -> None:
         state_to_host=multihost.gather_state,
         state_from_host=state_from_host,
         write_files=coord,
+        **({"health_path": health} if health else {}),
         **({"chunk_ticks": args.chunk_ticks} if args.chunk_ticks else {}),
         **({"max_chunks": args.max_chunks} if args.max_chunks else {}),
         **({"checkpoint_dir": args.checkpoint_dir}

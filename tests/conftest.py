@@ -99,6 +99,18 @@ _SLOW = {
                       "test_crash_dump_carries_per_member_flags",
                       "test_score_weight_variants_batch_together",
                       "test_record_member_with_flags_is_not_retired"),
+    # streaming telemetry plane (ISSUE 9): the core parity lenses (plain
+    # scan, supervised chunked journal, fleet per-member) + encoders +
+    # dashboard smoke stay tier-1; the retry/no-double-count and traced-
+    # mode cross-checks, the fleet crash replay, and the sharded/
+    # multihost smokes (8-device compile / subprocess pairs) are
+    # belt-and-braces
+    "test_telemetry.py": ("test_retried_chunk_rows_never_double_count",
+                          "TestRunTracedHealth",
+                          "TestFleetCrashReplay",
+                          "test_fleet_stream_matches_per_member",
+                          "test_bare_state_run_fn_not_mistaken",
+                          "test_window_end_is_paused_not_ended"),
     "test_sim_engine.py": ("test_negative_score_peer_gets_pruned",
                            "TestBackoff",
                            "TestNbrSubscribedCache",

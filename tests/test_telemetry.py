@@ -1,0 +1,564 @@
+"""Streaming telemetry plane (sim/telemetry.py, ISSUE 9).
+
+The core claim is PARITY: the per-tick aggregates streamed out of the
+scan (device-side reduction, one fetch per chunk) are identical to
+:func:`telemetry.health_record` computed post-hoc from the full state
+trajectory — across the plain scan, supervised chunking (journal rows
+included), the vmap-batched fleet, and the SPMD-sharded step (where ONE
+column, ``score_mean``, is allowed ~ulp reassociation slack — module
+docstring). On top of that: the native NDJSON encoder parses equal to
+the Python one, the journal reader survives torn tails and resume
+overlaps, the dashboard renders a recorded journal (``--once`` smoke),
+``run_traced`` emits health rows even with invariants off, and a fleet
+crash dump replays per member (clean AND tripped reproduction).
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import scenarios, telemetry
+from go_libp2p_pubsub_tpu.sim.engine import run_keys, step_jit
+from go_libp2p_pubsub_tpu.sim.supervisor import (SupervisorConfig,
+                                                 SupervisorCrash,
+                                                 supervised_run)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tiny(n=96, **kw):
+    return scenarios.single_topic_1k(n_peers=n, k_slots=16, degree=6, **kw)
+
+
+def _posthoc_rows(st, cfg, tp, keys):
+    """The reference: step the engine tick by tick and apply the SAME
+    reduction to every stored state."""
+    rows = []
+    for i in range(len(keys)):
+        st = step_jit(st, cfg, tp, keys[i])
+        rows.append(telemetry.record_to_row(
+            telemetry.health_record_jit(st, cfg, tp)))
+    return rows
+
+
+def _strip(rows):
+    return [{k: v for k, v in r.items() if k != "kind"} for r in rows]
+
+
+class TestStreamedParity:
+    def test_plain_scan_matches_posthoc(self):
+        cfg, tp, st = _tiny()
+        keys = jax.random.split(jax.random.PRNGKey(0), 6)
+        out, health = run_keys(st, cfg, tp, keys, telemetry=True)
+        mat, cols = telemetry.records_to_rows(health)
+        streamed = telemetry.rows_to_dicts(mat, cols)
+        assert streamed == _posthoc_rows(st, cfg, tp, keys)
+        # the telemetry lane never perturbs the trajectory
+        plain = run_keys(st, cfg, tp, keys)
+        np.testing.assert_array_equal(np.asarray(out.have),
+                                      np.asarray(plain.have))
+        assert int(out.tick) == int(plain.tick)
+
+    def test_chunked_supervised_stream_matches_posthoc(self, tmp_path):
+        # 6 ticks / chunk 3: shapes harmonized with the other tier-1
+        # cases so the compiled window programs are shared (the tier-1
+        # wall budget is the binding constraint — conftest rationale)
+        cfg, tp, st = _tiny()
+        hp = str(tmp_path / "health.jsonl")
+        sup = SupervisorConfig(chunk_ticks=3, health_path=hp,
+                               checkpoint_dir=str(tmp_path / "ck"),
+                               scenario="single_topic_1k")
+        out, report = supervised_run(st, cfg, tp, jax.random.PRNGKey(0),
+                                     6, sup)
+        j = telemetry.read_journal(hp)
+        keys = jax.random.split(jax.random.PRNGKey(0), 6)
+        assert _strip(j["rows"]) == _posthoc_rows(st, cfg, tp, keys)
+        # journal structure: header + one chunk marker per chunk +
+        # checkpoint notes; the wall stamps are the dashboard's hb/s feed
+        assert j["runs"] and j["runs"][0]["n_peers"] == cfg.n_peers
+        assert len(j["chunks"]) == report.chunks_run
+        assert all("wall" in c for c in j["chunks"])
+        assert any(n["kind"] == "checkpoint" for n in j["notes"])
+        assert any(n["kind"] == "run_end" for n in j["notes"])
+
+    def test_bare_state_run_fn_not_mistaken_for_telemetry_pair(self,
+                                                               tmp_path):
+        """SimState is a NamedTuple (a tuple subclass): a custom run_fn
+        returning the bare state must not be unpacked as the
+        (state, HealthRecord) telemetry pair even when a health stream
+        is configured (the multihost launcher without --health)."""
+        cfg, tp, st = _tiny()
+
+        def run_fn(state, exec_cfg, tp_arg, keys):
+            return run_keys(state, exec_cfg, tp_arg, keys)   # bare state
+
+        sup = SupervisorConfig(chunk_ticks=3, run_fn=run_fn,
+                               health_path=str(tmp_path / "h.jsonl"))
+        out, report = supervised_run(st, cfg, tp, jax.random.PRNGKey(0),
+                                     6, sup)
+        assert report.ticks_run == 6 and int(out.tick) == 6
+        # no records from a plain runner — but the journal still frames
+        # the run (header + chunk markers + run_end)
+        j = telemetry.read_journal(str(tmp_path / "h.jsonl"))
+        assert j["rows"] == [] and len(j["chunks"]) == 2
+        assert any(n["kind"] == "run_end" for n in j["notes"])
+
+    def test_retried_chunk_rows_never_double_count(self, tmp_path):
+        """A failed attempt's records die with its discarded output: the
+        journal holds each tick exactly once."""
+        cfg, tp, st = _tiny()
+        hp = str(tmp_path / "health.jsonl")
+        fails = {"n": 0}
+
+        def hook(info):
+            if info["chunk_start"] == 3 and fails["n"] < 2:
+                fails["n"] += 1
+                raise RuntimeError("injected chunk failure")
+
+        sup = SupervisorConfig(chunk_ticks=3, health_path=hp,
+                               sleep=lambda s: None)
+        supervised_run(st, cfg, tp, jax.random.PRNGKey(0), 9, sup,
+                       _chunk_hook=hook)
+        with open(hp) as f:
+            ticks = [json.loads(ln)["tick"] for ln in f
+                     if '"health"' in ln]
+        assert ticks == list(range(9))
+
+    def test_fleet_stream_matches_per_member(self, tmp_path):
+        from go_libp2p_pubsub_tpu.sim.fleet import (FleetMember,
+                                                    supervised_fleet_run)
+
+        cfg, tp, st = _tiny()
+        b = 4
+        members = [FleetMember(cfg=cfg, tp=tp, state=st,
+                               key=jax.random.PRNGKey(100 + i), n_ticks=6,
+                               name=f"m{i}") for i in range(b)]
+        hp = str(tmp_path / "fleet_health.jsonl")
+        sup = SupervisorConfig(chunk_ticks=3, health_path=hp,
+                               sleep=lambda s: None)
+        supervised_fleet_run(members, sup)
+        j = telemetry.read_journal(hp)
+        assert len(j["rows"]) == b * 6
+        assert j["runs"][0]["plane"] == "fleet"
+        assert j["runs"][0]["member_names"] == [m.name for m in members]
+        for i in range(b):
+            keys = jax.random.split(jax.random.PRNGKey(100 + i), 6)
+            ref = _posthoc_rows(st, cfg, tp, keys)
+            for r in ref:
+                r["member"] = i
+            got = [{k: v for k, v in r.items() if k != "kind"}
+                   for r in j["rows"] if r["member"] == i]
+            assert got == ref, f"member {i} diverged"
+
+    @pytest.mark.slow
+    def test_sharded_scan_matches_unsharded(self):
+        """The SPMD lens: telemetry records out of the 8-device sharded
+        scan equal the unsharded ones — exactly for every column except
+        ``score_mean``, whose cross-shard f32 partial sums legitimately
+        reassociate (~ulp; telemetry module docstring)."""
+        from go_libp2p_pubsub_tpu.parallel.sharding import (
+            make_mesh, make_sharded_run_keys, shard_state)
+        from go_libp2p_pubsub_tpu.sim import init_state
+
+        cfg, tp, topo, sub = scenarios.frontier_spec(128)
+        st = init_state(cfg, topo, subscribed=sub)
+        mesh = make_mesh()
+        fn = make_sharded_run_keys(mesh, cfg, tp, telemetry=True)
+        keys = jax.random.split(jax.random.PRNGKey(7), 5)
+        out_sh, health_sh = fn(shard_state(st, mesh, cfg), keys)
+        out, health = run_keys(st, cfg, tp, keys, telemetry=True)
+        m_sh, cols = telemetry.records_to_rows(health_sh)
+        m, _ = telemetry.records_to_rows(health)
+        names = [nm for nm, _ in cols]
+        sm = names.index("score_mean")
+        exact = [i for i in range(len(names)) if i != sm]
+        np.testing.assert_array_equal(m_sh[:, exact], m[:, exact])
+        np.testing.assert_allclose(m_sh[:, sm], m[:, sm], rtol=1e-5)
+        # the sharded state trajectory itself stays bit-exact
+        np.testing.assert_array_equal(np.asarray(out_sh.have),
+                                      np.asarray(out.have))
+
+
+class TestRunTracedHealth:
+    def test_emits_even_with_invariants_off(self):
+        from go_libp2p_pubsub_tpu.sim.trace_export import run_traced
+
+        cfg, tp, st = _tiny()
+        cfg = dataclasses.replace(cfg, record_provenance=True,
+                                  invariant_mode="off")
+        health = []
+        run_traced(st, cfg, tp, jax.random.PRNGKey(0), 4,
+                   health_out=health)
+        assert len(health) == 4
+        assert [h["tick"] for h in health] == [0, 1, 2, 3]
+        # delivery/mesh metrics stream regardless of the sentinel; the
+        # flag keys say "not tracked", not "clean"
+        for h in health:
+            assert h["fault_flags"] is None and h["flags"] is None
+            assert 0.0 <= h["delivery_frac_t0"] <= 1.0
+            assert h["mesh_deg_max"] >= h["mesh_deg_min"] >= 0
+
+    def test_record_mode_rows_match_device_stream(self):
+        from go_libp2p_pubsub_tpu.sim.trace_export import run_traced
+
+        cfg, tp, st = _tiny()
+        cfg_t = dataclasses.replace(cfg, record_provenance=True)
+        keys = jax.random.split(jax.random.PRNGKey(3), 4)
+        health = []
+        run_traced(st, cfg_t, tp, None, 0, health_out=health,
+                   keys=keys)
+        # provenance maintenance must not change the aggregates: compare
+        # against the device stream of the SAME traced config
+        _, dev = run_keys(st, cfg_t, tp, keys, telemetry=True)
+        mat, cols = telemetry.records_to_rows(dev)
+        ref = telemetry.rows_to_dicts(mat, cols)
+        got = [{k: v for k, v in h.items() if k != "flags"}
+               for h in health]
+        assert got == ref
+
+
+def _synthetic_records(c=4, b=None, t=2, seed=0):
+    """A hand-built stacked HealthRecord (numpy leaves — no jit): awkward
+    float values exercise the encoders' round-trip without paying an
+    engine compile in tier-1."""
+    rng = np.random.RandomState(seed)
+    shape = (c,) if b is None else (c, b)
+
+    def f32(lo, hi):
+        return rng.uniform(lo, hi, shape).astype(np.float32)
+
+    def i32(hi):
+        return rng.randint(0, hi, shape).astype(np.int32)
+
+    return telemetry.HealthRecord(
+        tick=np.arange(c, dtype=np.int32) if b is None else
+        np.repeat(np.arange(c, dtype=np.int32)[:, None], b, axis=1),
+        delivery_frac=rng.uniform(0, 1, shape + (t,)).astype(np.float32),
+        mesh_deg_min=i32(4), mesh_deg_mean=f32(0, 12), mesh_deg_max=i32(16),
+        backoff_count=i32(999), graylist_count=i32(50),
+        score_mean=f32(-7, 7) / 3.0, score_min=f32(-100, 0),
+        published_window=i32(64), delivered_total=f32(0, 1e7),
+        halo_overflow=i32(2), fault_flags=i32(1 << 14).astype(np.uint32))
+
+
+class TestEncodersAndJournal:
+    def test_native_encoder_parses_equal_to_python(self):
+        from go_libp2p_pubsub_tpu.trace import native
+
+        mat, cols = telemetry.records_to_rows(_synthetic_records())
+        payload = native.encode_health_json(mat, cols)
+        if payload is None:
+            pytest.skip("native codec unavailable (no compiler)")
+        py = [json.loads(ln)
+              for ln in telemetry.encode_rows_py(mat, cols).splitlines()]
+        nat = [json.loads(ln) for ln in payload.splitlines()]
+        assert py == nat
+
+    def test_native_encoder_nonfinite_to_null(self):
+        from go_libp2p_pubsub_tpu.trace import native
+
+        cols = [("a", True), ("b", False)]
+        mat = np.array([[1.0, np.nan], [2.0, np.inf]])
+        payload = native.encode_health_json(mat, cols)
+        if payload is None:
+            pytest.skip("native codec unavailable (no compiler)")
+        rows = [json.loads(ln) for ln in payload.splitlines()]
+        assert rows == [{"kind": "health", "a": 1, "b": None},
+                        {"kind": "health", "a": 2, "b": None}]
+        assert rows == [json.loads(ln) for ln in
+                        telemetry.encode_rows_py(mat, cols).splitlines()]
+
+    def test_read_journal_torn_tail_and_resume_dedup(self, tmp_path):
+        path = str(tmp_path / "health.jsonl")
+        with telemetry.HealthJournal(path, prefer_native=False) as hj:
+            hj.note("run", n_peers=64)
+            hj.append_dicts([{"tick": 0, "member": -1, "x": 1.0},
+                             {"tick": 1, "member": -1, "x": 2.0}])
+            # a resume re-streams tick 1 with a newer value: last wins
+            hj.append_dicts([{"tick": 1, "member": -1, "x": 9.0}])
+        with open(path, "a") as f:
+            f.write('{"kind": "health", "tick": 2, "tru')   # torn tail
+        j = telemetry.read_journal(path)
+        assert [r["tick"] for r in j["rows"]] == [0, 1]
+        assert j["rows"][1]["x"] == 9.0
+        assert len(j["runs"]) == 1 and len(j["chunks"]) == 2
+
+    def test_live_tailer_matches_full_read(self, tmp_path):
+        """The live dashboard's incremental tailer (bounded memory, O(new
+        bytes) per poll) must agree with the full-file reader, including
+        across a torn tail that completes on a later poll."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "graft_dashboard", os.path.join(REPO, "scripts",
+                                            "dashboard.py"))
+        dash = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dash)
+
+        path = str(tmp_path / "health.jsonl")
+        with telemetry.HealthJournal(path, prefer_native=False) as hj:
+            hj.note("run", n_peers=8, n_topics=1, invariant_mode="record")
+            hj.append_dicts([{"tick": t, "member": -1,
+                              "delivery_frac_t0": t / 4} for t in range(3)])
+        tailer = dash._Tailer(path)
+        tailer.poll()
+        # torn tail: half a line now, the rest on the next poll
+        line = json.dumps({"kind": "health", "tick": 3, "member": -1,
+                           "delivery_frac_t0": 0.75}) + "\n"
+        with open(path, "a") as f:
+            f.write(line[:12])
+            f.flush()
+        tailer.poll()
+        with open(path, "a") as f:
+            f.write(line[12:])
+        tailer.poll()
+        full = telemetry.read_journal(path)
+        tj = tailer.journal()
+        assert tj["rows"] == full["rows"]
+        assert tj["chunks_total"] == len(full["chunks"])
+        assert dash._snapshot_of(tj, path)["tick"] == 3
+
+    def test_fleet_rows_interleave_and_bind_member_ids(self):
+        recs = _synthetic_records(c=3, b=2)
+        mat, cols = telemetry.records_to_rows(recs, member_ids=[5, 9])
+        rows = telemetry.rows_to_dicts(mat, cols)
+        assert [(r["tick"], r["member"]) for r in rows] == \
+            [(0, 5), (0, 9), (1, 5), (1, 9), (2, 5), (2, 9)]
+        with pytest.raises(ValueError, match="member ids"):
+            telemetry.records_to_rows(recs, member_ids=[0, 1, 2])
+
+
+class TestDashboard:
+    def _journal(self, tmp_path):
+        cfg, tp, st = _tiny()
+        hp = str(tmp_path / "health.jsonl")
+        sup = SupervisorConfig(chunk_ticks=3, health_path=hp,
+                               scenario="single_topic_1k")
+        supervised_run(st, cfg, tp, jax.random.PRNGKey(0), 6, sup)
+        return hp
+
+    def test_once_snapshot_smoke(self, tmp_path):
+        hp = self._journal(tmp_path)
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "dashboard.py"),
+             hp, "--once"],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+        assert res.returncode == 0, res.stderr[-800:]
+        assert "graft telemetry" in res.stdout
+        assert "delivery" in res.stdout and "mesh degree" in res.stdout
+
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "dashboard.py"),
+             hp, "--once", "--json"],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+        assert res.returncode == 0, res.stderr[-800:]
+        snap = json.loads(res.stdout)
+        assert snap["tick"] == 5 and snap["rows"] == 6
+        assert snap["run"]["scenario"] == "single_topic_1k"
+        assert snap["fault_flags"] == 0 and snap["done"] is True
+        assert 0.0 <= snap["delivery_frac"] <= 1.0
+
+    def test_window_end_is_paused_not_ended(self, tmp_path):
+        """A max_chunks bounded-window stop journals "window_end", not
+        "run_end": the dashboard must keep a resumable run tailable
+        (PAUSED), and only true completion reads ENDED — markers from a
+        previous window don't leak into the resumed run's status."""
+        cfg, tp, st = _tiny()
+        hp = str(tmp_path / "health.jsonl")
+        ck = str(tmp_path / "ck")
+
+        def sup():
+            return SupervisorConfig(chunk_ticks=3, health_path=hp,
+                                    checkpoint_dir=ck, max_chunks=1,
+                                    scenario="single_topic_1k")
+
+        supervised_run(st, cfg, tp, jax.random.PRNGKey(0), 6, sup())
+        j = telemetry.read_journal(hp)
+        kinds = [n["kind"] for n in j["notes"]]
+        assert "window_end" in kinds and "run_end" not in kinds
+        snap = self._snap(hp)
+        assert snap["paused"] is True and snap["done"] is False
+        # resume the same schedule: second window completes the run
+        supervised_run(st, cfg, tp, jax.random.PRNGKey(0), 6, sup())
+        snap = self._snap(hp)
+        assert snap["done"] is True
+        assert [r["tick"] for r in telemetry.read_journal(hp)["rows"]] \
+            == list(range(6))
+
+    def test_invariants_off_rows_never_read_clean(self, tmp_path):
+        """The numeric row schema streams fault_flags=0 when the sentinel
+        is off; the dashboard must surface "not tracked", not "clean"
+        (the run header's invariant_mode is the discriminator)."""
+        hp = str(tmp_path / "health.jsonl")
+        with telemetry.HealthJournal(hp, prefer_native=False) as hj:
+            hj.note("run", n_peers=64, n_topics=1, invariant_mode="off",
+                    scenario="x")
+            hj.append_dicts([{"tick": 0, "member": -1,
+                              "delivery_frac_t0": 0.5, "mesh_deg_min": 1,
+                              "mesh_deg_mean": 2.0, "mesh_deg_max": 3,
+                              "fault_flags": 0}])
+        snap = self._snap(hp)
+        assert snap["fault_flags"] is None
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "dashboard.py"),
+             hp, "--once"],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+        assert "(invariants off)" in res.stdout
+        assert "clean" not in res.stdout
+
+    def _snap(self, hp):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "dashboard.py"),
+             hp, "--once", "--json"],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+        assert res.returncode == 0, res.stderr[-800:]
+        return json.loads(res.stdout)
+
+    def test_missing_journal_exits_1(self, tmp_path):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "dashboard.py"),
+             str(tmp_path / "nope.jsonl"), "--once"],
+            capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+        assert res.returncode == 1
+
+
+class TestFleetCrashReplay:
+    def _crash_fleet(self, tmp_path, members):
+        from go_libp2p_pubsub_tpu.sim.fleet import supervised_fleet_run
+
+        def bomb(info):
+            raise RuntimeError("injected window failure")
+
+        sup = SupervisorConfig(chunk_ticks=4, max_retries=0,
+                               crash_dir=str(tmp_path / "crash"),
+                               sleep=lambda s: None)
+        with pytest.raises(SupervisorCrash) as ei:
+            supervised_fleet_run(members, sup, _chunk_hook=bomb)
+        return ei.value.dump_dir
+
+    def test_member_replay_clean_and_tripped(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import replay_crash
+        from go_libp2p_pubsub_tpu.sim.fleet import FleetMember
+
+        cfg, tp, st = _tiny()
+        # member 1 carries a poisoned counter: its lane's replay must
+        # REPRODUCE the invariant trip; member 0 replays clean
+        poisoned = st._replace(mesh_failure_penalty=st.mesh_failure_penalty
+                               .at[0, 0, 0].set(jnp.inf))
+        members = [FleetMember(cfg=cfg, tp=tp, state=st,
+                               key=jax.random.PRNGKey(5), n_ticks=4,
+                               name="clean"),
+                   FleetMember(cfg=cfg, tp=tp, state=poisoned,
+                               key=jax.random.PRNGKey(6), n_ticks=4,
+                               name="poisoned")]
+        dump = self._crash_fleet(tmp_path, members)
+        meta = replay_crash.load_meta(dump)
+        assert replay_crash.is_fleet_dump(meta)
+        assert meta["member_names"] == ["clean", "poisoned"]
+
+        clean = replay_crash.replay_fleet(dump, 0, like=st, cfg=cfg, tp=tp)
+        assert clean["tripped"] is False and clean["ticks"] == 4
+        assert clean["member_name"] == "clean"
+
+        tripped = replay_crash.replay_fleet(dump, 1, like=st, cfg=cfg,
+                                            tp=tp)
+        assert tripped["tripped"] is True
+        assert "invariant violation" in tripped["error"]
+
+        # wrong config must be refused by the fleet-axis fingerprint
+        import dataclasses as dc
+        with pytest.raises(SystemExit, match="fingerprint"):
+            replay_crash.replay_fleet(
+                dump, 0, like=st,
+                cfg=dc.replace(cfg, history_length=cfg.history_length + 1),
+                tp=tp)
+        with pytest.raises(SystemExit, match="not in this dump"):
+            replay_crash.replay_fleet(dump, 7, like=st, cfg=cfg, tp=tp)
+
+    def test_mixed_config_groups_map_input_indices(self, tmp_path):
+        """A mixed-config fleet splits into groups; the dump stamps each
+        group's member INPUT indices so --member keeps meaning the input
+        index (group position is an implementation detail)."""
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        import dataclasses as dc
+
+        import replay_crash
+        from go_libp2p_pubsub_tpu.sim.fleet import FleetMember
+
+        cfg, tp, st = _tiny()
+        cfg2 = dc.replace(cfg, gater_enabled=True)
+        members = [FleetMember(cfg=cfg, tp=tp, state=st,
+                               key=jax.random.PRNGKey(1), n_ticks=4,
+                               name="A"),
+                   FleetMember(cfg=cfg2, tp=tp, state=st,
+                               key=jax.random.PRNGKey(2), n_ticks=4,
+                               name="B"),
+                   FleetMember(cfg=cfg, tp=tp, state=st,
+                               key=jax.random.PRNGKey(3), n_ticks=4,
+                               name="C")]
+        dump = self._crash_fleet(tmp_path, members)
+        meta = replay_crash.load_meta(dump)
+        # group 0 = the cfg members, input indices 0 and 2
+        assert meta["member_ids"] == [0, 2]
+        assert meta["member_names"] == ["A", "C"]
+        r = replay_crash.replay_fleet(dump, 2, like=st, cfg=cfg, tp=tp)
+        assert r["member_name"] == "C" and r["tripped"] is False
+        # member 1 belongs to the OTHER config group — refused by name
+        with pytest.raises(SystemExit, match="not in this dump"):
+            replay_crash.replay_fleet(dump, 1, like=st, cfg=cfg, tp=tp)
+
+
+@pytest.mark.slow
+def test_two_process_multihost_health_smoke(tmp_path):
+    """The multihost lens: a REAL 2-process jax.distributed CPU run with
+    --health streams rank-0-only journal rows that match the
+    single-process telemetry stream (score_mean exempted — sharded
+    reduction reassociation, module docstring)."""
+    from go_libp2p_pubsub_tpu.sim import init_state
+
+    def spawn(rank):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+        env.pop("XLA_FLAGS", None)      # one device per rank
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "run_multihost.py"),
+             "--coordinator", "localhost:19923", "--num-processes", "2",
+             "--process-id", str(rank), "--scenario", "frontier_250k",
+             "--n", "128", "--seed", "7", "--ticks", "4",
+             "--health", str(tmp_path / f"health_r{rank}.jsonl")],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=str(tmp_path))
+
+    procs = [spawn(r) for r in range(2)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for (out, err), p in zip(outs, procs):
+        assert p.returncode == 0, f"rank rc={p.returncode}\n{err[-3000:]}"
+    # rank-0-only write discipline
+    assert os.path.exists(tmp_path / "health_r0.jsonl")
+    assert not os.path.exists(tmp_path / "health_r1.jsonl")
+    j = telemetry.read_journal(str(tmp_path / "health_r0.jsonl"))
+    assert [r["tick"] for r in j["rows"]] == [0, 1, 2, 3]
+
+    cfg, tp, topo, sub = scenarios.frontier_spec(128)
+    st = init_state(cfg, topo, subscribed=sub)
+    keys = jax.random.split(jax.random.PRNGKey(7), 4)
+    _, health = run_keys(st, cfg, tp, keys, telemetry=True)
+    mat, cols = telemetry.records_to_rows(health)
+    ref = telemetry.rows_to_dicts(mat, cols)
+    for got, want in zip(_strip(j["rows"]), ref):
+        for (nm, _ii) in cols:
+            if nm == "score_mean":
+                assert got[nm] == pytest.approx(want[nm], rel=1e-5)
+            else:
+                assert got[nm] == want[nm], nm
