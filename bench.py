@@ -185,7 +185,8 @@ NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
          "50k_churn_gater_px", "100k_sybil20", "100k_floodsub",
          "100k_randomsub", "100k_gossipsub_sweep",
          "frontier_250k", "frontier_500k", "frontier_1m",
-         "telemetry_1k", "telemetry_10k", "headline"]
+         "telemetry_1k", "telemetry_10k",
+         "eclipse_50k", "flashcrowd_50k", "headline"]
 # execution order puts headline FIRST (banked before anything can time
 # out — losing it cost round 5 its record, VERDICT r5 weak #2) and its
 # line is re-emitted LAST so the driver's single-line stdout parse still
@@ -208,7 +209,11 @@ TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60,
                  # tracing-overhead A/B (ROADMAP item 5): windows long
                  # enough that the per-chunk journal write is amortized
                  # the way a real supervised stream amortizes it
-                 "telemetry_1k": 120, "telemetry_10k": 20}
+                 "telemetry_1k": 120, "telemetry_10k": 20,
+                 # attack family (ISSUE 10): windows cover the scenario's
+                 # [3, 8) attack schedule so the measured ticks include
+                 # cut + heal (the faults_degraded discipline)
+                 "eclipse_50k": 10, "flashcrowd_50k": 10}
 
 
 def _fleet_b() -> int:
@@ -506,6 +511,14 @@ def run_scenario(name: str) -> str | None:
             "randomsub", n_peers=_cap_n(100_000)),
         "100k_gossipsub_sweep": lambda: scenarios.router_sweep_100k(
             "gossipsub", n_peers=_cap_n(100_000)),
+        # adversary/workload library at bench scale (ISSUE 10): the
+        # eclipse + flash-crowd families with their [3, 8) attack
+        # windows inside the measured ticks — degraded-mode rates with
+        # the fault_flags naming exactly which attack fired
+        "eclipse_50k": lambda: scenarios.eclipse_50k(
+            n_peers=_cap_n(ATTACK_FULL_N["eclipse_50k"])),
+        "flashcrowd_50k": lambda: scenarios.flashcrowd_50k(
+            n_peers=_cap_n(ATTACK_FULL_N["flashcrowd_50k"])),
         "headline": headline,
     }
     assert set(builders) | {"fleet_256x1k", "telemetry_1k",
@@ -600,6 +613,10 @@ def _headline_n() -> int:
 FRONTIER_FULL_N = {"frontier_250k": 262_144, "frontier_500k": 524_288,
                    "frontier_1m": 1_048_576}
 
+# full peer counts of the attack family (ISSUE 10) — parent-safe like
+# FRONTIER_FULL_N; capped runs are labeled by what ran
+ATTACK_FULL_N = {"eclipse_50k": 50_000, "flashcrowd_50k": 50_000}
+
 
 def _label(name: str) -> str:
     if name == "headline":
@@ -618,6 +635,11 @@ def _label(name: str) -> str:
     if name in TELEMETRY_FULL_N:
         # same capped-label discipline as the frontier family
         full = TELEMETRY_FULL_N[name]
+        n = _cap_peers(full)
+        return name if n == full else f"{name}_capped_{n // 1000}k"
+    if name in ATTACK_FULL_N:
+        # same capped-label discipline for the attack family
+        full = ATTACK_FULL_N[name]
         n = _cap_peers(full)
         return name if n == full else f"{name}_capped_{n // 1000}k"
     return name

@@ -91,7 +91,13 @@ def _hop_config_ok(cfg) -> bool:
                 # data_ok plane cannot express
                 or (cfg.fault_plan is not None
                     and (cfg.fault_plan.link_dup_prob > 0
-                         or cfg.fault_plan.link_drop_prob > 0)))
+                         or cfg.fault_plan.link_drop_prob > 0
+                         # slow-link classes fold into link_ok (same
+                         # split-accounting need as link drop); the
+                         # censorship per-sender frontier mask has no
+                         # fused-kernel input at all (propagate.py)
+                         or getattr(cfg.fault_plan, "slowlinks", ())
+                         or getattr(cfg.fault_plan, "censorships", ()))))
 
 
 def _hop_shape_ok(w: int, n: int, k: int) -> bool:

@@ -232,7 +232,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
                  fwd_send: jnp.ndarray | None = None,
                  answers_k: jnp.ndarray | None = None,
                  link_ok: jnp.ndarray | None = None,
-                 dup_edges: jnp.ndarray | None = None) -> SimState:
+                 dup_edges: jnp.ndarray | None = None,
+                 censor_bits: jnp.ndarray | None = None) -> SimState:
     """One tick of data-plane traffic: resolve last tick's IWANTs, run
     ``prop_substeps`` forwarding hops, then emit this tick's IHAVE/IWANT.
 
@@ -266,6 +267,18 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     ([N, K] bool) makes mesh edges re-offer their recent deliveries on hop
     0, landing as seen-cache hits in the mesh-duplicate (P3 credit) and
     gater-duplicate stats — a re-transmitted RPC, not new traffic.
+
+    ``censor_bits`` ([W, N] packed words, sim/faults.py censor_word_mask)
+    marks the message slots each SENDER suppresses this tick (the
+    censorship attack): a censor neither advertises (IHAVE window), nor
+    answers pulls for, nor forwards a censored message — but still
+    receives it. An unanswered pull for a censored message IS a broken
+    promise: the asker charges P7 exactly as for a malicious non-answer
+    (the score-gamed censor pays in behaviour penalty), and withheld mesh
+    forwarding starves the censor's P3 credit — the scoring response the
+    adversary contracts assert on. Requires the non-fused hop
+    (ops/hopkernel.py gates Pallas out under a censor plan: the per-sender
+    frontier mask cannot enter the fused kernel).
     """
     n, t, k = state.mesh.shape
     m = cfg.msg_window
@@ -384,8 +397,11 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     # malicious sources never answer IWANTs (the iwantEverything-style actor
     # holds its promises open, gossipsub_spam_test.go:23-133); honest sources
     # answer from their mcache, which rejected/ignored messages never enter
-    # (deliver_tick stays NEVER on rejection — validation.go:293-370)
+    # (deliver_tick stays NEVER on rejection — validation.go:293-370).
+    # Censors additionally withhold the victim's slots (docstring above).
     answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)             # [W,N]
+    if censor_bits is not None:
+        answer_bits = answer_bits & ~censor_bits
     if fused_hop:
         # fused resolve (PERF_MODEL.md S6): eligibility (resolve_hop_mode)
         # guarantees the cap/throttle plumbing below is dead here
@@ -540,6 +556,8 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         age_d = state.tick - state.deliver_tick
         dup_window = pack_words((age_d >= 0) & (age_d < cfg.history_gossip)) \
             & alive_bits[:, None]
+        if censor_bits is not None:
+            dup_window = dup_window & ~censor_bits
         dup_kn = jnp.where((dup_edges & data_ok).T[None, :, :],
                            U32(0xFFFFFFFF), U32(0))
         dup_offer = gw(dup_window) & mesh_eb & dup_kn
@@ -619,7 +637,11 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
         edge_used, arrivals, throttled, validated = \
             c["edge_used"], c["arrivals"], c["throttled"], c["validated"]
         is_first = i == 0
-        offered = gw(frontier) & allowed                                # [W,K,N]
+        # censors hold censored messages out of their outgoing offers;
+        # the message stays in their have/frontier accounting (they DID
+        # receive it) — only the sender-side visibility is masked
+        src = frontier if censor_bits is None else frontier & ~censor_bits
+        offered = gw(src) & allowed                                     # [W,K,N]
         if flood_offer is not None:
             offered = offered | jnp.where(is_first, flood_offer, U32(0))
         if dup_offer is not None:
@@ -784,7 +806,15 @@ def forward_tick(state: SimState, cfg: SimConfig, tp: TopicParams,
     age = state.tick - state.deliver_tick
     window_bits = pack_words((age >= 0) & (age < cfg.history_gossip)) \
         & alive_bits[:, None]
-    # malicious peers advertise everything alive (IHAVE flood)
+    # malicious peers advertise everything alive (IHAVE flood). Censors
+    # deliberately DO advertise the victim's messages (censor_bits does
+    # not mask the window): the score-gamed starvation is advertise-but-
+    # never-answer — the IHAVE looks normal, the pull goes out, the
+    # answer never comes, and the asker charges a P7 broken promise
+    # (gossip_tracer.go:79-115) while gossip_ok eventually routes its
+    # pulls to honest advertisers once the censor sinks below the gossip
+    # threshold. Masking the advertisement would delete the very scoring
+    # response the contract asserts on.
     window_bits = jnp.where(mal[None, :], alive_bits[:, None], window_bits)
     emit_mode = resolve_emit_mode(cfg.hop_mode, w, n, k)
     if emit_mode in ("pallas", "pallas-mxu"):

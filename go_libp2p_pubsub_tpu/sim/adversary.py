@@ -1,0 +1,629 @@
+"""Adversary & workload library: attack scenarios with enforced contracts.
+
+ROADMAP item 4 / ISSUE 10. The scoring/gater/PX machinery (PAPER.md
+L4/L5) exists to survive adversarial meshes, and the gossipsub v1.1
+hardening literature (Vyzovitis et al., "GossipSub: Attack-Resilient
+Message Propagation in Filecoin and ETH2.0") evaluates routers against
+eclipse, censorship, and flood attacks — not static topologies. This
+module is that evaluation plane, layered on :mod:`sim.faults` (which
+carries the attack schedules as jit-static ``FaultPlan`` families):
+
+Five grounded scenario families, each a constructor returning an
+:class:`AttackScenario` — ``(cfg, tp, state)`` exactly like a
+``sim.scenarios`` builder, PLUS the machine-checkable **behavior
+contracts** the run must satisfy and the recommended run length:
+
+- :func:`eclipse` — sybil mesh takeover of a target peer region
+  (``FaultPlan.eclipses``): the targets' honest edges are cut, heartbeat
+  under-subscription grafts sybils in (GRAFT pressure), and the window
+  heals through the partition redial path. Contracts: network delivery
+  floor during the attack, recovery ceiling after the heal, sybils
+  graylisted / honest peers not.
+- :func:`censorship` — score-gamed IWANT starvation of a victim
+  publisher (``FaultPlan.censorships`` + a victim-centered publish storm
+  so the starvation has traffic to starve): censors advertise nothing of
+  the victim's, answer no pulls for it, forward none of it — and pay in
+  P7 broken promises + starved P3 credit. Contracts: the victim's topic
+  keeps a delivery floor (the honest mesh routes around the censors) and
+  the censors are graylisted while honest peers are not.
+- :func:`flash_crowd` — hot-topic publish storm with a skewed publisher
+  distribution (``FaultPlan.storms``). Contracts: delivery floor under
+  load, recovery ceiling after the storm ends.
+- :func:`slow_link` — heterogeneous per-edge delay/drop classes
+  (``FaultPlan.slowlinks``). Contracts: delivery floor despite the slow
+  tail, and NO honest peer graylisted (heterogeneous latency must not
+  read as misbehavior).
+- :func:`diurnal` — scheduled join/leave waves through the churn ops
+  (``FaultPlan.waves``). Contracts: delivery floor across the waves,
+  recovery ceiling after the last wave.
+
+**Contracts** are declarative, JSON-serializable (journal headers,
+scripts/dashboard.py), and evaluated from the per-tick telemetry row
+stream (sim/telemetry.py ``HealthRecord`` — the PR 9 plane; the
+graylist census is split attacker/honest by ``faults.attacker_mask``
+exactly for the score-response contract). The SAME contract object runs:
+
+- as a tier-1 test at small N (tests/test_adversary.py, the
+  ``adversarial`` marker),
+- per member of a fleet-swept grid (sim/fleet.py ``collect_health`` →
+  scripts/sweep_scores.py contract columns),
+- against a live/streamed journal (scripts/dashboard.py renders
+  pass/fail/pending from the stamped schedule + rows).
+
+Positive control: :class:`ScoreResponse` demonstrably FAILS when scoring
+is disabled — a broken assertion cannot silently pass (tier-1 pinned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .config import SimConfig, TopicParams
+from .faults import (
+    CensorWindow,
+    ChurnWave,
+    EclipseWindow,
+    FaultPlan,
+    SlowLinkClass,
+    StormWindow,
+    attack_end_tick,
+)
+from .state import SimState, init_state
+from . import topology
+from .scenarios import SEED, default_topic_params
+
+# ---------------------------------------------------------------------------
+# contracts
+
+
+@dataclasses.dataclass(frozen=True)
+class ContractResult:
+    """One contract's verdict over a row stream. ``status`` is ``"pass"``
+    / ``"fail"`` / ``"pending"`` (pending = the stream hasn't reached the
+    contract's decision tick yet — only possible with ``final=False``,
+    the live-dashboard mode; a FINAL stream that never reaches the
+    decision tick fails by name, so a too-short run can't silently
+    pass)."""
+
+    kind: str
+    status: str
+    detail: str
+    measured: dict
+
+    @property
+    def passed(self) -> bool:
+        return self.status == "pass"
+
+
+def _row_delivery(row: dict, topic) -> float:
+    if topic is not None:
+        return row.get(f"delivery_frac_t{topic}", 0.0)
+    vals, t = [], 0
+    while f"delivery_frac_t{t}" in row:
+        vals.append(row[f"delivery_frac_t{t}"])
+        t += 1
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class DeliveryFloor:
+    """Delivery fraction must stay >= ``floor`` at EVERY tick of
+    ``[start, end)`` (end None = stream end). ``topic`` restricts the
+    census to one topic column (the censorship contract watches the
+    victim's topic); None averages the per-topic columns."""
+
+    floor: float
+    start: int = 0
+    end: int | None = None
+    topic: int | None = None
+    kind: str = dataclasses.field(default="delivery_floor", repr=False)
+
+    def evaluate(self, rows: list, final: bool = True) -> ContractResult:
+        end = self.end if self.end is not None else (1 << 30)
+        win = [r for r in rows if self.start <= r["tick"] < end]
+        if not win:
+            last = max((r["tick"] for r in rows), default=-1)
+            if not final and last < self.start:
+                return ContractResult(self.kind, "pending",
+                                      "census window not reached", {})
+            return ContractResult(
+                self.kind, "fail",
+                f"no rows in census window [{self.start}, {end})",
+                {"rows": len(rows)})
+        vals = [(_row_delivery(r, self.topic), r["tick"]) for r in win]
+        worst, at = min(vals)
+        status = "pass" if worst >= self.floor else "fail"
+        if status == "pass" and not final and self.end is not None \
+                and max(r["tick"] for r in rows) < self.end - 1:
+            status = "pending"
+        return ContractResult(
+            self.kind, status,
+            f"min delivery {worst:.4f} @ tick {at} vs floor {self.floor}"
+            + (f" (topic {self.topic})" if self.topic is not None else ""),
+            {"min_delivery": round(worst, 4), "at_tick": at,
+             "floor": self.floor})
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryCeiling:
+    """After the attack ends at tick ``after``, delivery must climb back
+    to >= ``floor`` within ``within`` ticks — the recovery-time ceiling.
+    A final stream that ends before ``after + within`` without recovering
+    FAILS (the run was too short to prove recovery)."""
+
+    after: int
+    within: int
+    floor: float = 0.95
+    topic: int | None = None
+    kind: str = dataclasses.field(default="recovery_ceiling", repr=False)
+
+    def evaluate(self, rows: list, final: bool = True) -> ContractResult:
+        post = sorted((r["tick"], _row_delivery(r, self.topic))
+                      for r in rows if r["tick"] >= self.after)
+        rec = next((t for t, v in post if v >= self.floor), None)
+        last = max((r["tick"] for r in rows), default=-1)
+        m = {"after": self.after, "within": self.within, "floor": self.floor,
+             "recovered_at": rec}
+        if rec is not None and rec - self.after <= self.within:
+            return ContractResult(
+                self.kind, "pass",
+                f"recovered to >= {self.floor} at tick {rec} "
+                f"({rec - self.after} ticks after heal)", m)
+        if last < self.after + self.within and not final:
+            return ContractResult(self.kind, "pending",
+                                  "recovery window still open", m)
+        worst = f"never (last tick {last})" if rec is None \
+            else f"tick {rec} ({rec - self.after} > {self.within})"
+        return ContractResult(
+            self.kind, "fail",
+            f"no recovery to >= {self.floor} within {self.within} ticks "
+            f"of {self.after}: {worst}", m)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoreResponse:
+    """The scoring machinery must RESPOND: by tick ``by``, at least
+    ``attacker_frac`` of the connected attacker edges (telemetry's
+    ``attacker_graylisted / attacker_edges``, attackers =
+    faults.attacker_mask — sybils + censor cohorts) are below the
+    graylist threshold, while honest collateral stays bounded
+    (``honest_graylisted <= honest_max_frac * honest edges`` at every
+    tick from ``start``). ``attacker_frac=0`` drops the attacker leg —
+    the slow-link contract's shape, where the assertion is purely "no
+    honest peer gets graylisted for being slow". This contract is the
+    POSITIVE CONTROL of the library: with ``scoring_enabled=False``
+    nothing is ever graylisted and the attacker leg must fail
+    (tests/test_adversary.py pins it)."""
+
+    by: int
+    attacker_frac: float = 0.5
+    honest_max_frac: float = 0.05
+    start: int = 0
+    kind: str = dataclasses.field(default="score_response", repr=False)
+
+    def evaluate(self, rows: list, final: bool = True) -> ContractResult:
+        resp = None
+        honest_bad = []
+        for r in sorted(rows, key=lambda r: r["tick"]):
+            att = r.get("attacker_edges", 0)
+            if resp is None and att > 0 and \
+                    r.get("attacker_graylisted", 0) >= self.attacker_frac * att:
+                resp = r["tick"]
+            honest_edges = max(r.get("connected_edges", 0) - att, 1)
+            if r["tick"] >= self.start and \
+                    r.get("honest_graylisted", 0) > \
+                    self.honest_max_frac * honest_edges:
+                honest_bad.append(r["tick"])
+        last = max((r["tick"] for r in rows), default=-1)
+        m = {"by": self.by, "attacker_frac": self.attacker_frac,
+             "responded_at": resp, "honest_violations": honest_bad[:8]}
+        if honest_bad:
+            return ContractResult(
+                self.kind, "fail",
+                f"honest graylisting above {self.honest_max_frac:.2%} of "
+                f"honest edges at tick(s) {honest_bad[:8]}", m)
+        if self.attacker_frac <= 0.0:
+            return ContractResult(self.kind, "pass",
+                                  "no honest peer graylisted", m)
+        if resp is not None and resp <= self.by:
+            return ContractResult(
+                self.kind, "pass",
+                f">= {self.attacker_frac:.0%} of attacker edges "
+                f"graylisted by tick {resp} (<= {self.by})", m)
+        if last < self.by and not final:
+            return ContractResult(self.kind, "pending",
+                                  "response window still open", m)
+        return ContractResult(
+            self.kind, "fail",
+            f"attackers not graylisted to {self.attacker_frac:.0%} "
+            f"by tick {self.by} (responded_at={resp})", m)
+
+
+CONTRACT_KINDS = {"delivery_floor": DeliveryFloor,
+                  "recovery_ceiling": RecoveryCeiling,
+                  "score_response": ScoreResponse}
+
+
+def contract_to_json(c) -> dict:
+    d = dataclasses.asdict(c)
+    d["kind"] = c.kind
+    return d
+
+
+def contract_from_json(d: dict):
+    d = dict(d)
+    kind = d.pop("kind")
+    if kind not in CONTRACT_KINDS:
+        raise ValueError(f"unknown contract kind {kind!r}; "
+                         f"known: {sorted(CONTRACT_KINDS)}")
+    return CONTRACT_KINDS[kind](**d)
+
+
+def contracts_to_json(contracts) -> list:
+    return [contract_to_json(c) for c in contracts]
+
+
+def contracts_from_json(items) -> tuple:
+    return tuple(contract_from_json(d) for d in items)
+
+
+def evaluate_contracts(contracts, rows: list, final: bool = True) -> list:
+    """Evaluate every contract against one member's row stream (plain
+    dict rows, sim/telemetry.py schema)."""
+    return [c.evaluate(rows, final=final) for c in contracts]
+
+
+def member_rows(rows: list, member: int) -> list:
+    """One fleet member's rows out of a mixed journal/fleet row stream
+    (unbatched runs carry member == -1)."""
+    return [r for r in rows if r.get("member", -1) == member]
+
+
+def contracts_from_schedule(windows: list) -> tuple:
+    """Default contracts derived from a stamped attack schedule (the
+    journal-header ``attack_windows`` list) — the dashboard's fallback
+    when the run didn't stamp its scenario contracts explicitly.
+    Deliberately lenient: schedule-only defaults can't know the
+    scenario's tuned floors."""
+    out: list = []
+    ends = [w["end"] for w in windows if w.get("end") is not None]
+    if ends:
+        out.append(RecoveryCeiling(after=max(ends), within=15, floor=0.9))
+    if any(w["kind"] in ("eclipse", "censor") for w in windows):
+        out.append(ScoreResponse(by=max(ends) + 5 if ends else 1 << 30,
+                                 attacker_frac=0.25, honest_max_frac=0.1))
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# the scenario families
+
+
+class AttackScenario(tuple):
+    """``(cfg, tp, state, contracts, n_ticks, name)`` — the first three
+    elements are exactly a ``sim.scenarios`` builder's return (so
+    ``scenario[:3]`` drops into every existing runner), ``contracts`` is
+    the tuple of behavior contracts the run must satisfy over
+    ``n_ticks`` ticks."""
+
+    __slots__ = ()
+
+    def __new__(cls, cfg, tp, state, contracts, n_ticks, name):
+        return tuple.__new__(cls, (cfg, tp, state, contracts, n_ticks, name))
+
+    cfg = property(lambda s: s[0])
+    tp = property(lambda s: s[1])
+    state = property(lambda s: s[2])
+    contracts = property(lambda s: s[3])
+    n_ticks = property(lambda s: s[4])
+    name = property(lambda s: s[5])
+
+
+def _attack_cfg(n_peers: int, k_slots: int, n_topics: int, plan: FaultPlan,
+                **overrides) -> SimConfig:
+    """The shared adversarial config shape: full scoring with the
+    sybil_100k-style shallow thresholds (attacks must be able to MOVE the
+    graylist census within a small-N, tens-of-ticks run), PX + churn so
+    cut edges have a reconnect path, score retention covering the attack
+    windows — and the plan itself (``fault_plan`` is owned here, so a
+    caller can never build an attack config that silently drops its
+    attack)."""
+    base = dict(
+        n_peers=n_peers, k_slots=k_slots, n_topics=n_topics, msg_window=64,
+        publishers_per_tick=8, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        behaviour_penalty_threshold=2.0, behaviour_penalty_decay=0.99,
+        gossip_threshold=-10.0, publish_threshold=-50.0,
+        graylist_threshold=-100.0,
+        churn_disconnect_prob=0.01, churn_reconnect_prob=0.2,
+        px_enabled=True, accept_px_threshold=-5.0, retain_score_ticks=600)
+    base["fault_plan"] = plan
+    base.update(overrides)
+    return SimConfig(**base)
+
+
+def eclipse(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
+            sybil_fraction: float = 0.25, target_fraction: float = 0.12,
+            start: int = 10, end: int = 25, n_ticks: int = 40,
+            n_sybil_ips: int = 8, **cfg_kw) -> AttackScenario:
+    """Eclipse: a sybil population (invalid publishes, IHAVE floods,
+    unanswered IWANTs — the spam-actor set) plus an
+    :class:`~.faults.EclipseWindow` cutting the target region's honest
+    edges for ticks [start, end). During the window the targets' meshes
+    fill with sybils; scoring must graylist them (P4 + P7 + P6) and the
+    heal must restore delivery."""
+    rng = np.random.default_rng(SEED)
+    malicious = rng.random(n_peers) < sybil_fraction
+    # the target region is id-contiguous (faults.py eclipse semantics):
+    # keep it honest so the cut has honest edges to cut
+    n_tgt = max(1, int(np.ceil(target_fraction * n_peers)))
+    malicious[:n_tgt] = False
+    ip_group = np.arange(n_peers, dtype=np.int32)
+    ip_group[malicious] = n_peers + rng.integers(
+        0, n_sybil_ips, int(malicious.sum())).astype(np.int32)
+    _, ip_group = np.unique(ip_group, return_inverse=True)
+    ip_group = ip_group.astype(np.int32)
+    plan = FaultPlan(eclipses=(EclipseWindow(start, end,
+                                             fraction=target_fraction),))
+    cfg = _attack_cfg(n_peers, k_slots, 1, plan,
+                      ip_colocation_factor_weight=-50.0,
+                      ip_colocation_factor_threshold=4,
+                      n_ip_groups=int(ip_group.max()) + 1, **cfg_kw)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    state = init_state(cfg, topo, malicious=malicious, ip_group=ip_group)
+    contracts = (
+        # the network at large must ride out the regional cut
+        DeliveryFloor(floor=0.70, start=start, end=end),
+        # and the heal must restore near-full delivery quickly
+        RecoveryCeiling(after=end, within=10, floor=0.95),
+        # sybils graylisted by the time the window closes, honest spared
+        ScoreResponse(by=end, attacker_frac=0.5, honest_max_frac=0.05),
+    )
+    return AttackScenario(cfg, default_topic_params(1), state, contracts,
+                          n_ticks, "eclipse")
+
+
+def censorship(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
+               censor_fraction: float = 0.4, victim: int = 0,
+               start: int = 8, end: int = 30, n_ticks: int = 40,
+               skew: float = 0.8, **cfg_kw) -> AttackScenario:
+    """Censorship: a censor cohort starves the victim publisher's
+    messages (:class:`~.faults.CensorWindow`) while a victim-centered
+    :class:`~.faults.StormWindow` gives the starvation real traffic to
+    starve (hot=1 → the victim publishes ``skew`` of the window's
+    traffic). The honest mesh must route around the censors and the
+    censors must pay: every unanswered pull is a P7 broken promise."""
+    if victim != 0:
+        # the victim-centered storm publishes from the HOT set = the
+        # lowest peer ids (StormWindow semantics), and hot=1 makes that
+        # exactly peer 0 — a victim elsewhere would be censored while
+        # peer 0 carries the storm, silently measuring the wrong peer
+        raise ValueError(
+            "censorship(): the victim-centered storm (StormWindow hot=1) "
+            "publishes from peer 0, so victim must be 0; relabel peers "
+            "instead of moving the victim")
+    # the cohort must be large enough to OWN eager paths: a message is
+    # missed eagerly only when every mesh sender on it censors, and only
+    # a miss sends the IWANT whose unanswered promise prices the attack
+    # (an eagerly saturated mesh never pulls, and an unasked censor is
+    # indistinguishable from an honest peer)
+    plan = FaultPlan(
+        censorships=(CensorWindow(start, end, fraction=censor_fraction,
+                                  victim=victim),),
+        storms=(StormWindow(start, end, hot=1, skew=skew, topic=0),))
+    # shallow thresholds + zero P7 activation: a censor's price is a few
+    # broken promises per asking edge (the asker stops pulling from it
+    # once it sinks below the gossip threshold, capping the penalty), so
+    # the graylist line must sit where that price can reach it — the
+    # scenario-scale analogue of tuning PeerScoreThresholds to the
+    # topic's traffic rate
+    kw = dict(behaviour_penalty_threshold=0.0, gossip_threshold=-10.0,
+              publish_threshold=-20.0, graylist_threshold=-30.0)
+    kw.update(cfg_kw)
+    cfg = _attack_cfg(n_peers, k_slots, 1, plan, **kw)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    state = init_state(cfg, topo)
+    # P3 is the defense that prices this attack (score.go:949-981 mesh
+    # delivery deficit): with the victim at `skew` of the window's
+    # traffic, a censor's mesh-delivery credit runs at ~(1-skew) of an
+    # honest peer's, so a deliveries threshold BETWEEN the two rates
+    # (honest ~2x publish rate at decay 0.5, censor ~2x(1-skew)x rate)
+    # puts every censoring mesh edge in squared deficit while honest
+    # edges keep full margin — the per-topic tuning the Eth2 scoring
+    # shape applies to its high-rate topics. P7 rides along: the few
+    # wholly-censor-surrounded peers' pulls break promises too.
+    from ..core.params import TopicScoreParams
+    tp = TopicParams.from_topic_params([TopicScoreParams(
+        topic_weight=1.0, time_in_mesh_weight=0.01,
+        time_in_mesh_quantum=1.0, time_in_mesh_cap=3600.0,
+        first_message_deliveries_weight=1.0,
+        first_message_deliveries_decay=0.5,
+        first_message_deliveries_cap=100.0,
+        mesh_message_deliveries_weight=-10.0,
+        mesh_message_deliveries_decay=0.5,
+        mesh_message_deliveries_cap=100.0,
+        mesh_message_deliveries_threshold=6.0,
+        mesh_message_deliveries_window=0.01,
+        mesh_message_deliveries_activation=5.0,
+        mesh_failure_penalty_weight=-10.0, mesh_failure_penalty_decay=0.5,
+        invalid_message_deliveries_weight=-10.0,
+        invalid_message_deliveries_decay=0.9,
+    )])
+    contracts = (
+        # the victim's topic keeps delivering despite the censors
+        DeliveryFloor(floor=0.85, start=start, end=end, topic=0),
+        # censors graylisted (P3 deficit -> heartbeat eviction), honest
+        # spared entirely. The graylist residence is transient per edge
+        # (eviction converts the deficit to a decaying failure penalty),
+        # so the bar is the synchronized deficit SPIKE a few ticks after
+        # activation — measured ~14% of censor edges at this shape —
+        # not a steady majority
+        ScoreResponse(by=end, attacker_frac=0.10, honest_max_frac=0.01,
+                      start=start),
+    )
+    return AttackScenario(cfg, tp, state, contracts,
+                          n_ticks, "censorship")
+
+
+def flash_crowd(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
+                start: int = 10, end: int = 25, hot: int = 8,
+                skew: float = 0.95, n_ticks: int = 40,
+                **cfg_kw) -> AttackScenario:
+    """Flash crowd: a hot-topic publish storm from a skewed publisher
+    set (:class:`~.faults.StormWindow`) at double the ambient publish
+    rate. The mesh must absorb the load (delivery floor) and settle back
+    once the crowd disperses (recovery ceiling)."""
+    plan = FaultPlan(storms=(StormWindow(start, end, hot=hot, skew=skew,
+                                         topic=0),))
+    cfg = _attack_cfg(n_peers, k_slots, 2, plan,
+                      publishers_per_tick=16, **cfg_kw)
+    rng = np.random.default_rng(SEED)
+    subscribed = np.ones((n_peers, 2), dtype=bool)
+    subscribed[:, 1] = rng.random(n_peers) < 0.4   # a bystander subnet
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    state = init_state(cfg, topo, subscribed=subscribed)
+    # Eth2-style per-topic tuning: only the HOT topic carries the mesh-
+    # delivery-deficit penalty (P3). A storm starves the bystander topic
+    # of window slots, and an idle topic with an MMD threshold penalizes
+    # its whole mesh into mutual pruning + 60-tick backoff — the known
+    # idle-topic footgun real deployments configure away (attestation
+    # subnets carry MMD weights, voluntary_exit-class topics don't).
+    base = default_topic_params(2)
+    zeros2 = base.mesh_message_deliveries_weight * \
+        np.asarray([1.0, 0.0], np.float32)
+    tp = base._replace(
+        mesh_message_deliveries_weight=zeros2,
+        mesh_failure_penalty_weight=base.mesh_failure_penalty_weight
+        * np.asarray([1.0, 0.0], np.float32))
+    contracts = (
+        DeliveryFloor(floor=0.90, start=start, end=end),
+        RecoveryCeiling(after=end, within=8, floor=0.97),
+    )
+    return AttackScenario(cfg, tp, state, contracts,
+                          n_ticks, "flash_crowd")
+
+
+def slow_link(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
+              fraction: float = 0.3, period: int = 3, drop: float = 0.05,
+              n_ticks: int = 40, **cfg_kw) -> AttackScenario:
+    """Slow links: a heterogeneous link model
+    (:class:`~.faults.SlowLinkClass` — a third of the edges open their
+    data plane 1-in-``period`` ticks and drop ``drop`` even then). The
+    router's gossip pull path must compensate (delivery floor), and —
+    the robustness leg — peers behind slow links must NOT end up
+    graylisted: latency is not misbehavior."""
+    plan = FaultPlan(slowlinks=(SlowLinkClass(fraction=fraction,
+                                              period=period, drop=drop),))
+    cfg = _attack_cfg(n_peers, k_slots, 1, plan, **cfg_kw)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    state = init_state(cfg, topo)
+    contracts = (
+        DeliveryFloor(floor=0.90, start=10),
+        # no attacker leg (attacker_frac=0): the whole assertion is that
+        # heterogeneous RTT produces NO honest graylisting
+        ScoreResponse(by=0, attacker_frac=0.0, honest_max_frac=0.02),
+    )
+    return AttackScenario(cfg, default_topic_params(1), state, contracts,
+                          n_ticks, "slow_link")
+
+
+def diurnal(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
+            period: int = 15, duty: int = 5, until: int = 51,
+            fraction: float = 0.25, phase: int = 6, n_ticks: int = 55,
+            **cfg_kw) -> AttackScenario:
+    """Diurnal churn: the same quarter of the network leaves for the
+    first ``duty`` ticks of every ``period``-tick cycle and rejoins
+    through the churn/backoff/retention path
+    (:class:`~.faults.ChurnWave`). The mesh must re-knit around each
+    wave (delivery floor over the whole schedule — the dark cohort's
+    undelivered rows ARE the dip being bounded) and recover fully after
+    the last wave."""
+    plan = FaultPlan(waves=(ChurnWave(period=period, duty=duty,
+                                      until=until, fraction=fraction,
+                                      phase=phase),))
+    cfg = _attack_cfg(n_peers, k_slots, 1, plan, **cfg_kw)
+    topo = topology.sparse(n_peers, k_slots, degree=degree, seed=SEED)
+    state = init_state(cfg, topo)
+    last_end = attack_end_tick(plan)
+    contracts = (
+        # the dark cohort's own undelivered rows are the dip being
+        # bounded: fraction of the census goes dark every cycle, so the
+        # floor sits under 1 - fraction with catch-up margin
+        DeliveryFloor(floor=0.45, start=phase),
+        RecoveryCeiling(after=last_end, within=10, floor=0.95),
+    )
+    return AttackScenario(cfg, default_topic_params(1), state, contracts,
+                          n_ticks, "diurnal")
+
+
+# name -> constructor; the *_small names sim/scenarios.py registers are
+# thin wrappers over these (scenario[:3])
+FAMILIES = {
+    "eclipse": eclipse,
+    "censorship": censorship,
+    "flash_crowd": flash_crowd,
+    "slow_link": slow_link,
+    "diurnal": diurnal,
+}
+
+# the sweep/test registry: scenario-registry name -> AttackScenario
+# builder (same names as sim/scenarios.SCENARIOS entries)
+ATTACKS = {
+    "eclipse_small": eclipse,
+    "censor_small": censorship,
+    "flashcrowd_small": flash_crowd,
+    "slowlink_small": slow_link,
+    "diurnal_small": diurnal,
+}
+
+
+# ---------------------------------------------------------------------------
+# running + evaluating
+
+
+@dataclasses.dataclass
+class AttackReport:
+    """One scenario run's outcome: final state, the telemetry row stream
+    the contracts were judged on, and the per-contract results."""
+
+    name: str
+    state: SimState
+    rows: list
+    results: list
+    fault_flags: int
+
+    @property
+    def passed(self) -> bool:
+        return all(r.passed for r in self.results)
+
+    def summary(self) -> dict:
+        return {"scenario": self.name, "passed": self.passed,
+                "fault_flags": self.fault_flags,
+                "contracts": [{"kind": r.kind, "status": r.status,
+                               "detail": r.detail} for r in self.results]}
+
+
+def run_with_contracts(scn: AttackScenario, key=None,
+                       n_ticks: int | None = None) -> AttackReport:
+    """Run one scenario end-to-end on the telemetry lane
+    (``engine.run_keys(telemetry=True)`` — the same device-side reduction
+    every execution plane streams) and evaluate its contracts on the
+    resulting rows. The tier-1 entry point; the fleet and journal planes
+    evaluate the same contracts via :func:`evaluate_contracts`."""
+    import jax
+
+    from . import telemetry
+    from .engine import run_keys
+
+    key = jax.random.PRNGKey(0) if key is None else key
+    ticks = n_ticks if n_ticks is not None else scn.n_ticks
+    keys = jax.random.split(key, ticks)
+    state, health = run_keys(scn.state, scn.cfg, scn.tp, keys,
+                             telemetry=True)
+    mat, cols = telemetry.records_to_rows(health)
+    rows = telemetry.rows_to_dicts(mat, cols)
+    results = evaluate_contracts(scn.contracts, rows, final=True)
+    return AttackReport(scn.name, state, rows, results,
+                        int(np.asarray(state.fault_flags)))

@@ -41,17 +41,29 @@ def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
                       ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Default scenario: P random peers publish, each to a random topic it
     subscribes to (peers with no subscriptions fall back to topic 0, which
-    only arises in custom scenarios)."""
+    only arises in custom scenarios). Under a plan with
+    :class:`~.faults.StormWindow`\\ s, active windows re-skew the draw
+    toward the hot publisher set (flash-crowd workload, sim/faults.py) —
+    the storm split only exists for storm plans, so every other config
+    keeps the exact historical RNG stream."""
+    storms = cfg.fault_plan is not None and cfg.fault_plan.storms
+    if storms:
+        key, k_storm = jax.random.split(key)
     kp, kt = jax.random.split(key)
     p = cfg.publishers_per_tick
     peers = jax.random.randint(kp, (p,), 0, cfg.n_peers)
     sub = state.subscribed[peers]                       # [P, T]
     g = jax.random.gumbel(kt, sub.shape)
     topics = jnp.argmax(jnp.where(sub, g, -jnp.inf), axis=-1).astype(jnp.int32)
+    if storms:
+        from .faults import storm_publishers
+        peers, topics = storm_publishers(state, cfg, peers, topics, k_storm)
     return peers, topics
 
 
-def _iwant_answer_extras(state: SimState, cfg: SimConfig) -> list | None:
+def _iwant_answer_extras(state: SimState, cfg: SimConfig,
+                         censor_bits: jnp.ndarray | None = None
+                         ) -> list | None:
     """When the tick's exchanges ride a formulation that can carry extra
     word lanes, the IWANT answer-table gather (forward_tick step 1) is
     data-independent of the heartbeat — it reads only deliver_tick and
@@ -80,6 +92,11 @@ def _iwant_answer_extras(state: SimState, cfg: SimConfig) -> list | None:
         return None
     answer_bits = jnp.where(state.malicious[None, :], jnp.uint32(0),
                             pack_words(state.deliver_tick < _NEVER))
+    if censor_bits is not None:
+        # censors withhold the victim's messages from their answer table
+        # (sim/faults.py censor_word_mask) — the SAME mask forward_tick
+        # applies on its own answer path, so the ride-along is identical
+        answer_bits = answer_bits & ~censor_bits
     return [answer_bits]
 
 
@@ -112,11 +129,20 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
                 jnp.uint32(0)))
     state = publish(state, cfg, peers, topics, k_ign,
                     corrupt=fault.corrupt if fault is not None else None)
+    if cfg.fault_plan is not None:
+        # the censor word mask reads msg_publisher, so it must be built
+        # AFTER publish — the victim's brand-new messages are censored
+        # the tick they appear (sim/faults.py)
+        from .faults import censor_word_mask
+        censor_bits = censor_word_mask(state, cfg)
+    else:
+        censor_bits = None
     if cfg.gater_enabled:
         state = gater_decay(state, cfg)
     if cfg.router == "gossipsub":
         hb = heartbeat(state, cfg, tp, k_hb,
-                       extra_words=_iwant_answer_extras(state, cfg))
+                       extra_words=_iwant_answer_extras(
+                           state, cfg, censor_bits=censor_bits))
     else:
         # floodsub/randomsub run NO heartbeat: no mesh maintenance, no
         # gossip, no scoring (floodsub.go/randomsub.go define none of it)
@@ -133,7 +159,8 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
                          if hb.extra_routed else None,
                          link_ok=fault.link_ok if fault is not None else None,
                          dup_edges=fault.dup_edges
-                         if fault is not None else None)
+                         if fault is not None else None,
+                         censor_bits=censor_bits)
     if cfg.churn_disconnect_prob > 0.0:
         # connection churn closes the tick, reusing the heartbeat's score
         # cache (its unmasked variant) for the PX reconnect gate — one

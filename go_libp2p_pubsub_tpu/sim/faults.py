@@ -47,6 +47,47 @@ Fault classes:
   and charge P4 invalid-message deliveries (score.go:899-918), feeding the
   scoring pipeline invalid traffic that no sybil actor sent.
 
+Beyond the original fault classes, the plan carries the ADVERSARY /
+WORKLOAD families of ISSUE 10 (ROADMAP item 4 — the gossipsub v1.1
+hardening evaluation set, Vyzovitis et al.):
+
+- **eclipse** (``eclipses``): for a tick window, every edge between a
+  TARGET (an honest peer in the contiguous id region
+  ``[0, ceil(fraction*N))``) and an honest NON-target is cut with
+  RemovePeer semantics — the targets keep only their sybil
+  (``state.malicious``) neighbors, so heartbeat under-subscription grafts
+  sybils into the targets' meshes (GRAFT pressure) and the window heals
+  through the same redial path as a partition. The region is id-contiguous
+  so both halves (and the host injector's ``malicious`` list) pick the
+  same targets.
+- **censorship** (``censorships``): a hash-chosen ``fraction`` of honest
+  peers suppress the ``victim`` peer's messages while the window is
+  active: no IHAVE advertisement, no IWANT answer, no forwarding — but
+  they still RECEIVE them (score-gamed: the censor behaves perfectly on
+  all other traffic). Unanswered pulls for censored messages are charged
+  as broken promises (P7) and withheld mesh forwarding starves P3 credit
+  — the scoring machinery the contract must show responding. Applied via
+  :func:`censor_word_mask` in engine.step; the fused Pallas hop is
+  ineligible under a censor plan (ops/hopkernel.py gate) because the
+  per-sender frontier mask cannot enter the kernel.
+- **flash-crowd storms** (``storms``): while a window is active each
+  publisher slot redraws, with probability ``skew``, from the ``hot``
+  lowest peer ids and publishes to the window's ``topic`` — a hot-topic
+  publish storm with a skewed publisher distribution
+  (:func:`storm_publishers`, consumed by engine.choose_publishers).
+- **slow links** (``slowlinks``): heterogeneous per-edge delay/drop
+  classes layered on the drop/dup link model. A symmetric edge hash
+  assigns each class's ``fraction`` of edges; a member edge's DATA plane
+  opens only every ``period``-th tick (a phase from the same hash — the
+  tick-quantized stand-in for a high-RTT/low-bandwidth link) and drops
+  with ``drop`` even when open. Control always flows.
+- **diurnal churn waves** (``waves``): a hash-chosen cohort
+  (``fraction``) goes dark for the first ``duty`` ticks of every
+  ``period``-tick cycle (offset ``phase``) until ``until`` — scheduled
+  join/leave waves through the same churn ops (take_edges_down /
+  bring_edges_up) as outages, one expanded window per cycle
+  (:func:`wave_windows`).
+
 Every random draw is keyed off the step key (batched) or a
 ``random.Random(plan.seed)`` stream (host), so runs are reproducible; the
 plan itself is a frozen dataclass, hashable, and lives on ``SimConfig`` as
@@ -98,6 +139,120 @@ class OutageWindow:
 
 
 @dataclasses.dataclass(frozen=True)
+class EclipseWindow:
+    """Sybil mesh takeover of a target region for ticks
+    ``start <= tick < end``: edges between an honest TARGET (peer id <
+    ceil(fraction*N)) and an honest non-target go down with RemovePeer
+    semantics, leaving the targets only their ``malicious`` neighbors;
+    the cut redials at ``end`` through the partition heal path."""
+
+    start: int
+    end: int
+    fraction: float = 0.1
+
+
+@dataclasses.dataclass(frozen=True)
+class CensorWindow:
+    """Score-gamed starvation of peer ``victim``'s messages for ticks
+    ``start <= tick < end``: a hash-chosen ``fraction`` of peers (never
+    the victim itself) stop advertising, answering IWANTs for, and
+    forwarding messages the victim published — while still receiving
+    them and behaving normally on all other traffic."""
+
+    start: int
+    end: int
+    fraction: float = 0.2
+    victim: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class StormWindow:
+    """Flash-crowd publish storm for ticks ``start <= tick < end``: each
+    publisher slot redraws with probability ``skew`` from the ``hot``
+    lowest peer ids and publishes to ``topic``."""
+
+    start: int
+    end: int
+    hot: int = 4
+    skew: float = 0.9
+    topic: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class SlowLinkClass:
+    """A heterogeneous link class (permanent, not windowed): a symmetric
+    edge hash assigns ``fraction`` of all edges; a member edge's data
+    plane opens only every ``period``-th tick (hash-derived phase) and
+    additionally drops with probability ``drop`` while open."""
+
+    fraction: float
+    period: int = 4
+    drop: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnWave:
+    """Diurnal join/leave schedule: a hash-chosen cohort (``fraction``)
+    is dark for the first ``duty`` ticks of every ``period``-tick cycle
+    starting at ``phase``, with no new cycle at or after ``until``. Each
+    cycle is one expanded outage-like window (:func:`wave_windows`); the
+    SAME cohort leaves every cycle (the diurnal pattern)."""
+
+    period: int
+    duty: int
+    until: int
+    fraction: float = 0.25
+    phase: int = 0
+
+
+def wave_windows(w: ChurnWave) -> list:
+    """The explicit (start, end) dark windows a :class:`ChurnWave`
+    expands to — shared by the batched cut mask and the host injector's
+    event schedule so both halves agree tick-for-tick."""
+    out = []
+    s = w.phase
+    while s < w.until:
+        out.append((s, s + w.duty))
+        s += w.period
+    return out
+
+
+# parse syntax per plan key (the named-error message AND the docs row)
+_SYNTAX = {
+    "drop": "drop=PROB",
+    "dup": "dup=PROB",
+    "corrupt": "corrupt=PROB",
+    "seed": "seed=INT",
+    "partition": "partition=COMPONENTS@START:END",
+    "outage": "outage=FRACTION@START:END",
+    "eclipse": "eclipse=FRACTION@START:END",
+    "censor": "censor=FRACTION[xVICTIM]@START:END",
+    "storm": "storm=HOT[xSKEW[xTOPIC]]@START:END",
+    "slowlink": "slowlink=FRACTION@PERIOD[:DROP]",
+    "wave": "wave=FRACTION@PERIOD:DUTY:UNTIL[:PHASE]",
+}
+
+
+def _window(v: str) -> tuple:
+    """``AMT@S:E`` -> (amt_str, start, end), validated."""
+    amt, sep, win = v.partition("@")
+    s, sep2, e = win.partition(":")
+    if not sep or not sep2:
+        raise ValueError("missing @START:END window")
+    start, end = int(s), int(e)
+    if end <= start:
+        raise ValueError(f"empty window [{start}, {end})")
+    return amt, start, end
+
+
+def _frac(v: str, what: str = "fraction") -> float:
+    f = float(v)
+    if not 0.0 <= f <= 1.0:
+        raise ValueError(f"{what} {f} outside [0, 1]")
+    return f
+
+
+@dataclasses.dataclass(frozen=True)
 class FaultPlan:
     """Jit-static fault schedule (module docstring). All-defaults is the
     null plan; ``SimConfig.fault_plan=None`` skips the fault pass
@@ -109,51 +264,158 @@ class FaultPlan:
     corrupt_prob: float = 0.0
     partitions: tuple = ()          # tuple[PartitionWindow, ...]
     outages: tuple = ()             # tuple[OutageWindow, ...]
+    eclipses: tuple = ()            # tuple[EclipseWindow, ...]
+    censorships: tuple = ()         # tuple[CensorWindow, ...]
+    storms: tuple = ()              # tuple[StormWindow, ...]
+    slowlinks: tuple = ()           # tuple[SlowLinkClass, ...]
+    waves: tuple = ()               # tuple[ChurnWave, ...]
     seed: int = 0
 
     def active(self) -> bool:
         return (self.link_drop_prob > 0.0 or self.link_dup_prob > 0.0
                 or self.corrupt_prob > 0.0 or bool(self.partitions)
-                or bool(self.outages))
+                or bool(self.outages) or bool(self.eclipses)
+                or bool(self.censorships) or bool(self.storms)
+                or bool(self.slowlinks) or bool(self.waves))
 
     @staticmethod
     def parse(spec: str) -> "FaultPlan":
         """Parse the ``GRAFT_FAULT_PLAN`` env-knob syntax: comma-separated
-        ``key=value`` items, repeatable for windows.
+        ``key=value`` items, repeatable for windows/classes.
 
             drop=0.05,dup=0.01,corrupt=0.1,seed=7
             partition=2@10:30          # 2 components, ticks [10, 30)
             outage=0.2@10:30           # 20% of peers dark, ticks [10, 30)
-        """
-        kw: dict = {"partitions": [], "outages": []}
+            eclipse=0.1@10:30          # 10% target region eclipsed
+            censor=0.2x5@10:30         # 20% censors starve peer 5's msgs
+            storm=8x0.9x1@10:20        # 8 hot publishers, skew .9, topic 1
+            slowlink=0.3@4:0.05        # 30% of edges open 1-in-4, drop 5%
+            wave=0.25@20:5:60          # 25% dark 5 ticks per 20, until 60
+
+        Malformed items raise a named ``ValueError`` quoting the item and
+        its expected syntax; :meth:`format` renders the canonical spec
+        back (``FaultPlan.parse(plan.format()) == plan``)."""
+        kw: dict = {"partitions": [], "outages": [], "eclipses": [],
+                    "censorships": [], "storms": [], "slowlinks": [],
+                    "waves": []}
         for item in spec.split(","):
             item = item.strip()
             if not item:
                 continue
             k, _, v = item.partition("=")
-            if k == "partition":
-                amt, _, win = v.partition("@")
-                s, _, e = win.partition(":")
-                kw["partitions"].append(
-                    PartitionWindow(int(s), int(e), components=int(amt)))
-            elif k == "outage":
-                amt, _, win = v.partition("@")
-                s, _, e = win.partition(":")
-                kw["outages"].append(
-                    OutageWindow(int(s), int(e), fraction=float(amt)))
-            elif k == "drop":
-                kw["link_drop_prob"] = float(v)
-            elif k == "dup":
-                kw["link_dup_prob"] = float(v)
-            elif k == "corrupt":
-                kw["corrupt_prob"] = float(v)
-            elif k == "seed":
-                kw["seed"] = int(v)
-            else:
-                raise ValueError(f"unknown fault-plan item {item!r}")
-        kw["partitions"] = tuple(kw["partitions"])
-        kw["outages"] = tuple(kw["outages"])
+            if k not in _SYNTAX:
+                raise ValueError(f"unknown fault-plan item {item!r}; "
+                                 f"known keys: {sorted(_SYNTAX)}")
+            try:
+                if k == "partition":
+                    amt, s, e = _window(v)
+                    kw["partitions"].append(
+                        PartitionWindow(s, e, components=int(amt)))
+                elif k == "outage":
+                    amt, s, e = _window(v)
+                    kw["outages"].append(
+                        OutageWindow(s, e, fraction=_frac(amt)))
+                elif k == "eclipse":
+                    amt, s, e = _window(v)
+                    kw["eclipses"].append(
+                        EclipseWindow(s, e, fraction=_frac(amt)))
+                elif k == "censor":
+                    amt, s, e = _window(v)
+                    parts = amt.split("x")
+                    if len(parts) > 2:
+                        raise ValueError("too many x-separated fields")
+                    victim = int(parts[1]) if len(parts) == 2 else 0
+                    kw["censorships"].append(CensorWindow(
+                        s, e, fraction=_frac(parts[0]), victim=victim))
+                elif k == "storm":
+                    amt, s, e = _window(v)
+                    parts = amt.split("x")
+                    if len(parts) > 3:
+                        raise ValueError("too many x-separated fields")
+                    hot = int(parts[0])
+                    if hot < 1:
+                        raise ValueError(f"hot={hot} must be >= 1")
+                    skew = _frac(parts[1], "skew") if len(parts) > 1 else 0.9
+                    topic = int(parts[2]) if len(parts) > 2 else 0
+                    kw["storms"].append(StormWindow(
+                        s, e, hot=hot, skew=skew, topic=topic))
+                elif k == "slowlink":
+                    amt, _, rest = v.partition("@")
+                    if not rest:
+                        raise ValueError("missing @PERIOD")
+                    p, _, d = rest.partition(":")
+                    period = int(p)
+                    if period < 1:
+                        raise ValueError(f"period={period} must be >= 1")
+                    kw["slowlinks"].append(SlowLinkClass(
+                        fraction=_frac(amt), period=period,
+                        drop=_frac(d, "drop") if d else 0.0))
+                elif k == "wave":
+                    amt, _, rest = v.partition("@")
+                    parts = rest.split(":") if rest else []
+                    if len(parts) not in (3, 4):
+                        raise ValueError("expected PERIOD:DUTY:UNTIL"
+                                         "[:PHASE] after @")
+                    period, duty, until = (int(parts[0]), int(parts[1]),
+                                           int(parts[2]))
+                    phase = int(parts[3]) if len(parts) == 4 else 0
+                    if period < 1 or not 0 < duty <= period:
+                        raise ValueError(
+                            f"need period >= 1 and 0 < duty <= period "
+                            f"(got period={period}, duty={duty})")
+                    if (until - phase) > 100_000 * period:
+                        raise ValueError("wave expands to > 100000 cycles")
+                    kw["waves"].append(ChurnWave(
+                        period=period, duty=duty, until=until,
+                        fraction=_frac(amt), phase=phase))
+                elif k == "drop":
+                    kw["link_drop_prob"] = _frac(v, "prob")
+                elif k == "dup":
+                    kw["link_dup_prob"] = _frac(v, "prob")
+                elif k == "corrupt":
+                    kw["corrupt_prob"] = _frac(v, "prob")
+                elif k == "seed":
+                    kw["seed"] = int(v)
+            except ValueError as err:
+                raise ValueError(
+                    f"malformed fault-plan item {item!r} (expected "
+                    f"{_SYNTAX[k]}): {err}") from err
+        for f in ("partitions", "outages", "eclipses", "censorships",
+                  "storms", "slowlinks", "waves"):
+            kw[f] = tuple(kw[f])
         return FaultPlan(**kw)
+
+    def format(self) -> str:
+        """The canonical spec string: ``FaultPlan.parse(p.format()) == p``
+        (round-trip pinned by tests/test_adversary.py). Zero-valued knobs
+        are omitted; window fields always render fully qualified."""
+        items = []
+        if self.link_drop_prob:
+            items.append(f"drop={self.link_drop_prob!r}")
+        if self.link_dup_prob:
+            items.append(f"dup={self.link_dup_prob!r}")
+        if self.corrupt_prob:
+            items.append(f"corrupt={self.corrupt_prob!r}")
+        for w in self.partitions:
+            items.append(f"partition={w.components}@{w.start}:{w.end}")
+        for w in self.outages:
+            items.append(f"outage={w.fraction!r}@{w.start}:{w.end}")
+        for w in self.eclipses:
+            items.append(f"eclipse={w.fraction!r}@{w.start}:{w.end}")
+        for w in self.censorships:
+            items.append(
+                f"censor={w.fraction!r}x{w.victim}@{w.start}:{w.end}")
+        for w in self.storms:
+            items.append(f"storm={w.hot}x{w.skew!r}x{w.topic}"
+                         f"@{w.start}:{w.end}")
+        for c in self.slowlinks:
+            items.append(f"slowlink={c.fraction!r}@{c.period}:{c.drop!r}")
+        for w in self.waves:
+            items.append(f"wave={w.fraction!r}@{w.period}:{w.duty}"
+                         f":{w.until}:{w.phase}")
+        if self.seed:
+            items.append(f"seed={self.seed}")
+        return ",".join(items)
 
 
 # ---------------------------------------------------------------------------
@@ -172,23 +434,163 @@ def _outage_salt(plan_seed: int, widx: int) -> int:
     return (plan_seed * 0x9E3779B9 + widx * 0x85EBCA6B) & 0xFFFFFFFF
 
 
-def outage_peers_host(n: int, widx: int, plan: FaultPlan) -> list[bool]:
-    """Host-side twin of the in-graph outage choice: peer i is dark in
-    outage window ``widx`` iff hash(i, seed, widx) < fraction * 2^32."""
-    w = plan.outages[widx]
-    thr = min(int(w.fraction * 4294967296.0), 0xFFFFFFFF)
-    salt = _outage_salt(plan.seed, widx)
+# per-family salt streams: same mixing as outages but a distinct additive
+# base per family, so window 0 of two different families never picks the
+# same cohort. "outage" keeps base 0 — the historical outage peer choice
+# is unchanged (tests pin it across halves).
+_FAMILY_SALTS = {
+    "outage": (0x85EBCA6B, 0x00000000),
+    "censor": (0xC2B2AE35, 0x9E3779B9),
+    "wave": (0x27D4EB2F, 0x3C6EF372),
+    "slowlink": (0x165667B1, 0xDAA66D2B),
+}
+
+
+def _family_salt(plan_seed: int, family: str, idx: int) -> int:
+    mult, base = _FAMILY_SALTS[family]
+    return (plan_seed * 0x9E3779B9 + idx * mult + base) & 0xFFFFFFFF
+
+
+def _thr32(fraction: float) -> int:
+    return min(int(fraction * 4294967296.0), 0xFFFFFFFF)
+
+
+def _hash_mask_host(n: int, salt: int, fraction: float) -> list[bool]:
+    thr = _thr32(fraction)
     return [_mix32_host(i ^ salt) < thr for i in range(n)]
 
 
-def _outage_peers_jax(n: int, widx: int, plan: FaultPlan) -> jnp.ndarray:
-    w = plan.outages[widx]
-    thr = U32(min(int(w.fraction * 4294967296.0), 0xFFFFFFFF))
-    x = jnp.arange(n, dtype=U32) ^ U32(_outage_salt(plan.seed, widx))
+def _hash_mask_jax(n: int, salt: int, fraction: float) -> jnp.ndarray:
+    x = jnp.arange(n, dtype=U32) ^ U32(salt)
     x = (x ^ (x >> 16)) * U32(0x45D9F3B)
     x = (x ^ (x >> 16)) * U32(0x45D9F3B)
     x = x ^ (x >> 16)
-    return x < thr
+    return x < U32(_thr32(fraction))
+
+
+def outage_peers_host(n: int, widx: int, plan: FaultPlan) -> list[bool]:
+    """Host-side twin of the in-graph outage choice: peer i is dark in
+    outage window ``widx`` iff hash(i, seed, widx) < fraction * 2^32."""
+    return _hash_mask_host(n, _outage_salt(plan.seed, widx),
+                           plan.outages[widx].fraction)
+
+
+def _outage_peers_jax(n: int, widx: int, plan: FaultPlan) -> jnp.ndarray:
+    return _hash_mask_jax(n, _outage_salt(plan.seed, widx),
+                          plan.outages[widx].fraction)
+
+
+def censor_peers_host(n: int, widx: int, plan: FaultPlan) -> list[bool]:
+    """Censor cohort of censorship window ``widx`` (never the victim)."""
+    w = plan.censorships[widx]
+    mask = _hash_mask_host(n, _family_salt(plan.seed, "censor", widx),
+                           w.fraction)
+    if 0 <= w.victim < n:
+        mask[w.victim] = False
+    return mask
+
+
+def _censor_peers_jax(n: int, widx: int, plan: FaultPlan) -> jnp.ndarray:
+    w = plan.censorships[widx]
+    mask = _hash_mask_jax(n, _family_salt(plan.seed, "censor", widx),
+                          w.fraction)
+    return mask & (jnp.arange(n) != w.victim)
+
+
+def wave_peers_host(n: int, widx: int, plan: FaultPlan) -> list[bool]:
+    """The diurnal cohort of wave ``widx`` — the SAME peers every cycle."""
+    return _hash_mask_host(n, _family_salt(plan.seed, "wave", widx),
+                           plan.waves[widx].fraction)
+
+
+def _wave_peers_jax(n: int, widx: int, plan: FaultPlan) -> jnp.ndarray:
+    return _hash_mask_jax(n, _family_salt(plan.seed, "wave", widx),
+                          plan.waves[widx].fraction)
+
+
+def eclipse_targets_host(n: int, widx: int, plan: FaultPlan,
+                         malicious=None) -> list[bool]:
+    """Target region of eclipse window ``widx``: honest peers in the
+    contiguous id region [0, ceil(fraction*N)). Both halves share this."""
+    import math
+    w = plan.eclipses[widx]
+    lim = max(1, int(math.ceil(w.fraction * n)))
+    return [i < lim and not (malicious is not None and malicious[i])
+            for i in range(n)]
+
+
+def _slow_edge_hash_host(i: int, j: int, salt: int) -> int:
+    a, b = (i, j) if i < j else (j, i)
+    return _mix32_host(((a * 0x9E3779B1) ^ b ^ salt) & 0xFFFFFFFF)
+
+
+def _slow_edge_hash_jax(neighbors: jnp.ndarray, salt: int) -> jnp.ndarray:
+    """[N, K] symmetric per-edge hash (both directions of an edge hash
+    identically — min/max endpoint ordering), matching
+    :func:`_slow_edge_hash_host` bit for bit."""
+    n = neighbors.shape[0]
+    i = jnp.broadcast_to(jnp.arange(n, dtype=U32)[:, None], neighbors.shape)
+    j = jnp.clip(neighbors, 0, n - 1).astype(U32)
+    a = jnp.minimum(i, j)
+    b = jnp.maximum(i, j)
+    x = ((a * U32(0x9E3779B1)) ^ b ^ U32(salt))
+    x = (x ^ (x >> 16)) * U32(0x45D9F3B)
+    x = (x ^ (x >> 16)) * U32(0x45D9F3B)
+    return x ^ (x >> 16)
+
+
+# ---------------------------------------------------------------------------
+# schedule introspection (journal headers, dashboard, recovery censuses)
+
+
+def attack_schedule(plan) -> list:
+    """The plan's attack/workload schedule as plain dicts — what the
+    health-journal run header stamps (sim/telemetry.py) and the dashboard
+    renders. Windowed families carry ``start``/``end``; slow-link classes
+    are permanent (``end`` is None)."""
+    out: list = []
+    if plan is None:
+        return out
+    for w in plan.partitions:
+        out.append({"kind": "partition", "start": w.start, "end": w.end,
+                    "components": w.components})
+    for w in plan.outages:
+        out.append({"kind": "outage", "start": w.start, "end": w.end,
+                    "fraction": w.fraction})
+    for w in plan.eclipses:
+        out.append({"kind": "eclipse", "start": w.start, "end": w.end,
+                    "fraction": w.fraction})
+    for w in plan.censorships:
+        out.append({"kind": "censor", "start": w.start, "end": w.end,
+                    "fraction": w.fraction, "victim": w.victim})
+    for w in plan.storms:
+        out.append({"kind": "storm", "start": w.start, "end": w.end,
+                    "hot": w.hot, "skew": w.skew, "topic": w.topic})
+    for c in plan.slowlinks:
+        out.append({"kind": "slowlink", "start": 0, "end": None,
+                    "fraction": c.fraction, "period": c.period,
+                    "drop": c.drop})
+    for i, w in enumerate(plan.waves):
+        for s, e in wave_windows(w):
+            out.append({"kind": "wave", "start": s, "end": e, "wave": i,
+                        "fraction": w.fraction})
+    return sorted(out, key=lambda d: (d["start"], d["kind"]))
+
+
+def attack_end_tick(plan) -> int:
+    """The tick the plan's last scheduled attack window closes (0 for a
+    window-free plan) — the heal tick a recovery census must anchor on
+    (scripts/sweep_scores.py; the hardcoded-20 bug class of PR 7).
+    Permanent slow-link classes have no end and do not move it."""
+    if plan is None:
+        return 0
+    ends = [w.end for fam in (plan.partitions, plan.outages, plan.eclipses,
+                              plan.censorships, plan.storms) for w in fam]
+    for w in plan.waves:
+        wins = wave_windows(w)
+        if wins:
+            ends.append(wins[-1][1])
+    return max(ends) if ends else 0
 
 
 # ---------------------------------------------------------------------------
@@ -207,7 +609,8 @@ class FaultTick(NamedTuple):
 
 def edge_cut_mask(plan: FaultPlan, tick: jnp.ndarray,
                   neighbors: jnp.ndarray, reverse_slot: jnp.ndarray,
-                  disconnect_tick: jnp.ndarray | None = None
+                  disconnect_tick: jnp.ndarray | None = None,
+                  malicious: jnp.ndarray | None = None
                   ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(want_down [N,K], heal_mask [N,K], injected uint32) for this tick's
     partition/outage schedule. ``heal_mask`` covers exactly the edges the
@@ -222,9 +625,15 @@ def edge_cut_mask(plan: FaultPlan, tick: jnp.ndarray,
     predates the later start) and must still heal it at its own end —
     the host injector's keep-severed-until-no-window-cuts-it bookkeeping
     (``HostFaultInjector._reknit``), mirrored. Symmetric by construction
-    (component membership, peer-outage, and the disconnect stamp are all
-    edge-symmetric), so RemovePeer semantics stay edge-symmetric."""
-    from .invariants import FAULT_OUTAGE, FAULT_PARTITION
+    (component membership, peer-outage, eclipse-target/honest membership,
+    and the disconnect stamp are all edge-symmetric), so RemovePeer
+    semantics stay edge-symmetric. ``malicious`` gates the eclipse cut
+    (sybil edges are the ones an eclipse deliberately leaves standing);
+    eclipse windows in a plan require it."""
+    import math
+
+    from .invariants import (FAULT_ECLIPSE, FAULT_OUTAGE, FAULT_PARTITION,
+                             FAULT_WAVE)
 
     n, k = neighbors.shape
     known = (neighbors >= 0) & (reverse_slot >= 0)
@@ -239,6 +648,21 @@ def edge_cut_mask(plan: FaultPlan, tick: jnp.ndarray,
         dark = _outage_peers_jax(n, i, plan)
         wins.append((w.start, w.end,
                      (dark[:, None] | dark[nbr]) & known, FAULT_OUTAGE))
+    if plan.eclipses and malicious is None:
+        raise ValueError("edge_cut_mask: a plan with eclipse windows "
+                         "needs the malicious mask (sybil edges are the "
+                         "ones the eclipse leaves standing)")
+    for w in plan.eclipses:
+        lim = max(1, int(math.ceil(w.fraction * n)))
+        tgt = (jnp.arange(n) < lim) & ~malicious
+        honest2 = ~malicious[:, None] & ~malicious[nbr]
+        cross = (tgt[:, None] ^ tgt[nbr]) & honest2 & known
+        wins.append((w.start, w.end, cross, FAULT_ECLIPSE))
+    for i, w in enumerate(plan.waves):
+        dark = _wave_peers_jax(n, i, plan)
+        cut = (dark[:, None] | dark[nbr]) & known
+        for s, e in wave_windows(w):
+            wins.append((s, e, cut, FAULT_WAVE))
 
     cut = jnp.zeros((n, k), bool)
     heal = jnp.zeros((n, k), bool)
@@ -270,15 +694,22 @@ def apply_faults(state: SimState, cfg: SimConfig, tp: TopicParams,
 
     plan = cfg.fault_plan
     n, k = state.neighbors.shape
-    kd, kdup, kc = jax.random.split(key, 3)
+    if plan.slowlinks:
+        # the extra split only exists under a slow-link plan, so every
+        # pre-existing plan shape keeps its exact historical RNG stream
+        kd, kdup, kc, kslow = jax.random.split(key, 4)
+    else:
+        kd, kdup, kc = jax.random.split(key, 3)
+        kslow = None
 
-    if plan.partitions or plan.outages:
+    if plan.partitions or plan.outages or plan.eclipses or plan.waves:
         # want_down from PRE-take-down state; heal_mask consults the
         # disconnect stamps as they stand at the window's end (the cut
         # itself stamped them >= window.start)
         want_down, heal_mask, inj = edge_cut_mask(
             plan, state.tick, state.neighbors, state.reverse_slot,
-            disconnect_tick=state.disconnect_tick)
+            disconnect_tick=state.disconnect_tick,
+            malicious=state.malicious)
         go_down = state.connected & want_down
         state = take_edges_down(state, cfg, tp, go_down)
         # heal redials exactly the ending windows' own cuts (edges a
@@ -288,7 +719,20 @@ def apply_faults(state: SimState, cfg: SimConfig, tp: TopicParams,
         state = bring_edges_up(state, cfg, come_up)
     else:
         want_down, _, inj = edge_cut_mask(
-            plan, state.tick, state.neighbors, state.reverse_slot)
+            plan, state.tick, state.neighbors, state.reverse_slot,
+            malicious=state.malicious)
+
+    # workload-family activity bits (the cut families stamp theirs in
+    # edge_cut_mask; storms/censorships act elsewhere — publisher choice
+    # and the forwarding word masks — but their ACTIVE windows are
+    # schedule facts, recorded here like a partition window's)
+    from .invariants import FAULT_CENSOR, FAULT_STORM
+    for w in plan.storms:
+        inj = inj | jnp.where((state.tick >= w.start) & (state.tick < w.end),
+                              U32(FAULT_STORM), U32(0))
+    for w in plan.censorships:
+        inj = inj | jnp.where((state.tick >= w.start) & (state.tick < w.end),
+                              U32(FAULT_CENSOR), U32(0))
 
     valid = state.connected
     link_ok = dup_edges = corrupt = None
@@ -296,6 +740,28 @@ def apply_faults(state: SimState, cfg: SimConfig, tp: TopicParams,
         link_ok = jax.random.uniform(kd, (n, k)) >= plan.link_drop_prob
         inj = inj | jnp.where(jnp.any(~link_ok & valid),
                               U32(FAULT_LINK_DROP), U32(0))
+    if plan.slowlinks:
+        # heterogeneous link classes: a member edge's data plane opens
+        # only every period-th tick (hash-derived phase) and drops with
+        # cl.drop while open — layered INTO link_ok like the uniform drop
+        from .invariants import FAULT_SLOWLINK
+        kss = jax.random.split(kslow, len(plan.slowlinks))
+        lk = jnp.ones((n, k), bool)
+        stalled = jnp.zeros((), bool)
+        known = state.neighbors >= 0
+        for ci, cl in enumerate(plan.slowlinks):
+            h = _slow_edge_hash_jax(
+                state.neighbors, _family_salt(plan.seed, "slowlink", ci))
+            member = (h < U32(_thr32(cl.fraction))) & known
+            phase = (h % U32(cl.period)).astype(jnp.int32)
+            open_now = ((state.tick + phase) % cl.period) == 0
+            ok = open_now
+            if cl.drop > 0.0:
+                ok = ok & (jax.random.uniform(kss[ci], (n, k)) >= cl.drop)
+            lk = lk & (~member | ok)
+            stalled = stalled | jnp.any(member & ~open_now & valid)
+        link_ok = lk if link_ok is None else (link_ok & lk)
+        inj = inj | jnp.where(stalled, U32(FAULT_SLOWLINK), U32(0))
     if plan.link_dup_prob > 0.0:
         dup_edges = (jax.random.uniform(kdup, (n, k)) < plan.link_dup_prob) \
             & valid
@@ -310,6 +776,66 @@ def apply_faults(state: SimState, cfg: SimConfig, tp: TopicParams,
     return state, FaultTick(want_down=want_down, link_ok=link_ok,
                             dup_edges=dup_edges, corrupt=corrupt,
                             injected=inj)
+
+
+# ---------------------------------------------------------------------------
+# workload-family hooks the engine consumes (sim/engine.py)
+
+
+def storm_publishers(state: SimState, cfg: SimConfig, peers: jnp.ndarray,
+                     topics: jnp.ndarray, key: jax.Array
+                     ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply the plan's active :class:`StormWindow`\\ s to this tick's
+    publisher draw: with probability ``skew`` a publisher slot redraws
+    from the ``hot`` lowest peer ids and publishes to the storm topic.
+    Called by ``engine.choose_publishers`` only when storms exist, so
+    storm-free configs keep the exact historical RNG stream."""
+    plan = cfg.fault_plan
+    for w in plan.storms:
+        key, kh, ks = jax.random.split(key, 3)
+        active = (state.tick >= w.start) & (state.tick < w.end)
+        hot = jax.random.randint(kh, peers.shape, 0, min(w.hot, cfg.n_peers))
+        use = active & (jax.random.uniform(ks, peers.shape) < w.skew)
+        peers = jnp.where(use, hot, peers)
+        topics = jnp.where(use, jnp.int32(w.topic), topics)
+    return peers, topics
+
+
+def censor_word_mask(state: SimState, cfg: SimConfig) -> jnp.ndarray | None:
+    """[W, N] packed word mask of the message slots peer ``n`` SUPPRESSES
+    this tick under the plan's active :class:`CensorWindow`\\ s (no IHAVE,
+    no IWANT answer, no forward — receiving is unaffected), or None when
+    no censorship is configured. Computed AFTER publish (engine.step) so
+    the victim's brand-new messages are covered the tick they appear."""
+    plan = cfg.fault_plan
+    if plan is None or not plan.censorships:
+        return None
+    from ..ops.bits import pack_bool
+    n = state.neighbors.shape[0]
+    mask = None
+    for i, w in enumerate(plan.censorships):
+        active = (state.tick >= w.start) & (state.tick < w.end)
+        vic = pack_bool(((state.msg_publisher == w.victim)
+                         & (state.msg_topic >= 0))[None, :])[0]     # [W]
+        cens = _censor_peers_jax(n, i, plan)                        # [N]
+        mw = jnp.where(active & cens[None, :], vic[:, None], U32(0))
+        mask = mw if mask is None else (mask | mw)
+    return mask
+
+
+def attacker_mask(state: SimState, cfg: SimConfig) -> jnp.ndarray:
+    """[N] bool: the peers the telemetry graylist split counts as
+    ATTACKERS — sybil actors (``state.malicious``) plus every censor
+    cohort of the plan (window-independent: the census asks "is this peer
+    an adversary", not "is it attacking right now"). The score-response
+    contract (sim/adversary.py) reads the split this mask induces."""
+    att = state.malicious
+    plan = getattr(cfg, "fault_plan", None)
+    if plan is not None:
+        n = state.malicious.shape[0]
+        for i in range(len(plan.censorships)):
+            att = att | _censor_peers_jax(n, i, plan)
+    return att
 
 
 # ---------------------------------------------------------------------------
@@ -329,27 +855,40 @@ class HostFaultInjector:
 
     ``corrupt_prob`` has no host-side hook here: on the runtime, corrupt
     traffic is expressed through topic validators (the reference's own
-    mechanism) — see tests/test_adversarial_runtime.py.
+    mechanism) — see tests/test_adversarial_runtime.py. The same applies
+    to ``censorships`` and ``storms``: on the host half a censor is a
+    router/validator behavior and a storm is the scenario's own publish
+    schedule, so the injector carries only the CONNECTION-layer families
+    (partitions, outages, eclipses, waves) and the LINK-layer ones
+    (drop/dup/slowlink).
 
     ORDERING CONTRACT: ``hosts`` must be in engine row order — list
     position i IS peer row i of the batched half (partition components
-    are ``i % components`` and outage peers hash the row id on both
-    sides). Build the swarm the way topology.from_hosts expects and pass
-    the same list; any other order silently picks different cut/dark
-    sets than the batched run of the same plan.
+    are ``i % components``, outage/wave peers hash the row id, and
+    eclipse targets are the low-id region on both sides). Build the swarm
+    the way topology.from_hosts expects and pass the same list; any other
+    order silently picks different cut/dark sets than the batched run of
+    the same plan. ``malicious`` (row-ordered bools) is required when the
+    plan has eclipse windows — the eclipse leaves sybil edges standing.
     """
 
-    def __init__(self, network, hosts, plan: FaultPlan):
+    def __init__(self, network, hosts, plan: FaultPlan, malicious=None):
         import random as _random
 
         self.network = network
         self.hosts = list(hosts)
         self.plan = plan
+        self.malicious = list(malicious) if malicious is not None else None
+        if plan.eclipses and self.malicious is None:
+            raise ValueError("HostFaultInjector: a plan with eclipse "
+                             "windows needs the malicious list (engine "
+                             "row order)")
         self.rng = _random.Random(plan.seed)
         self.index = {h.peer_id: i for i, h in enumerate(self.hosts)}
         self._partitions_live: list[PartitionWindow] = []
-        self._dark: dict = {}                          # widx -> set(peer ids)
-        self._severed: list = []                       # [(host_a, host_b)]
+        self._eclipse_targets: dict = {}     # widx -> [bool] target rows
+        self._dark: dict = {}                # (family, widx) -> set(peer ids)
+        self._severed: list = []             # [(host_a, host_b)]
         network.link_fault = self._link_fault
         sched = network.scheduler
         now = sched.now()
@@ -363,6 +902,19 @@ class HostFaultInjector:
                           lambda i=i, w=w: self._outage_start(i, w))
             sched.call_at(max(now, float(w.end)),
                           lambda i=i: self._outage_end(i))
+        for i, w in enumerate(plan.eclipses):
+            sched.call_at(max(now, float(w.start)),
+                          lambda i=i, w=w: self._eclipse_start(i, w))
+            sched.call_at(max(now, float(w.end)),
+                          lambda i=i: self._eclipse_end(i))
+        for i, w in enumerate(plan.waves):
+            # one scheduled (start, end) pair per expanded cycle — the
+            # batched half's wave_windows expansion, mirrored exactly
+            for s, e in wave_windows(w):
+                sched.call_at(max(now, float(s)),
+                              lambda i=i: self._wave_start(i))
+                sched.call_at(max(now, float(e)),
+                              lambda i=i: self._wave_end(i))
 
     # -- the one cut predicate (all transitions and the link hook agree) --
 
@@ -372,6 +924,10 @@ class HostFaultInjector:
     def _is_cut(self, i: int, j: int) -> bool:
         for w in self._partitions_live:
             if i % w.components != j % w.components:
+                return True
+        for tgt in self._eclipse_targets.values():
+            if (tgt[i] != tgt[j]) and not (
+                    self.malicious[i] or self.malicious[j]):
                 return True
         return self._is_dark(self.hosts[i].peer_id) \
             or self._is_dark(self.hosts[j].peer_id)
@@ -384,6 +940,21 @@ class HostFaultInjector:
             return "ok"
         if self._is_cut(i, j):
             return "drop"             # cut/dark link: nothing crosses
+        # slow-link classes: a member edge's DATA plane opens only every
+        # period-th scheduler second ((tick + phase) % period == 0, the
+        # batched half's formula on the same symmetric edge hash) and
+        # drops with cl.drop even when open — control always flows
+        if self.plan.slowlinks and has_data:
+            tick = int(self.network.scheduler.now())
+            for ci, cl in enumerate(self.plan.slowlinks):
+                h = _slow_edge_hash_host(
+                    i, j, _family_salt(self.plan.seed, "slowlink", ci))
+                if h >= _thr32(cl.fraction):
+                    continue
+                if (tick + h % cl.period) % cl.period != 0:
+                    return "drop_data"
+                if cl.drop > 0.0 and self.rng.random() < cl.drop:
+                    return "drop_data"
         # lossy links shed the DATA plane only (batched-half parity:
         # forward_tick masks link_ok into data_ok, control still flows),
         # so the drop draw is only spent on data-bearing frames
@@ -436,10 +1007,29 @@ class HostFaultInjector:
 
     def _outage_start(self, widx: int, w: OutageWindow) -> None:
         dark_mask = outage_peers_host(len(self.hosts), widx, self.plan)
-        self._dark[widx] = {h.peer_id
-                           for h, d in zip(self.hosts, dark_mask) if d}
+        self._dark[("outage", widx)] = \
+            {h.peer_id for h, d in zip(self.hosts, dark_mask) if d}
         self._sever_cut()
 
     def _outage_end(self, widx: int) -> None:
-        self._dark.pop(widx, None)
+        self._dark.pop(("outage", widx), None)
+        self._reknit()
+
+    def _eclipse_start(self, widx: int, w: EclipseWindow) -> None:
+        self._eclipse_targets[widx] = eclipse_targets_host(
+            len(self.hosts), widx, self.plan, malicious=self.malicious)
+        self._sever_cut()
+
+    def _eclipse_end(self, widx: int) -> None:
+        self._eclipse_targets.pop(widx, None)
+        self._reknit()
+
+    def _wave_start(self, widx: int) -> None:
+        dark_mask = wave_peers_host(len(self.hosts), widx, self.plan)
+        self._dark[("wave", widx)] = \
+            {h.peer_id for h, d in zip(self.hosts, dark_mask) if d}
+        self._sever_cut()
+
+    def _wave_end(self, widx: int) -> None:
+        self._dark.pop(("wave", widx), None)
         self._reknit()

@@ -85,7 +85,12 @@ class FleetResult:
     """Per-member outcome. ``state`` is the member's final SimState
     (bit-identical to its sequential run); ``tripped`` marks a member
     whose ``invariant_mode="raise"`` sentinel fired — its state is frozen
-    at the end of the window where the trip was detected."""
+    at the end of the window where the trip was detected.
+    ``health_rows`` (``collect_health=True`` runs only) is the member's
+    full per-tick telemetry row stream (sim/telemetry.py dict rows) — the
+    input the adversary behavior contracts evaluate per member
+    (sim/adversary.py evaluate_contracts; scripts/sweep_scores.py
+    contract columns)."""
 
     name: str
     state: SimState
@@ -93,6 +98,7 @@ class FleetResult:
     fault_flags: int
     flag_names: list
     tripped: bool
+    health_rows: list | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -349,7 +355,7 @@ def _write_fleet_crash_dump(sup, group_cfg, full, keys_win, gi, active,
 
 
 def _drive_group(gi, idxs, members, sup, report, dumps, hook,
-                 journal=None) -> dict:
+                 journal=None, collect_health=False) -> dict:
     """Run one config group to completion; {input_index: FleetResult}."""
     from .invariants import VIOLATION_MASK, decode_flags
 
@@ -380,13 +386,19 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook,
         # groups interleaved; the member ids bind rows back to input order
         journal.header(group_cfg, plane="fleet", group=gi,
                        member_ids=list(map(int, idxs)), member_names=names,
-                       n_ticks=n_ticks, resumed_done=done)
+                       n_ticks=n_ticks, resumed_done=done,
+                       **(sup.health_meta or {}))
     exec_cfg = group_cfg
     chunk_ticks = max(1, int(sup.chunk_ticks))
     every = sup.checkpoint_every_ticks or chunk_ticks
     next_ckpt = done + every
     failures = 0
     prev_active = b
+    # collect_health: per-member telemetry row accumulation (input-index
+    # keyed — compaction changes lane positions, never ids). A RESUMED
+    # run's pre-restore ticks are not re-collected; contract evaluation
+    # over a resumed fleet should read the journal instead.
+    health_rows: dict = {int(i): [] for i in idxs} if collect_health else {}
     while True:
         active = [j for j in range(b)
                   if not tripped[j] and done < n_ticks[j]]
@@ -410,7 +422,8 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook,
         try:
             out, health = _run_window(sub, exec_cfg, sub_tps, keys_win, sup,
                                       hook, info,
-                                      telemetry=journal is not None)
+                                      telemetry=journal is not None
+                                      or collect_health)
         except Exception as e:
             if not dumps:
                 raise       # plain fleet_run: no retry net, no dumps
@@ -449,6 +462,12 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook,
             journal.append_records(
                 health, member_ids=[int(idxs[j]) for j in active],
                 group=gi, window_start=done - this_win, ticks=this_win)
+        if collect_health and health is not None:
+            from .telemetry import records_to_rows, rows_to_dicts
+            mat, cols = records_to_rows(
+                health, member_ids=[int(idxs[j]) for j in active])
+            for r in rows_to_dicts(mat, cols):
+                health_rows[r["member"]].append(r)
         # per-member sentinel surfacing: a raise-mode lane whose violation
         # bits lit retires HERE, its siblings keep running
         if any(escalate):
@@ -481,11 +500,12 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook,
         out[i] = FleetResult(
             name=names[j], state=member_state(full, j),
             ticks_run=int(ticks[j] - starts[j]), fault_flags=fj,
-            flag_names=decode_flags(fj), tripped=tripped[j])
+            flag_names=decode_flags(fj), tripped=tripped[j],
+            health_rows=health_rows.get(int(i)) if collect_health else None)
     return out
 
 
-def _drive(members, sup, dumps, hook):
+def _drive(members, sup, dumps, hook, collect_health=False):
     if not members:
         return [], SupervisorReport()
     for m in members:
@@ -509,32 +529,42 @@ def _drive(members, sup, dumps, hook):
     try:
         for gi, idxs in enumerate(groups.values()):
             results.update(_drive_group(gi, idxs, members, sup, report,
-                                        dumps, hook, journal=journal))
+                                        dumps, hook, journal=journal,
+                                        collect_health=collect_health))
     finally:
         if journal is not None:
             journal.close()
     return [results[i] for i in range(len(members))], report
 
 
-def fleet_run(members: list, chunk_ticks: int | None = None) -> list:
+def fleet_run(members: list, chunk_ticks: int | None = None,
+              collect_health: bool = False) -> list:
     """Run a fleet unsupervised: no watchdog, no retries, no checkpoints —
     failures propagate. ``chunk_ticks`` bounds the window length (windows
     also end at member finishes for compaction); None scans each group's
     longest common stretch in one dispatch. Returns ``[FleetResult]`` in
-    input order; bit-exact per member vs sequential ``engine.run``."""
+    input order; bit-exact per member vs sequential ``engine.run``.
+    ``collect_health=True`` runs the telemetry lane and attaches each
+    member's per-tick row stream (``FleetResult.health_rows``) — the
+    fleet entry point for adversary contract evaluation."""
     sup = SupervisorConfig(chunk_ticks=chunk_ticks or (1 << 30),
                            max_retries=0, backoff_base_s=0.0,
                            sleep=lambda s: None)
-    results, _ = _drive(members, sup, dumps=False, hook=None)
+    results, _ = _drive(members, sup, dumps=False, hook=None,
+                        collect_health=collect_health)
     return results
 
 
 def supervised_fleet_run(members: list, sup: SupervisorConfig | None = None,
-                         *, _chunk_hook=None) -> tuple:
+                         *, collect_health: bool = False,
+                         _chunk_hook=None) -> tuple:
     """Run a fleet under the supervised execution plane (module
     docstring): chunked windows with watchdog + retry/degrade ladder,
     crash-atomic fleet-axis-bound checkpoints in
     ``sup.checkpoint_dir/fleet_gNN/``, resume, and fleet crash dumps.
-    Returns ``([FleetResult], SupervisorReport)``."""
+    Returns ``([FleetResult], SupervisorReport)``. ``collect_health``
+    as in :func:`fleet_run` (independent of ``sup.health_path`` — a run
+    may stream, collect, both, or neither)."""
     sup = sup or SupervisorConfig.from_env()
-    return _drive(members, sup, dumps=True, hook=_chunk_hook)
+    return _drive(members, sup, dumps=True, hook=_chunk_hook,
+                  collect_health=collect_health)

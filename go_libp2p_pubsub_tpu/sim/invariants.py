@@ -37,26 +37,38 @@ from .state import NEVER, SimState
 
 U32 = jnp.uint32
 
-# --- injected-fault bits (sim/faults.py sets these) ---
+# --- injected-fault bits (sim/faults.py sets these). The low HALF-WORD
+# belongs to injected faults: the adversary/workload plane (ISSUE 10)
+# outgrew the original low byte, so violations moved from bits 8+ to bits
+# 16+. Flag WORDS recorded before that move are not decodable under the
+# new layout: their old bits 8-9 (nonfinite/negative-counter violations)
+# land on FAULT_CENSOR/FAULT_WAVE and their higher violation bits read as
+# unknown — do not interpret pre-move journals/checkpoints' numeric flags
+# with post-move code (named constants keep all CODE correct) ---
 FAULT_LINK_DROP = 1 << 0     # >=1 link dropped a data plane this run
 FAULT_LINK_DUP = 1 << 1      # >=1 link duplicated traffic
 FAULT_PARTITION = 1 << 2     # a partition window was active
 FAULT_OUTAGE = 1 << 3        # an outage window was active
 FAULT_CORRUPT = 1 << 4       # >=1 honest publish was corrupted
+FAULT_STORM = 1 << 5         # a flash-crowd publish storm window was active
+FAULT_SLOWLINK = 1 << 6      # >=1 slow-link class stalled a live edge
+FAULT_ECLIPSE = 1 << 7       # an eclipse window was active
+FAULT_CENSOR = 1 << 8        # a censorship window was active
+FAULT_WAVE = 1 << 9          # a diurnal churn wave's dark phase was active
 
 # --- invariant-violation bits ---
-FLAG_NONFINITE = 1 << 8      # NaN/Inf in a score counter / app score
-FLAG_NEG_COUNTER = 1 << 9    # a monotone/decayed counter went negative
-FLAG_MESH_DEAD_EDGE = 1 << 10  # mesh slot points at a down/absent edge
-FLAG_GRAFT_IN_BACKOFF = 1 << 11  # edge grafted while its backoff was live
-FLAG_SLOT_GARBAGE = 1 << 12  # slot/topic index out of range (packed-word
+FLAG_NONFINITE = 1 << 16     # NaN/Inf in a score counter / app score
+FLAG_NEG_COUNTER = 1 << 17   # a monotone/decayed counter went negative
+FLAG_MESH_DEAD_EDGE = 1 << 18  # mesh slot points at a down/absent edge
+FLAG_GRAFT_IN_BACKOFF = 1 << 19  # edge grafted while its backoff was live
+FLAG_SLOT_GARBAGE = 1 << 20  # slot/topic index out of range (packed-word
 #                              tail-bit garbage decodes into this class)
-FLAG_DELIVER_FUTURE = 1 << 13  # deliver_tick > tick, negative, or
+FLAG_DELIVER_FUTURE = 1 << 21  # deliver_tick > tick, negative, or
 #                                delivered-but-not-seen
-FLAG_HALO_OVERFLOW = 1 << 14  # halo-route bucket overflow (counter > 0)
+FLAG_HALO_OVERFLOW = 1 << 22  # halo-route bucket overflow (counter > 0)
 
-VIOLATION_MASK = 0xFFFFFF00
-INJECTED_MASK = 0x000000FF
+VIOLATION_MASK = 0xFFFF0000
+INJECTED_MASK = 0x0000FFFF
 
 _NAMES = {
     FAULT_LINK_DROP: "link_drop",
@@ -64,6 +76,11 @@ _NAMES = {
     FAULT_PARTITION: "partition",
     FAULT_OUTAGE: "outage",
     FAULT_CORRUPT: "corrupt",
+    FAULT_STORM: "storm",
+    FAULT_SLOWLINK: "slowlink",
+    FAULT_ECLIPSE: "eclipse",
+    FAULT_CENSOR: "censor",
+    FAULT_WAVE: "wave",
     FLAG_NONFINITE: "VIOLATION:nonfinite_counter",
     FLAG_NEG_COUNTER: "VIOLATION:negative_counter",
     FLAG_MESH_DEAD_EDGE: "VIOLATION:mesh_dead_edge",

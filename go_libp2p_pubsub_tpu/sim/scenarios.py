@@ -331,6 +331,71 @@ def frontier_1m(n_peers: int = FRONTIER_NS["frontier_1m"], **kw):
     return frontier(n_peers, **kw)
 
 
+# --- adversary & workload library (sim/adversary.py, ISSUE 10) -----------
+# Five attack/workload families with machine-checkable behavior contracts
+# (delivery floor, recovery ceiling, score response). The registry entries
+# below return the plain (cfg, tp, state) triple; the contracts travel on
+# adversary.ATTACKS[name]() for the contract-enforcing planes
+# (tests/test_adversary.py tier-1, sweep contract columns, dashboard).
+# Lazy imports: adversary imports THIS module for the shared helpers.
+
+
+def eclipse_small(**kw) -> tuple[SimConfig, TopicParams, SimState]:
+    """Sybil mesh takeover of a target region (adversary.eclipse)."""
+    from . import adversary
+    return tuple(adversary.eclipse(**kw)[:3])
+
+
+def censor_small(**kw) -> tuple[SimConfig, TopicParams, SimState]:
+    """Score-gamed starvation of a victim publisher
+    (adversary.censorship)."""
+    from . import adversary
+    return tuple(adversary.censorship(**kw)[:3])
+
+
+def flashcrowd_small(**kw) -> tuple[SimConfig, TopicParams, SimState]:
+    """Hot-topic publish storm, skewed publishers
+    (adversary.flash_crowd)."""
+    from . import adversary
+    return tuple(adversary.flash_crowd(**kw)[:3])
+
+
+def slowlink_small(**kw) -> tuple[SimConfig, TopicParams, SimState]:
+    """Heterogeneous per-edge delay/drop classes (adversary.slow_link)."""
+    from . import adversary
+    return tuple(adversary.slow_link(**kw)[:3])
+
+
+def diurnal_small(**kw) -> tuple[SimConfig, TopicParams, SimState]:
+    """Scheduled diurnal join/leave waves (adversary.diurnal)."""
+    from . import adversary
+    return tuple(adversary.diurnal(**kw)[:3])
+
+
+def eclipse_50k(n_peers: int = 50_000, k_slots: int = 32, degree: int = 12,
+                **kw) -> tuple[SimConfig, TopicParams, SimState]:
+    """The eclipse family at bench scale: 50k peers, windows sized for
+    short measured windows (the faults_degraded bench-line discipline —
+    the attack must FIRE inside a 10-tick measurement)."""
+    from . import adversary
+    kw.setdefault("start", 3)
+    kw.setdefault("end", 8)
+    return tuple(adversary.eclipse(n_peers=n_peers, k_slots=k_slots,
+                                   degree=degree, **kw)[:3])
+
+
+def flashcrowd_50k(n_peers: int = 50_000, k_slots: int = 32,
+                   degree: int = 12, **kw
+                   ) -> tuple[SimConfig, TopicParams, SimState]:
+    """The flash-crowd family at bench scale (hot set scaled with N)."""
+    from . import adversary
+    kw.setdefault("start", 3)
+    kw.setdefault("end", 8)
+    kw.setdefault("hot", 64)
+    return tuple(adversary.flash_crowd(n_peers=n_peers, k_slots=k_slots,
+                                       degree=degree, **kw)[:3])
+
+
 # --- small-N attack family (scripts/sweep_scores.py grid cells) ----------
 # The same adversarial shapes as their big siblings, sized so a
 # weight-variant × seed fleet of them batches into one vmapped scan on any
@@ -370,6 +435,13 @@ SCENARIOS = {
     "sybil_small": sybil_small,
     "partition_small": partition_small,
     "outage_small": outage_small,
+    "eclipse_small": eclipse_small,
+    "censor_small": censor_small,
+    "flashcrowd_small": flashcrowd_small,
+    "slowlink_small": slowlink_small,
+    "diurnal_small": diurnal_small,
+    "eclipse_50k": eclipse_50k,
+    "flashcrowd_50k": flashcrowd_50k,
     "frontier_250k": frontier_250k,
     "frontier_500k": frontier_500k,
     "frontier_1m": frontier_1m,

@@ -152,6 +152,11 @@ class SupervisorConfig:
     # the (collective) reduction but skip the journal — the multihost
     # rank-0-only write discipline. Env: GRAFT_HEALTH_STREAM=path.
     health_path: str | None = None
+    # extra keys stamped into the health journal's run header (JSON-able
+    # dict) — adversary scenarios stamp their declared behavior contracts
+    # here (sim/adversary.py contracts_to_json) so the dashboard can
+    # evaluate the SCENARIO's contracts, not just the schedule defaults
+    health_meta: dict | None = None
 
     @staticmethod
     def from_env(**overrides) -> "SupervisorConfig":
@@ -528,7 +533,7 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         journal = HealthJournal(sup.health_path)
         journal.header(cfg, scenario=sup.scenario, start_tick=start_tick,
                        n_ticks=n_ticks, resumed_tick=report.resumed_tick,
-                       traced=traced)
+                       traced=traced, **(sup.health_meta or {}))
 
     exec_cfg = cfg
     chunk_ticks = max(1, int(sup.chunk_ticks))

@@ -79,6 +79,17 @@ class HealthRecord(NamedTuple):
     backoff_count: jnp.ndarray    # i32 live backoff entries (expiry > tick)
     graylist_count: jnp.ndarray   # i32 connected edges scored below
                                   #   graylist_threshold (AcceptFrom gate)
+    connected_edges: jnp.ndarray  # i32 connected neighbor slots (total)
+    attacker_edges: jnp.ndarray   # i32 connected slots whose REMOTE peer
+                                  #   is an attacker (sim/faults.py
+                                  #   attacker_mask: sybils + censor
+                                  #   cohorts) — the score-response
+                                  #   contract's denominator
+    attacker_graylisted: jnp.ndarray  # i32 attacker edges below the
+                                  #   graylist threshold (the response)
+    honest_graylisted: jnp.ndarray    # i32 graylisted edges to HONEST
+                                  #   peers (collateral damage — the
+                                  #   contract's "honest peers not" leg)
     score_mean: jnp.ndarray       # f32 over connected slots
     score_min: jnp.ndarray        # f32
     published_window: jnp.ndarray  # i32 live slots of the message window
@@ -102,19 +113,38 @@ def health_record(state: SimState, cfg: SimConfig,
     tick = state.tick
 
     # --- per-topic settled delivery fraction (delivery_fraction, split
-    # by topic via a segment-sum over the message window) ---
+    # by topic via a segment-sum over the message window). The census
+    # counts DELIVERABLE traffic only: invalid (sybil/corrupted) and
+    # ignore-verdict messages are structurally undeliverable to honest
+    # receivers (validation.go:293-370 — rejected messages never enter
+    # the mcache), so counting them would fake a delivery deficit
+    # proportional to the attacker publish share in every adversarial
+    # scenario. A topic with an EMPTY census this tick reads 1.0
+    # (vacuously delivered), not 0.0 — a storm that crowds topic B out
+    # of the window must not report topic B as a delivery catastrophe
+    # (the empty-census-is-not-zero rule of scripts/sweep_scores.py).
+    # ATTACKER receivers (sim/faults.py attacker_mask) are excluded too:
+    # a graylisted sybil that no honest peer still serves is the defense
+    # WORKING — counting its starved rows would read every successful
+    # eviction as a delivery failure. ---
+    from .faults import attacker_mask
+
     age = tick - state.msg_publish_tick                       # [M]
     alive = (age < cfg.history_length) & (age >= 0)
     valid = state.msg_topic >= 0
+    deliverable = valid & alive & ~state.msg_invalid & ~state.msg_ignored
     t_m = jnp.clip(state.msg_topic, 0, t_topics - 1)
-    should = state.subscribed[:, t_m] & (alive & valid)[None, :]   # [N, M]
+    att = attacker_mask(state, cfg)                           # [N]
+    should = state.subscribed[:, t_m] & ~att[:, None] \
+        & deliverable[None, :]                                     # [N, M]
     got = unpack_have(state, cfg.msg_window) & should
     got_m = jnp.sum(got, axis=0).astype(jnp.float32)          # [M]
     should_m = jnp.sum(should, axis=0).astype(jnp.float32)
     zeros_t = jnp.zeros((t_topics,), jnp.float32)
-    got_t = zeros_t.at[t_m].add(jnp.where(valid, got_m, 0.0))
-    should_t = zeros_t.at[t_m].add(jnp.where(valid, should_m, 0.0))
-    delivery_frac = got_t / jnp.maximum(should_t, 1.0)
+    got_t = zeros_t.at[t_m].add(jnp.where(deliverable, got_m, 0.0))
+    should_t = zeros_t.at[t_m].add(jnp.where(deliverable, should_m, 0.0))
+    delivery_frac = jnp.where(should_t > 0.0,
+                              got_t / jnp.maximum(should_t, 1.0), 1.0)
 
     # --- mesh degree over subscribed (peer, topic) pairs ---
     deg = jnp.sum(state.mesh, axis=-1).astype(jnp.int32)      # [N, T]
@@ -128,11 +158,19 @@ def health_record(state: SimState, cfg: SimConfig,
     deg_mean = jnp.sum(jnp.where(sub, deg, 0)).astype(jnp.float32) \
         / jnp.maximum(n_sub, 1).astype(jnp.float32)
 
-    # --- backoff / graylist census ---
+    # --- backoff / graylist census, split by the attacker mask ---
+    # (`att` above — the score-response contract needs "attackers
+    # graylisted, honest peers not" as two integer counts; integer sums
+    # stay exact under the sharded step)
     backoff_count = jnp.sum(state.backoff > tick, dtype=jnp.int32)
     scores = compute_scores(state, cfg, tp, apply_decay=True)  # [N, K]
     gray = state.connected & (scores < cfg.graylist_threshold)
     graylist_count = jnp.sum(gray, dtype=jnp.int32)
+    nbr_att = att[jnp.clip(state.neighbors, 0, n - 1)] \
+        & (state.neighbors >= 0)                               # [N, K]
+    attacker_edges = jnp.sum(state.connected & nbr_att, dtype=jnp.int32)
+    attacker_graylisted = jnp.sum(gray & nbr_att, dtype=jnp.int32)
+    honest_graylisted = graylist_count - attacker_graylisted
 
     # --- score stats over connected slots ---
     conn = state.connected
@@ -152,6 +190,10 @@ def health_record(state: SimState, cfg: SimConfig,
         mesh_deg_max=deg_max,
         backoff_count=backoff_count,
         graylist_count=graylist_count,
+        connected_edges=n_conn.astype(jnp.int32),
+        attacker_edges=attacker_edges,
+        attacker_graylisted=attacker_graylisted,
+        honest_graylisted=honest_graylisted,
         score_mean=score_mean,
         score_min=score_min,
         published_window=jnp.sum(valid, dtype=jnp.int32),
@@ -170,8 +212,9 @@ health_record_jit = jax.jit(health_record, static_argnames=("cfg",))
 # encoder (native or Python) and one dashboard read every journal
 
 _INT_COLS = {"tick", "member", "mesh_deg_min", "mesh_deg_max",
-             "backoff_count", "graylist_count", "published_window",
-             "halo_overflow", "fault_flags"}
+             "backoff_count", "graylist_count", "connected_edges",
+             "attacker_edges", "attacker_graylisted", "honest_graylisted",
+             "published_window", "halo_overflow", "fault_flags"}
 
 
 def health_columns(n_topics: int) -> list:
@@ -181,7 +224,9 @@ def health_columns(n_topics: int) -> list:
     names = ["tick", "member"] \
         + [f"delivery_frac_t{j}" for j in range(n_topics)] \
         + ["mesh_deg_min", "mesh_deg_mean", "mesh_deg_max", "backoff_count",
-           "graylist_count", "score_mean", "score_min", "published_window",
+           "graylist_count", "connected_edges", "attacker_edges",
+           "attacker_graylisted", "honest_graylisted",
+           "score_mean", "score_min", "published_window",
            "delivered_total", "halo_overflow", "fault_flags"]
     return [(nm, nm in _INT_COLS) for nm in names]
 
@@ -223,7 +268,9 @@ def records_to_rows(records: HealthRecord,
     mat[:, 2:2 + t_topics] = np.asarray(
         leaves.delivery_frac, np.float64).reshape(c * b, t_topics)
     scalar_fields = ["mesh_deg_min", "mesh_deg_mean", "mesh_deg_max",
-                     "backoff_count", "graylist_count", "score_mean",
+                     "backoff_count", "graylist_count", "connected_edges",
+                     "attacker_edges", "attacker_graylisted",
+                     "honest_graylisted", "score_mean",
                      "score_min", "published_window", "delivered_total",
                      "halo_overflow", "fault_flags"]
     for i, f in enumerate(scalar_fields):
@@ -314,6 +361,13 @@ class HealthJournal:
 
     def header(self, cfg: SimConfig, **meta) -> None:
         from . import checkpoint
+        from .faults import attack_schedule
+        sched = attack_schedule(getattr(cfg, "fault_plan", None))
+        if sched:
+            # attack scenarios stamp their schedule into the run header
+            # so the dashboard can render active windows and evaluate the
+            # default behavior contracts without the (jit-static) config
+            meta.setdefault("attack_windows", sched)
         self.note("run",
                   fingerprint=checkpoint.config_fingerprint(cfg),
                   n_peers=cfg.n_peers, n_topics=cfg.n_topics,

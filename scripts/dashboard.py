@@ -226,6 +226,7 @@ def _snapshot_of(j: dict, path: str) -> dict:
         # not-tracked ≠ clean rule run_traced's None flags encode)
         snap["fault_flags"] = None
     snap["fault_flag_names"] = _decode_flags(snap["fault_flags"])
+    _attach_attacks(snap, run, rows)
     # recent trend for the sparkline: mean delivery per tick
     trend: dict = {}
     for r in rows:
@@ -244,6 +245,47 @@ def _snapshot_of(j: dict, path: str) -> dict:
             "delivery_frac": sum(wf) / len(wf) if wf else None,
             "fault_flags": worst.get("fault_flags")}
     return snap
+
+
+def _attach_attacks(snap: dict, run: dict, rows: list) -> None:
+    """Attack-scenario view (ISSUE 10): the run header stamps its
+    ``attack_windows`` schedule (sim/telemetry.py header) and optionally
+    its declared ``contracts`` (SupervisorConfig.health_meta); the
+    dashboard marks which windows cover the newest tick and evaluates
+    the contracts over the visible rows — ``pending`` while a decision
+    tick is still ahead, final once the run ended/crashed. Live mode's
+    tailer keeps a bounded recent row window, so a long-scrolled-past
+    delivery dip may age out of the live view; ``--once`` reads the
+    whole journal and judges the full stream."""
+    windows = run.get("attack_windows")
+    if not windows:
+        return
+    tick = snap.get("tick", -1)
+    snap["attacks"] = [dict(w, active=(w["start"] <= tick
+                                       and (w["end"] is None
+                                            or tick < w["end"])))
+                       for w in windows]
+    final = bool(snap.get("done") or snap.get("crashes"))
+    try:
+        from go_libp2p_pubsub_tpu.sim import adversary
+        if run.get("contracts"):
+            contracts = adversary.contracts_from_json(run["contracts"])
+        else:
+            contracts = adversary.contracts_from_schedule(windows)
+        members = sorted({r.get("member", -1) for r in rows})
+        out = []
+        for c in contracts:
+            per = [c.evaluate(adversary.member_rows(rows, m), final=final)
+                   for m in members]
+            worst = next((r for r in per if r.status == "fail"),
+                         next((r for r in per if r.status == "pending"),
+                              per[0]))
+            out.append({"kind": worst.kind, "status": worst.status,
+                        "detail": worst.detail})
+        snap["contracts"] = out
+    except Exception as e:           # the dashboard must render anyway
+        snap["contracts"] = [{"kind": "error", "status": "fail",
+                              "detail": f"contract evaluation failed: {e}"}]
 
 
 def render(snap: dict) -> str:
@@ -313,6 +355,20 @@ def render(snap: dict) -> str:
         out.append(f"  worst member #{w['member']}: "
                    f"delivery {w['delivery_frac']:.4f} "
                    f"flags {w['fault_flags']}")
+    if snap.get("attacks"):
+        live = [w for w in snap["attacks"] if w["active"]]
+        sched = [w for w in snap["attacks"] if not w["active"]]
+        for w in live:
+            end = "∞" if w["end"] is None else w["end"]
+            out.append(f"  ATTACK {w['kind']} [{w['start']}, {end}) ACTIVE")
+        if sched:
+            out.append("  attacks scheduled: " + ", ".join(
+                f"{w['kind']}@{w['start']}" for w in sched[:6]))
+    for c in snap.get("contracts", []):
+        mark = {"pass": "ok", "fail": "FAIL", "pending": "…"}[
+            c["status"]] if c["status"] in ("pass", "fail", "pending") \
+            else c["status"]
+        out.append(f"  contract {c['kind']}: {mark} — {c['detail']}")
     if snap.get("checkpoints"):
         out.append("  checkpoints @ " + ", ".join(
             str(t) for t in snap["checkpoints"][-4:]))

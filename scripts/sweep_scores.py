@@ -62,6 +62,13 @@ VARIANTS = {
 }
 
 SCENARIO_NAMES = ("sybil_small", "partition_small", "outage_small")
+# the adversary/workload library families (sim/adversary.py, ISSUE 10):
+# sweepable like the classic trio (--scenarios eclipse_small,... or
+# SWEEP_SCENARIOS); their cells additionally evaluate the scenario's
+# declared behavior contracts per member (contracts_failed column) from
+# the fleet's collected telemetry rows
+ATTACK_SCENARIOS = ("eclipse_small", "censor_small", "flashcrowd_small",
+                    "slowlink_small", "diurnal_small")
 SEED_KEY_BASE = 271828
 
 PERF_BEGIN = "<!-- sweep_scores:frontier:begin -->"
@@ -115,13 +122,15 @@ def _recovery_fraction(state, cfg, heal_tick: int) -> float | None:
 
 
 def _heal_tick(cfg) -> int:
-    """The tick the member's own FaultPlan fully heals (last window end)
-    — derived from the config so a re-tuned scenario window can never
-    silently desynchronize the recovery census."""
-    plan = cfg.fault_plan
-    ends = ([w.end for w in plan.partitions] + [w.end for w in plan.outages]
-            if plan is not None else [])
-    return max(ends) if ends else 0
+    """The tick the member's own FaultPlan fully heals/ends its LAST
+    scheduled window — derived from the config so a re-tuned scenario
+    window can never silently desynchronize the recovery census (the
+    hardcoded-20 bug class fixed in PR 7). ``faults.attack_end_tick``
+    covers every windowed family (partition/outage/eclipse/censor/storm/
+    wave); window-free plans (slow-link classes) return 0, making the
+    recovery census the whole settled run."""
+    from go_libp2p_pubsub_tpu.sim.faults import attack_end_tick
+    return attack_end_tick(cfg.fault_plan)
 
 
 def cell_metrics(scenario: str, res, cfg) -> dict:
@@ -195,6 +204,21 @@ def run_sweep(scenario_names=None, variant_names=None, *, n: int = 512,
 
     rows = []
     for scen in scenario_names:
+        # adversary-family scenarios (sim/adversary.py) carry behavior
+        # contracts: run their fleets on the telemetry lane and judge
+        # every member's row stream against the scenario's contracts.
+        # Members run at least the scenario's recommended n_ticks — the
+        # contracts' decision ticks (e.g. diurnal's last-wave recovery
+        # window) can sit past the grid's default, and a run that ends
+        # before them would fail every cell's contracts structurally
+        from go_libp2p_pubsub_tpu.sim import adversary
+        scen_ticks = ticks
+        contracts = ()
+        if scen in adversary.ATTACKS:
+            attack = adversary.ATTACKS[scen](n_peers=n)
+            contracts = attack.contracts
+            scen_ticks = max(ticks, attack.n_ticks)
+
         members, cells, cfgs = [], [], {}
         for var in variant_names:
             if (scen, var) in recorded:
@@ -207,13 +231,14 @@ def run_sweep(scenario_names=None, variant_names=None, *, n: int = 512,
             for s in range(seeds):
                 members.append(FleetMember(
                     cfg, tp, st, jax.random.PRNGKey(SEED_KEY_BASE + s),
-                    ticks, name=f"{scen}/{var}/s{s}"))
+                    scen_ticks, name=f"{scen}/{var}/s{s}"))
                 cells.append(var)
 
         by_cell: dict = {}
         if members:
             results, report = supervised_fleet_run(
-                members, sup or SupervisorConfig.from_env())
+                members, sup or SupervisorConfig.from_env(),
+                collect_health=bool(contracts))
             groups = next((len(e["sizes"]) for e in report.events
                            if e["event"] == "fleet_plan"), 0)
             emit(json.dumps({"info": "fleet done", "scenario": scen,
@@ -241,8 +266,22 @@ def run_sweep(scenario_names=None, variant_names=None, *, n: int = 512,
                 "fault_flags": flags,
                 "fault_flag_names": decode_flags(flags),
                 "tripped": any(r.tripped for r in cell_res),
-                "seeds": seeds, "n": n, "ticks": ticks,
+                "seeds": seeds, "n": n, "ticks": scen_ticks,
             }
+            if contracts:
+                # every member's stream judged against the scenario's
+                # declared contracts; the row carries how many member-
+                # contract pairs failed and which kinds (a weight
+                # variant that breaks a contract shows it here)
+                failed = []
+                for r in cell_res:
+                    for c in adversary.evaluate_contracts(
+                            contracts, r.health_rows or [], final=True):
+                        if not c.passed:
+                            failed.append(c.kind)
+                row["contracts"] = len(contracts) * len(cell_res)
+                row["contracts_failed"] = len(failed)
+                row["contracts_failed_kinds"] = sorted(set(failed))
             rows.append(row)
             emit(json.dumps(row))
             _journal_append(journal, scen, var, env, row)

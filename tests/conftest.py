@@ -111,6 +111,14 @@ _SLOW = {
                           "test_fleet_stream_matches_per_member",
                           "test_bare_state_run_fn_not_mistaken",
                           "test_window_end_is_paused_not_ended"),
+    # adversary & workload library (ISSUE 10): the acceptance core — the
+    # five families with enforced contracts, the positive control, parse/
+    # format round-trips, contract-evaluation pins, dashboard/telemetry
+    # plumbing — stays tier-1; the host-runtime swarm parities and the
+    # fleet collect_health integration are belt-and-braces
+    "test_adversary.py": ("TestHostRuntimeAttacks",
+                          "test_fleet_collect_health_rows_judge_contracts",
+                          "test_censor_suppresses_victim_messages"),
     "test_sim_engine.py": ("test_negative_score_peer_gets_pruned",
                            "TestBackoff",
                            "TestNbrSubscribedCache",

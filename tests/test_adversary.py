@@ -1,0 +1,546 @@
+"""Adversary & workload library (sim/adversary.py + sim/faults.py attack
+families, ISSUE 10).
+
+Acceptance contract: the five scenario families (eclipse / censorship /
+flash-crowd / slow-link / diurnal churn) run end-to-end at small N with
+at least one ENFORCED behavior contract each; the score-response
+contract demonstrably FAILS when scoring is disabled (positive control —
+a broken assertion cannot silently pass); the new ``FaultPlan.parse``
+keys round-trip through ``format`` and reject malformed specs by name;
+contract evaluation itself is pinned against synthetic HealthRecord row
+streams that must pass/fail each contract type; the host runtime mirrors
+the connection/link-layer families (eclipse cut set, wave schedule,
+slow-link stall) from the same plan.
+"""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import adversary, invariants, scenarios
+from go_libp2p_pubsub_tpu.sim.adversary import (
+    AttackScenario, DeliveryFloor, RecoveryCeiling, ScoreResponse,
+    contract_from_json, contract_to_json, contracts_from_schedule,
+    evaluate_contracts,
+)
+from go_libp2p_pubsub_tpu.sim.faults import (
+    CensorWindow, ChurnWave, EclipseWindow, FaultPlan, HostFaultInjector,
+    OutageWindow, PartitionWindow, SlowLinkClass, StormWindow,
+    attack_end_tick, attack_schedule, censor_peers_host,
+    eclipse_targets_host, wave_peers_host, wave_windows,
+)
+
+pytestmark = pytest.mark.adversarial
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan parse / format round-trip (satellite 1)
+
+
+class TestPlanParseFormat:
+    FULL = FaultPlan(
+        link_drop_prob=0.05, link_dup_prob=0.01, corrupt_prob=0.1,
+        partitions=(PartitionWindow(10, 30, components=2),),
+        outages=(OutageWindow(5, 15, fraction=0.2),),
+        eclipses=(EclipseWindow(5, 15, fraction=0.1),),
+        censorships=(CensorWindow(5, 15, fraction=0.2, victim=3),),
+        storms=(StormWindow(5, 15, hot=8, skew=0.9, topic=1),),
+        slowlinks=(SlowLinkClass(0.3, period=4, drop=0.05),),
+        waves=(ChurnWave(period=20, duty=5, until=60, fraction=0.25,
+                         phase=2),),
+        seed=7)
+
+    def test_full_roundtrip(self):
+        spec = self.FULL.format()
+        assert FaultPlan.parse(spec) == self.FULL
+        # and the canonical form is a fixed point
+        assert FaultPlan.parse(spec).format() == spec
+
+    def test_each_new_key_parses(self):
+        plan = FaultPlan.parse(
+            "eclipse=0.1@5:15,censor=0.2x3@5:15,storm=8x0.9x1@5:15,"
+            "slowlink=0.3@4:0.05,wave=0.25@20:5:60:2")
+        assert plan.eclipses == (EclipseWindow(5, 15, fraction=0.1),)
+        assert plan.censorships == (CensorWindow(5, 15, fraction=0.2,
+                                                 victim=3),)
+        assert plan.storms == (StormWindow(5, 15, hot=8, skew=0.9,
+                                           topic=1),)
+        assert plan.slowlinks == (SlowLinkClass(0.3, period=4, drop=0.05),)
+        assert plan.waves == (ChurnWave(period=20, duty=5, until=60,
+                                        fraction=0.25, phase=2),)
+        assert plan.active()
+
+    def test_defaults_fill_in(self):
+        plan = FaultPlan.parse("censor=0.2@5:15,storm=8@5:15,slowlink=0.3@4")
+        assert plan.censorships[0].victim == 0
+        assert plan.storms[0].skew == 0.9 and plan.storms[0].topic == 0
+        assert plan.slowlinks[0].drop == 0.0
+
+    @pytest.mark.parametrize("bad, fragment", [
+        ("eclipse=0.1@30:10", "empty window"),
+        ("eclipse=1.5@5:15", "outside"),
+        ("censor=0.2x3x9@5:15", "too many"),
+        ("storm=0@5:15", "must be >= 1"),
+        ("storm=8x2.0@5:15", "outside"),
+        ("slowlink=0.3", "missing @PERIOD"),
+        ("slowlink=0.3@0", "must be >= 1"),
+        ("wave=0.25@20:5", "PERIOD:DUTY:UNTIL"),
+        ("wave=0.25@20:25:60", "duty <= period"),
+        ("eclipse=0.1", "missing @START:END"),
+    ])
+    def test_malformed_specs_raise_named(self, bad, fragment):
+        with pytest.raises(ValueError, match="malformed fault-plan item"):
+            FaultPlan.parse(bad)
+        with pytest.raises(ValueError, match=fragment):
+            FaultPlan.parse(bad)
+
+    def test_unknown_key_names_known_keys(self):
+        with pytest.raises(ValueError, match="unknown fault-plan item"):
+            FaultPlan.parse("chaos=1")
+
+    def test_wave_windows_expansion(self):
+        w = ChurnWave(period=20, duty=5, until=60, phase=2)
+        assert wave_windows(w) == [(2, 7), (22, 27), (42, 47)]
+        assert attack_end_tick(FaultPlan(waves=(w,))) == 47
+
+    def test_attack_end_tick_spans_families(self):
+        assert attack_end_tick(None) == 0
+        assert attack_end_tick(FaultPlan()) == 0
+        plan = self.FULL
+        assert attack_end_tick(plan) == 47       # last wave window end
+        # permanent slow-link classes never move the end tick
+        assert attack_end_tick(
+            FaultPlan(slowlinks=(SlowLinkClass(0.5),))) == 0
+
+    def test_attack_schedule_shapes(self):
+        sched = attack_schedule(self.FULL)
+        kinds = [w["kind"] for w in sched]
+        for k in ("partition", "outage", "eclipse", "censor", "storm",
+                  "slowlink", "wave"):
+            assert k in kinds
+        assert sum(1 for w in sched if w["kind"] == "wave") == 3
+        slow = next(w for w in sched if w["kind"] == "slowlink")
+        assert slow["end"] is None
+        assert json.loads(json.dumps(sched)) == sched     # JSON-able
+
+
+# ---------------------------------------------------------------------------
+# contract evaluation on synthetic row streams (satellite 3): each
+# contract type must PASS on a stream built to satisfy it and FAIL on a
+# stream built to violate it — a broken evaluator cannot silently pass
+
+
+def _rows(deliv, att_edges=0, att_gray=0, hon_gray=0, conn=100, t0=0):
+    return [{"tick": t0 + i, "member": -1, "delivery_frac_t0": d,
+             "attacker_edges": att_edges, "attacker_graylisted": g,
+             "honest_graylisted": hon_gray, "connected_edges": conn}
+            for i, (d, g) in enumerate(
+                zip(deliv, att_gray if isinstance(att_gray, list)
+                    else [att_gray] * len(deliv)))]
+
+
+class TestContractEvaluation:
+    def test_delivery_floor_pass_fail(self):
+        c = DeliveryFloor(floor=0.8, start=2, end=6, topic=0)
+        ok = c.evaluate(_rows([0.5, 0.5, 0.9, 0.85, 0.99, 0.81, 0.1]))
+        assert ok.status == "pass"                # dips outside [2, 6) ignored
+        bad = c.evaluate(_rows([0.9, 0.9, 0.9, 0.79, 0.9, 0.9, 0.9]))
+        assert bad.status == "fail" and "0.79" in bad.detail
+
+    def test_delivery_floor_topic_mean_modes(self):
+        rows = [{"tick": 0, "member": -1, "delivery_frac_t0": 1.0,
+                 "delivery_frac_t1": 0.5}]
+        assert DeliveryFloor(floor=0.9, topic=0).evaluate(rows).passed
+        assert not DeliveryFloor(floor=0.9, topic=1).evaluate(rows).passed
+        assert not DeliveryFloor(floor=0.9).evaluate(rows).passed  # mean .75
+
+    def test_delivery_floor_empty_census_fails_final(self):
+        c = DeliveryFloor(floor=0.5, start=10, end=20)
+        r = c.evaluate(_rows([1.0, 1.0]), final=True)
+        assert r.status == "fail" and "no rows" in r.detail
+        assert c.evaluate(_rows([1.0, 1.0]), final=False).status == "pending"
+
+    def test_recovery_ceiling_pass_fail_pending(self):
+        c = RecoveryCeiling(after=3, within=4, floor=0.95)
+        ok = c.evaluate(_rows([0.2, 0.2, 0.2, 0.3, 0.6, 0.96, 1.0, 1.0]))
+        assert ok.status == "pass" and "tick 5" in ok.detail
+        late = c.evaluate(_rows([0.2] * 8 + [0.96]))     # recovers at 8 > 3+4
+        assert late.status == "fail"
+        never = c.evaluate(_rows([0.2] * 12))
+        assert never.status == "fail" and "never" in never.detail
+        short = c.evaluate(_rows([0.2] * 5), final=False)
+        assert short.status == "pending"
+        # a FINAL stream too short to prove recovery fails by name
+        assert c.evaluate(_rows([0.2] * 5), final=True).status == "fail"
+
+    def test_score_response_pass_fail(self):
+        c = ScoreResponse(by=5, attacker_frac=0.5, honest_max_frac=0.05)
+        ok = c.evaluate(_rows([1.0] * 8, att_edges=100,
+                              att_gray=[0, 0, 10, 30, 60, 80, 80, 80]))
+        assert ok.status == "pass" and "tick 4" in ok.detail
+        slow = c.evaluate(_rows([1.0] * 8, att_edges=100,
+                                att_gray=[0] * 6 + [60, 80]))
+        assert slow.status == "fail"              # responded at 6 > by 5
+        none = c.evaluate(_rows([1.0] * 8, att_edges=100, att_gray=0))
+        assert none.status == "fail" and "responded_at=None" in none.detail
+
+    def test_score_response_honest_leg(self):
+        c = ScoreResponse(by=5, attacker_frac=0.5, honest_max_frac=0.05)
+        # attacker leg satisfied but honest collateral blows the bound
+        r = c.evaluate(_rows([1.0] * 8, att_edges=100, att_gray=80,
+                             hon_gray=50, conn=200))   # 50 > 5% of 100
+        assert r.status == "fail" and "honest" in r.detail
+        # attacker_frac=0 drops the attacker leg entirely (slow-link shape)
+        c0 = ScoreResponse(by=0, attacker_frac=0.0, honest_max_frac=0.05)
+        assert c0.evaluate(_rows([1.0] * 4)).status == "pass"
+        assert c0.evaluate(_rows([1.0] * 4, hon_gray=50,
+                                 conn=200)).status == "fail"
+
+    def test_contract_json_roundtrip(self):
+        for c in (DeliveryFloor(floor=0.8, start=2, end=6, topic=1),
+                  RecoveryCeiling(after=25, within=10, floor=0.97),
+                  ScoreResponse(by=30, attacker_frac=0.4,
+                                honest_max_frac=0.01, start=8)):
+            assert contract_from_json(
+                json.loads(json.dumps(contract_to_json(c)))) == c
+        with pytest.raises(ValueError, match="unknown contract kind"):
+            contract_from_json({"kind": "nope"})
+
+    def test_contracts_from_schedule_defaults(self):
+        sched = attack_schedule(FaultPlan(
+            eclipses=(EclipseWindow(5, 15, fraction=0.1),)))
+        cs = contracts_from_schedule(sched)
+        assert any(c.kind == "recovery_ceiling" and c.after == 15
+                   for c in cs)
+        assert any(c.kind == "score_response" for c in cs)
+
+
+# ---------------------------------------------------------------------------
+# the five families end-to-end with ENFORCED contracts (the acceptance
+# core). One jitted telemetry run each at the scenario's tuned shape.
+
+
+class TestFiveFamiliesEndToEnd:
+    @pytest.mark.parametrize("name, bit", [
+        ("eclipse_small", invariants.FAULT_ECLIPSE),
+        ("censor_small", invariants.FAULT_CENSOR),
+        ("flashcrowd_small", invariants.FAULT_STORM),
+        ("slowlink_small", invariants.FAULT_SLOWLINK),
+        ("diurnal_small", invariants.FAULT_WAVE),
+    ])
+    def test_family_contracts_hold(self, name, bit):
+        scn = adversary.ATTACKS[name]()
+        assert scn.contracts, name
+        rep = adversary.run_with_contracts(scn)
+        for r in rep.results:
+            assert r.passed, (name, r.kind, r.detail)
+        # the family's injected bit fired and nothing violated
+        assert rep.fault_flags & bit, (name, hex(rep.fault_flags))
+        assert rep.fault_flags & invariants.VIOLATION_MASK == 0, \
+            (name, invariants.decode_flags(rep.fault_flags))
+
+    def test_scenarios_registry_returns_triples(self):
+        for name in adversary.ATTACKS:
+            cfg, tp, st = scenarios.SCENARIOS[name](n_peers=96, k_slots=16,
+                                                    degree=6)
+            assert cfg.n_peers == 96
+            assert cfg.fault_plan is not None and cfg.fault_plan.active()
+
+
+class TestPositiveControl:
+    def test_score_response_fails_without_scoring(self):
+        """The library's broken-assertion guard: with scoring disabled
+        nothing is ever graylisted, so the score-response contract MUST
+        fail — if it passes, the contract (or the telemetry split it
+        reads) is vacuous."""
+        scn = adversary.censorship(n_peers=256)
+        off = dataclasses.replace(scn.cfg, scoring_enabled=False)
+        rep = adversary.run_with_contracts(AttackScenario(
+            off, scn.tp, scn.state, scn.contracts, scn.n_ticks, scn.name))
+        sr = [r for r in rep.results if r.kind == "score_response"]
+        assert sr and sr[0].status == "fail", sr
+
+
+# ---------------------------------------------------------------------------
+# host-half parity for the connection/link-layer families
+
+
+class TestHostRuntimeAttacks:
+    def _swarm(self, n):
+        from go_libp2p_pubsub_tpu.api import LAX_NO_SIGN, PubSub
+        from go_libp2p_pubsub_tpu.net import Network
+        from go_libp2p_pubsub_tpu.routers.gossipsub import GossipSubRouter
+        net = Network()
+        nodes = [PubSub(net.add_host(), GossipSubRouter(),
+                        sign_policy=LAX_NO_SIGN) for _ in range(n)]
+        net.dense_connect([p.host for p in nodes], degree=8)
+        subs = [p.join("t").subscribe() for p in nodes]
+        return net, nodes, subs
+
+    def test_host_eclipse_cuts_target_honest_edges(self):
+        net, nodes, subs = self._swarm(20)
+        mal = [False] * 16 + [True] * 4          # rows 16..19 are sybils
+        plan = FaultPlan(eclipses=(EclipseWindow(2, 8, fraction=0.2),))
+        HostFaultInjector(net, [p.host for p in nodes], plan, malicious=mal)
+        tgt = eclipse_targets_host(20, 0, plan, malicious=mal)
+        assert tgt[:4] == [True] * 4 and not any(tgt[4:])
+        net.scheduler.run_for(3.0)               # inside the window
+        for i in (0, 1, 2, 3):                   # targets keep NO honest
+            for pid in nodes[i].host.conns:      # non-target connections
+                j = next(k for k, p in enumerate(nodes)
+                         if p.host.peer_id == pid)
+                assert mal[j] or tgt[j], (i, j)
+        net.scheduler.run_for(7.0)               # past the heal at t=8
+        for i in (0, 1, 2, 3):
+            js = {next(k for k, p in enumerate(nodes)
+                       if p.host.peer_id == pid)
+                  for pid in nodes[i].host.conns}
+            assert any(not mal[j] and not tgt[j] for j in js), \
+                f"target {i} never re-knit to the honest majority"
+
+    def test_host_eclipse_requires_malicious(self):
+        net, nodes, _ = self._swarm(4)
+        plan = FaultPlan(eclipses=(EclipseWindow(2, 8),))
+        with pytest.raises(ValueError, match="malicious"):
+            HostFaultInjector(net, [p.host for p in nodes], plan)
+
+    def test_host_wave_cohort_matches_batched_choice(self):
+        net, nodes, _ = self._swarm(12)
+        plan = FaultPlan(waves=(ChurnWave(period=6, duty=2, until=13,
+                                          fraction=0.3),), seed=3)
+        HostFaultInjector(net, [p.host for p in nodes], plan)
+        dark = wave_peers_host(12, 0, plan)
+        assert any(dark) and not all(dark)
+        net.scheduler.run_for(1.0)               # inside dark phase [0, 2)
+        for i, p in enumerate(nodes):
+            if dark[i]:
+                assert not p.host.conns, f"dark peer {i} kept connections"
+        net.scheduler.run_for(3.0)               # lit phase [2, 6)
+        for i, p in enumerate(nodes):
+            assert p.host.conns, f"peer {i} not back between waves"
+        net.scheduler.run_for(3.0)               # second dark phase [6, 8)
+        for i, p in enumerate(nodes):
+            if dark[i]:
+                assert not p.host.conns, \
+                    f"dark peer {i} lit during the second wave"
+        net.scheduler.run_for(7.0)               # schedule over (until=13)
+        for i, p in enumerate(nodes):
+            assert p.host.conns, f"peer {i} never came back after waves"
+
+    def test_host_slowlink_stalls_data_plane(self):
+        """A 100%-membership slow-link class with period 1000 stalls
+        (almost) every data send; control still flows, so meshes form
+        but payloads do not cross."""
+        net, nodes, subs = self._swarm(8)
+        plan = FaultPlan(slowlinks=(SlowLinkClass(1.0, period=1000),))
+        HostFaultInjector(net, [p.host for p in nodes], plan)
+        net.scheduler.run_for(3.0)
+        nodes[0].my_topics["t"].publish(b"stalled")
+        net.scheduler.run_for(2.0)
+        got = sum(1 for s in subs[1:]
+                  if any(m is not None and m.data == b"stalled"
+                         for m in iter(s.next, None)))
+        # hash phase opens ~1/1000 of edge-ticks; at 8 peers the payload
+        # must be (near-)fully stalled
+        assert got <= 1, got
+
+    def test_batched_censor_cohort_excludes_victim(self):
+        plan = FaultPlan(censorships=(CensorWindow(0, 10, fraction=0.5,
+                                                   victim=5),))
+        mask = censor_peers_host(64, 0, plan)
+        assert not mask[5]
+        assert 10 < sum(mask) < 54          # ~half, hash-chosen
+
+
+# ---------------------------------------------------------------------------
+# telemetry plumbing: split columns + header schedule + dashboard
+
+
+class TestAttackTelemetry:
+    def test_graylist_split_columns_present_and_consistent(self):
+        from go_libp2p_pubsub_tpu.sim import telemetry
+        cols = [n for n, _ in telemetry.health_columns(1)]
+        for c in ("connected_edges", "attacker_edges",
+                  "attacker_graylisted", "honest_graylisted"):
+            assert c in cols
+
+    def test_journal_header_stamps_schedule_and_contracts(self, tmp_path):
+        from go_libp2p_pubsub_tpu.sim import telemetry
+        scn = adversary.diurnal(n_peers=96)
+        path = str(tmp_path / "health.jsonl")
+        with telemetry.HealthJournal(path, prefer_native=False) as hj:
+            hj.header(scn.cfg,
+                      contracts=adversary.contracts_to_json(scn.contracts))
+        run = telemetry.read_journal(path)["runs"][0]
+        assert [w["kind"] for w in run["attack_windows"]] == ["wave"] * 3
+        assert adversary.contracts_from_json(run["contracts"]) \
+            == scn.contracts
+
+    def test_dashboard_renders_attacks_and_contracts(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "graft_dashboard", os.path.join(REPO, "scripts", "dashboard.py"))
+        dash = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(dash)
+        from go_libp2p_pubsub_tpu.sim import telemetry
+
+        path = str(tmp_path / "health.jsonl")
+        contracts = (DeliveryFloor(floor=0.9, start=0, topic=0),
+                     ScoreResponse(by=3, attacker_frac=0.5))
+        plan = FaultPlan(eclipses=(EclipseWindow(1, 6, fraction=0.1),))
+        cfg = scenarios.SCENARIOS["1k_single_topic"](n_peers=64,
+                                                     k_slots=16)[0]
+        cfg = dataclasses.replace(cfg, fault_plan=plan)
+        with telemetry.HealthJournal(path, prefer_native=False) as hj:
+            hj.header(cfg, scenario="eclipse_small",
+                      contracts=adversary.contracts_to_json(contracts))
+            hj.append_dicts([
+                {"tick": t, "member": -1, "delivery_frac_t0": 0.95,
+                 "attacker_edges": 100, "attacker_graylisted": 80 * (t >= 2),
+                 "honest_graylisted": 0, "connected_edges": 500}
+                for t in range(4)])
+        snap = dash.snapshot(path)
+        assert snap["attacks"][0]["kind"] == "eclipse"
+        assert snap["attacks"][0]["active"] is True       # tick 3 in [1, 6)
+        st = {c["kind"]: c["status"] for c in snap["contracts"]}
+        assert st == {"delivery_floor": "pass", "score_response": "pass"}
+        text = dash.render(snap)
+        assert "ATTACK eclipse [1, 6) ACTIVE" in text
+        assert "contract delivery_floor: ok" in text
+        # and a floor violation renders FAIL
+        with telemetry.HealthJournal(path, prefer_native=False) as hj:
+            hj.append_dicts([{"tick": 4, "member": -1,
+                              "delivery_frac_t0": 0.2,
+                              "attacker_edges": 100,
+                              "attacker_graylisted": 80,
+                              "honest_graylisted": 0,
+                              "connected_edges": 500}])
+        snap = dash.snapshot(path)
+        assert {c["kind"]: c["status"] for c in snap["contracts"]}[
+            "delivery_floor"] == "fail"
+        assert "contract delivery_floor: FAIL" in dash.render(snap)
+
+
+# ---------------------------------------------------------------------------
+# fleet + sweep integration: the same contracts per member
+
+
+class TestFleetContracts:
+    def test_fleet_collect_health_rows_judge_contracts(self):
+        from go_libp2p_pubsub_tpu.sim.fleet import FleetMember, fleet_run
+
+        scn = adversary.diurnal(n_peers=96, k_slots=16, degree=6)
+        members = [FleetMember(scn.cfg, scn.tp, scn.state,
+                               jax.random.PRNGKey(s), scn.n_ticks,
+                               name=f"s{s}") for s in range(2)]
+        results = fleet_run(members, collect_health=True)
+        for res in results:
+            assert res.health_rows and len(res.health_rows) == scn.n_ticks
+            ticks = [r["tick"] for r in res.health_rows]
+            assert ticks == sorted(ticks)
+            verdicts = evaluate_contracts(scn.contracts, res.health_rows)
+            assert all(v.status in ("pass", "fail") for v in verdicts)
+
+    def test_sweep_heal_tick_uses_plan_end(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "graft_sweep", os.path.join(REPO, "scripts", "sweep_scores.py"))
+        sweep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sweep)
+        for name in adversary.ATTACKS:
+            cfg = scenarios.SCENARIOS[name](n_peers=96, k_slots=16,
+                                            degree=6)[0]
+            assert sweep._heal_tick(cfg) == attack_end_tick(cfg.fault_plan)
+        # the new families all declare a nonzero end except slow links
+        cfg = scenarios.SCENARIOS["slowlink_small"](n_peers=96)[0]
+        assert sweep._heal_tick(cfg) == 0
+        cfg = scenarios.SCENARIOS["eclipse_small"](n_peers=96)[0]
+        assert sweep._heal_tick(cfg) == 25
+
+
+# ---------------------------------------------------------------------------
+# engine-level mechanics pinned (storm skew, censor starvation)
+
+
+class TestAttackMechanics:
+    def test_storm_skews_publishers_inside_window_only(self):
+        from go_libp2p_pubsub_tpu.sim.engine import choose_publishers
+        from go_libp2p_pubsub_tpu.sim import topology
+        from go_libp2p_pubsub_tpu.sim.state import init_state
+        from go_libp2p_pubsub_tpu.sim.config import SimConfig
+
+        plan = FaultPlan(storms=(StormWindow(5, 10, hot=4, skew=1.0,
+                                             topic=1),))
+        cfg = SimConfig(n_peers=64, k_slots=16, n_topics=2, msg_window=32,
+                        publishers_per_tick=8, fault_plan=plan)
+        st = init_state(cfg, topology.sparse(64, 16, degree=6, seed=7))
+        inside = st._replace(tick=jax.numpy.int32(6))
+        peers, topics = choose_publishers(inside, cfg, jax.random.PRNGKey(1))
+        assert np.asarray(peers).max() < 4            # hot set only
+        assert (np.asarray(topics) == 1).all()
+        outside = st._replace(tick=jax.numpy.int32(12))
+        peers, topics = choose_publishers(outside, cfg,
+                                          jax.random.PRNGKey(1))
+        assert np.asarray(peers).max() >= 4           # back to uniform
+
+    def test_censor_suppresses_victim_messages_from_cohort(self):
+        """With EVERY non-victim peer censoring and eager forwarding the
+        only path, the victim's publishes must reach only its direct
+        recipients' first hop... in fact nobody re-forwards, so coverage
+        stays near the victim's own mesh; without the plan the same
+        publish saturates. The differential pins the forwarding mask."""
+        from go_libp2p_pubsub_tpu.sim.config import SimConfig
+        from go_libp2p_pubsub_tpu.sim.engine import run
+        from go_libp2p_pubsub_tpu.sim.state import init_state, unpack_have
+        from go_libp2p_pubsub_tpu.sim import topology
+
+        def build(plan):
+            cfg = SimConfig(n_peers=64, k_slots=16, n_topics=1,
+                            msg_window=32, publishers_per_tick=2,
+                            prop_substeps=6, scoring_enabled=False,
+                            fault_plan=plan)
+            st = init_state(cfg, topology.sparse(64, 16, degree=6, seed=7))
+            return cfg, scenarios.default_topic_params(1), st
+
+        storm = StormWindow(0, 20, hot=1, skew=1.0, topic=0)
+        plan_c = FaultPlan(censorships=(CensorWindow(0, 20, fraction=1.0,
+                                                     victim=0),),
+                           storms=(storm,))
+        plan_f = FaultPlan(storms=(storm,))
+        covs = {}
+        for tag, plan in (("censored", plan_c), ("free", plan_f)):
+            cfg, tp, st = build(plan)
+            out = run(st, cfg, tp, jax.random.PRNGKey(0), 8)
+            mt = np.asarray(out.msg_topic)
+            alive = (int(out.tick) - np.asarray(out.msg_publish_tick)) \
+                < cfg.history_length
+            have = np.asarray(unpack_have(out, cfg.msg_window))
+            m = alive & (np.asarray(out.msg_publisher) == 0) & (mt >= 0)
+            covs[tag] = have[:, m].mean()
+        assert covs["free"] > 0.95, covs
+        assert covs["censored"] < 0.5, covs
+
+    def test_slowlink_hash_symmetric_and_host_parity(self):
+        from go_libp2p_pubsub_tpu.sim.faults import (
+            _family_salt, _slow_edge_hash_host, _slow_edge_hash_jax)
+        from go_libp2p_pubsub_tpu.sim import topology
+
+        topo = topology.sparse(64, 16, degree=6, seed=7)
+        nbrs = np.asarray(topo.neighbors)
+        salt = _family_salt(0, "slowlink", 0)
+        h = np.asarray(_slow_edge_hash_jax(jax.numpy.asarray(nbrs), salt))
+        for i in range(0, 64, 7):
+            for k in range(16):
+                j = nbrs[i, k]
+                if j < 0:
+                    continue
+                assert h[i, k] == _slow_edge_hash_host(i, int(j), salt)
+                # symmetric: the reverse direction hashes identically
+                rk = list(nbrs[j]).index(i)
+                assert h[j, rk] == h[i, k]

@@ -49,15 +49,15 @@ def test_bench_emits_driver_parseable_json():
 
 
 def test_full_suite_fits_budget_at_reduced_n():
-    """All 14 configs at reduced N must complete, rc=0, within
+    """All 16 configs at reduced N must complete, rc=0, within
     BENCH_TOTAL_BUDGET on CPU — the structural guarantee that the r5
     timeout (rc=124, headline line missing) cannot recur. Every metric
     line must be present, the 100k_default headline first AND last.
     GRAFT_FLEET_SIZE=4 keeps the batched-fleet line (ISSUE 7) at
-    contract scale; the frontier family (ISSUE 8) and the
-    tracing-overhead pair (ISSUE 9) ride the same BENCH_MAX_N cap with
-    capped-N labels — reduced runs can never bank under the full
-    labels."""
+    contract scale; the frontier family (ISSUE 8), the tracing-overhead
+    pair (ISSUE 9), and the attack pair (ISSUE 10) ride the same
+    BENCH_MAX_N cap with capped-N labels — reduced runs can never bank
+    under the full labels."""
     budget = 900
     res, metrics, _, elapsed = _run_bench({
         "BENCH_N": "256", "BENCH_MAX_N": "256", "BENCH_TICKS": "2",
@@ -66,8 +66,8 @@ def test_full_suite_fits_budget_at_reduced_n():
         timeout=budget + 120)
     assert res.returncode == 0, res.stderr[-500:]
     assert elapsed < budget, f"suite blew the budget: {elapsed:.0f}s"
-    # 14 configs + the headline re-emit
-    assert len(metrics) == 15, [m["metric"] for m in metrics]
+    # 16 configs + the headline re-emit
+    assert len(metrics) == 17, [m["metric"] for m in metrics]
     for m in metrics:
         assert m["value"] > 0, m
         # every record carries the memory accounting (ISSUE 8 satellite)
@@ -81,7 +81,8 @@ def test_full_suite_fits_budget_at_reduced_n():
                      "100k_gossipsub_sweep",
                      "frontier_250k_capped_0k", "frontier_500k_capped_0k",
                      "frontier_1m_capped_0k",
-                     "telemetry_1k_capped_0k", "telemetry_10k_capped_0k"}
+                     "telemetry_1k_capped_0k", "telemetry_10k_capped_0k",
+                     "eclipse_50k_capped_0k", "flashcrowd_50k_capped_0k"}
     fleet = next(m for m in metrics if "fleet_4x0k" in m["metric"])
     assert fleet["fleet_size"] == 4
     assert fleet["per_member_hbps"] > 0

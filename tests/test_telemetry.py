@@ -243,6 +243,8 @@ def _synthetic_records(c=4, b=None, t=2, seed=0):
         delivery_frac=rng.uniform(0, 1, shape + (t,)).astype(np.float32),
         mesh_deg_min=i32(4), mesh_deg_mean=f32(0, 12), mesh_deg_max=i32(16),
         backoff_count=i32(999), graylist_count=i32(50),
+        connected_edges=i32(4000), attacker_edges=i32(900),
+        attacker_graylisted=i32(40), honest_graylisted=i32(10),
         score_mean=f32(-7, 7) / 3.0, score_min=f32(-100, 0),
         published_window=i32(64), delivered_total=f32(0, 1e7),
         halo_overflow=i32(2), fault_flags=i32(1 << 14).astype(np.uint32))
