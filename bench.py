@@ -186,6 +186,7 @@ NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
          "100k_randomsub", "100k_gossipsub_sweep",
          "frontier_250k", "frontier_500k", "frontier_1m",
          "telemetry_1k", "telemetry_10k",
+         "supervised_overlap_1k", "supervised_overlap_10k",
          "eclipse_50k", "flashcrowd_50k", "headline"]
 # execution order puts headline FIRST (banked before anything can time
 # out — losing it cost round 5 its record, VERDICT r5 weak #2) and its
@@ -210,6 +211,9 @@ TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60,
                  # enough that the per-chunk journal write is amortized
                  # the way a real supervised stream amortizes it
                  "telemetry_1k": 120, "telemetry_10k": 20,
+                 # supervised-overlap A/B (ISSUE 12): windows long enough
+                 # for a ~5-checkpoint cadence over >=10 chunks
+                 "supervised_overlap_1k": 250, "supervised_overlap_10k": 40,
                  # attack family (ISSUE 10): windows cover the scenario's
                  # [3, 8) attack schedule so the measured ticks include
                  # cut + heal (the faults_degraded discipline)
@@ -376,12 +380,14 @@ def bench_telemetry(name: str, ticks: int, repeats: int) -> str:
 
     tmp = tempfile.mkdtemp(prefix="graft_telemetry_bench_")
 
-    def streaming(prefer_native):
-        path = os.path.join(tmp, f"health_{prefer_native}.jsonl")
+    def streaming(prefer_native, sync_every_write=True):
+        path = os.path.join(tmp,
+                            f"health_{prefer_native}_{sync_every_write}.jsonl")
         def leg(keys):
             out, health = run_keys(st, cfg, tp, keys, telemetry=True)
-            with telemetry.HealthJournal(path,
-                                         prefer_native=prefer_native) as hj:
+            with telemetry.HealthJournal(
+                    path, prefer_native=prefer_native,
+                    sync_every_write=sync_every_write) as hj:
                 hj.append_records(health, ticks=int(keys.shape[0]))
             np.asarray(out.tick)
             return hj.encoder
@@ -397,6 +403,11 @@ def bench_telemetry(name: str, ticks: int, repeats: int) -> str:
     device_hbps = measure(py_leg, ticks)
     native_hbps = measure(streaming(prefer_native=True), ticks) \
         if native_ok else None
+    # batched-fsync flavor (ISSUE 12 satellite): the async supervisor's
+    # writer journals with ONE fsync per queue drain instead of one per
+    # write — this leg prices exactly that knob on the best encoder
+    batched_hbps = measure(streaming(prefer_native=native_ok,
+                                     sync_every_write=False), ticks)
 
     # legacy comparator: per-tick host-stepped event export into the
     # NDJSON sink — the Python-JSON-sink bottleneck the device reduction
@@ -444,10 +455,150 @@ def bench_telemetry(name: str, ticks: int, repeats: int) -> str:
         if native_hbps is not None else None,
         "json_sink_hbps": round(json_hbps, 2),
         "json_sink_ticks": sink_ticks,
+        "batched_fsync_hbps": round(batched_hbps, 2),
         "device_py_overhead_pct": pct(device_hbps),
         "device_native_overhead_pct": pct(native_hbps),
         "json_sink_overhead_pct": pct(json_hbps),
+        "batched_fsync_overhead_pct": pct(batched_hbps),
         "native_codec": native_ok,
+        **_memory_record(cfg),
+    })
+    print(line, flush=True)
+    return line
+
+
+# full peer counts of the supervised-overlap pair (ISSUE 12) —
+# parent-safe like TELEMETRY_FULL_N; capped runs are labeled by what ran
+OVERLAP_FULL_N = {"supervised_overlap_1k": 1024,
+                  "supervised_overlap_10k": 10_000}
+
+
+def bench_overlap(name: str, ticks: int, repeats: int) -> str:
+    """The supervised-overlap A/B (ISSUE 12 acceptance): the SAME window
+    measured three ways — the unsupervised engine scan, the synchronous
+    supervised loop (``async_chunks=False``: checkpoint serialization and
+    journal fsync inline at every boundary, the positive control), and
+    the async pipeline (speculative chunk dispatch + off-path writer
+    thread) — with the checkpoint cadence swept. ``value`` is the async
+    pipeline's hb/s at the ~5-checkpoint cadence; the ``*_pause_ms_*``
+    fields are the per-checkpoint visible pause (the supervisor's
+    "boundary" events: what the main loop stalled at a boundary). These
+    are the numbers PERF_MODEL's "Supervised execution plane" tracks."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    from go_libp2p_pubsub_tpu.sim.engine import run_keys
+    from go_libp2p_pubsub_tpu.sim.supervisor import (SupervisorConfig,
+                                                     supervised_run)
+
+    n = _cap_peers(OVERLAP_FULL_N[name])
+    cfg, tp, st = scenarios.single_topic_1k(n_peers=n) \
+        if name == "supervised_overlap_1k" \
+        else scenarios.beacon_10k(n_peers=n)
+    key = jax.random.PRNGKey(7)
+    keys_all = jax.random.split(key, ticks)
+    np.asarray(run_keys(st, cfg, tp, keys_all).tick)    # compile + warm
+    rtt = _fetch_rtt()
+
+    def timed(fn, cleanup=None):
+        """Median hb/s over the repeat runs. ``cleanup`` runs OUTSIDE the
+        timed section between repeats: checkpoint dirs must be wiped so a
+        later repeat cannot resume mid-window and measure a shorter run."""
+        rates = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            fn()
+            raw = time.perf_counter() - t0
+            dt = max(raw - rtt, raw * 0.05)
+            rates.append(ticks / dt)
+            if cleanup is not None:
+                cleanup()
+        return statistics.median(rates)
+
+    unsup_hbps = timed(
+        lambda: np.asarray(run_keys(st, cfg, tp, keys_all).tick))
+
+    chunk = max(1, ticks // 10)
+    tmp = tempfile.mkdtemp(prefix="graft_overlap_bench_")
+
+    def leg(asynch: bool, every: int):
+        ck = os.path.join(tmp, f"ck_{int(asynch)}_{every}")
+        pauses: list = []
+
+        def run_once():
+            sup = SupervisorConfig(
+                chunk_ticks=chunk, checkpoint_every_ticks=every,
+                checkpoint_dir=ck,
+                health_path=os.path.join(tmp, "health.jsonl"),
+                async_chunks=asynch, max_retries=0, backoff_base_s=0.0)
+            out, rep = supervised_run(st, cfg, tp, key, ticks, sup)
+            np.asarray(out.tick)
+            pauses.extend(e["pause_ms"] for e in rep.events
+                          if e["event"] == "boundary")
+
+        def cleanup():
+            shutil.rmtree(ck, ignore_errors=True)
+
+        run_once()      # compile + warm the chunk executables (AOT cache)
+        cleanup()
+        pauses.clear()
+        rate = timed(run_once, cleanup)
+        return rate, pauses
+
+    def pause_stats(prefix, pauses):
+        if not pauses:
+            return {f"{prefix}_pause_ms_max": None,
+                    f"{prefix}_pause_ms_mean": None}
+        return {f"{prefix}_pause_ms_max": round(max(pauses), 3),
+                f"{prefix}_pause_ms_mean":
+                    round(sum(pauses) / len(pauses), 3)}
+
+    # cadence sweep: ~5 and ~10 checkpoints over the window, clamped to
+    # the chunk length (a boundary can only land on a chunk edge);
+    # largest interval (fewest checkpoints) first — it is the headline
+    cadences = sorted({max(chunk, ticks // 5), max(chunk, ticks // 10)},
+                      reverse=True)
+    sweep = []
+    for every in cadences:
+        sync_hbps, sync_pauses = leg(False, every)
+        async_hbps, async_pauses = leg(True, every)
+        sweep.append({
+            "checkpoint_every_ticks": every,
+            "n_checkpoints": ticks // every,
+            "sync_hbps": round(sync_hbps, 2),
+            "async_hbps": round(async_hbps, 2),
+            **pause_stats("sync", sync_pauses),
+            **pause_stats("async", async_pauses),
+        })
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    def pct(rate):
+        return round((unsup_hbps / rate - 1.0) * 100.0, 2) if rate else None
+
+    head = sweep[0]
+    platform = jax.devices()[0].platform
+    line = json.dumps({
+        "metric": f"network_heartbeats_per_sec@{_label(name)}[{platform}]",
+        "value": head["async_hbps"],
+        "unit": "heartbeats/s",
+        "platform": platform,
+        "vs_baseline": round(head["async_hbps"] / TARGET_HBPS, 4),
+        "repeats": repeats,
+        "ticks_per_window": ticks,
+        "fetch_rtt_ms": round(rtt * 1e3, 1),
+        "n_peers": cfg.n_peers,
+        "chunk_ticks": chunk,
+        "unsupervised_hbps": round(unsup_hbps, 2),
+        "sync_hbps": head["sync_hbps"],
+        "async_hbps": head["async_hbps"],
+        "sync_overhead_pct": pct(head["sync_hbps"]),
+        "async_overhead_pct": pct(head["async_hbps"]),
+        "sync_pause_ms_max": head["sync_pause_ms_max"],
+        "async_pause_ms_max": head["async_pause_ms_max"],
+        "cadence_sweep": sweep,
         **_memory_record(cfg),
     })
     print(line, flush=True)
@@ -465,6 +616,11 @@ def run_scenario(name: str) -> str | None:
         # the tracing-overhead A/B rides its own four-way measurement
         # path; the kernel-mode sweep knobs don't apply
         return bench_telemetry(name, ticks, repeats)
+
+    if name in OVERLAP_FULL_N:
+        # the supervised-overlap A/B (ISSUE 12) rides its own three-way
+        # measurement path; the kernel-mode sweep knobs don't apply
+        return bench_overlap(name, ticks, repeats)
 
     if name == "fleet_256x1k":
         # the batched-fleet line rides its own measurement path (aggregate
@@ -522,7 +678,8 @@ def run_scenario(name: str) -> str | None:
         "headline": headline,
     }
     assert set(builders) | {"fleet_256x1k", "telemetry_1k",
-                            "telemetry_10k"} == set(NAMES), \
+                            "telemetry_10k", "supervised_overlap_1k",
+                            "supervised_overlap_10k"} == set(NAMES), \
         "scenario registry drifted from NAMES"
     assert FRONTIER_FULL_N == scenarios.FRONTIER_NS, \
         "bench FRONTIER_FULL_N drifted from scenarios.FRONTIER_NS"
@@ -640,6 +797,11 @@ def _label(name: str) -> str:
     if name in ATTACK_FULL_N:
         # same capped-label discipline for the attack family
         full = ATTACK_FULL_N[name]
+        n = _cap_peers(full)
+        return name if n == full else f"{name}_capped_{n // 1000}k"
+    if name in OVERLAP_FULL_N:
+        # same capped-label discipline for the supervised-overlap pair
+        full = OVERLAP_FULL_N[name]
         n = _cap_peers(full)
         return name if n == full else f"{name}_capped_{n // 1000}k"
     return name

@@ -1,0 +1,281 @@
+"""Centralized compile plan: ONE module owns shardings, donation, and
+AOT caching for every execution plane's chunk executable (ISSUE 12,
+tentpole d — the pattern of Titanax's ``compile_step_with_plan``: a
+single place that binds step function + sharding plan + donation so no
+plane hand-rolls its own jit site).
+
+The four compile sites this replaces:
+
+- ``sim/supervisor.py`` held its own ``_AOT_CACHE`` of
+  ``run_keys.lower().compile()`` chunk executables → :func:`engine_chunk`
+  / :func:`engine_window` (the ``key_schedule="fold_in"`` flavor, whose
+  chunk length is static because no key window ships in);
+- ``sim/fleet.py`` tracked first-use compiles of the batched fleet scan
+  in its own set → :func:`fleet_chunk`;
+- ``parallel/sharding.py`` built the sharded step/chunk jits inline →
+  :func:`sharded_step_plan` / :func:`sharded_chunk_plan` (sharding.py
+  keeps thin delegating wrappers for its public factory names);
+- ``scripts/run_multihost.py`` cached sharded runners per exec-config →
+  now a dict of :func:`sharded_chunk_plan` results.
+
+Donation policy (the async pipeline's contract, sim/supervisor.py):
+every plane's chunk executable EXISTS in a donated flavor — the carried
+state aliases in place, halving peak state memory — but the caller
+decides per dispatch, because three inputs must outlive their chunk:
+the caller's own initial state, any state serving as the host-side
+retry anchor, and a checkpoint-boundary input whose output the writer
+thread still has to fetch. :func:`donated_param_count` introspects what
+a lowered/compiled executable actually promises (the donation audit,
+tests/test_compile_plan.py).
+
+The fleet plane is the exception that proves the cache: AOT-compiling
+the batched fleet scan hoists module-level jnp constants into executable
+parameters (the round-9 "compiled for 61 inputs but called with 59"
+failure), so :func:`fleet_chunk` deliberately returns the plain-jit
+entry point and only CENTRALIZES the first-use bookkeeping its compile
+deadline needs; its donation audit compiles a throwaway lowering purely
+for introspection.
+"""
+
+from __future__ import annotations
+
+import re
+from collections import deque
+from functools import partial
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..sim.config import SimConfig, TopicParams
+from ..sim.state import SimState
+
+# ---------------------------------------------------------------------------
+# plain engine plane: AOT chunk executables
+
+# keyed by (schedule, exec_cfg, chunk shape, key dtype, telemetry,
+# donate): compiling through .lower().compile() ahead of the watchdog
+# keeps compile time out of the run deadline, and re-dispatching the SAME
+# executable across chunks/retries skips the jit cache lookup entirely.
+# SimConfig is frozen/hashable, so the dict stays small (one entry per
+# ladder rung per tail-chunk shape per donation flavor).
+_ENGINE_AOT: dict = {}
+
+
+def engine_chunk(exec_cfg: SimConfig, state: SimState, tp: TopicParams,
+                 keys_chunk, *, telemetry: bool = False,
+                 donate: bool = False):
+    """AOT executable for one supervised chunk of the plain engine scan
+    (``key_schedule="host"``: explicit per-tick key rows). Call as
+    ``exe(state, tp, keys_chunk)``; ``donate=True`` consumes ``state``."""
+    from ..sim.engine import run_keys, run_keys_donated
+    cache_key = ("engine", exec_cfg, int(keys_chunk.shape[0]),
+                 str(keys_chunk.dtype), telemetry, donate)
+    exe = _ENGINE_AOT.get(cache_key)
+    if exe is None:
+        fn = run_keys_donated if donate else run_keys
+        exe = fn.lower(state, exec_cfg, tp, keys_chunk,
+                       telemetry=telemetry).compile()
+        _ENGINE_AOT[cache_key] = exe
+    return exe
+
+
+def engine_window(exec_cfg: SimConfig, state: SimState, tp: TopicParams,
+                  key, n_ticks: int, *, telemetry: bool = False,
+                  donate: bool = False):
+    """AOT executable for one supervised chunk under
+    ``key_schedule="fold_in"``: per-tick keys derive on device from the
+    master key and the carried absolute tick, so the call ships two
+    scalars' worth of key material instead of a ``[C, 2]`` window. Call
+    as ``exe(state, tp, key)``."""
+    from ..sim.engine import run_window, run_window_donated
+    cache_key = ("window", exec_cfg, int(n_ticks), str(key.dtype),
+                 telemetry, donate)
+    exe = _ENGINE_AOT.get(cache_key)
+    if exe is None:
+        fn = run_window_donated if donate else run_window
+        exe = fn.lower(state, exec_cfg, tp, key, n_ticks,
+                       telemetry=telemetry).compile()
+        _ENGINE_AOT[cache_key] = exe
+    return exe
+
+
+# ---------------------------------------------------------------------------
+# fleet plane: plain-jit dispatch with centralized first-use bookkeeping
+
+_FLEET_SEEN: set = set()
+
+
+def fleet_chunk(exec_cfg: SimConfig, keys_shape=None, key_dtype=None, *,
+                telemetry: bool = False, mark: bool = True):
+    """The batched fleet window entry point + whether this (config,
+    [C, B] window shape, key dtype, lane) is a first use (the fleet
+    driver runs first uses under its compile deadline instead of the run
+    deadline — compile time is not execution time). ``mark=False`` only
+    queries: the async fleet driver marks a shape compiled on CONFIRM,
+    not dispatch, so a window that dies mid-compile retries under the
+    compile deadline again. Plain jit on purpose — see the module
+    docstring's const-hoisting rationale."""
+    from ..sim.fleet import fleet_run_keys
+    seen_key = ("fleet", exec_cfg, tuple(keys_shape or ()), str(key_dtype),
+                telemetry)
+    first_use = seen_key not in _FLEET_SEEN
+    if mark:
+        _FLEET_SEEN.add(seen_key)
+    return fleet_run_keys, first_use
+
+
+# ---------------------------------------------------------------------------
+# sharded plane: the jit factories (moved here from parallel/sharding.py,
+# which keeps its public make_sharded_* names as delegating wrappers)
+
+# stale-id protection, both directions: the dispatch cache keys on
+# function identity, and a garbage-collected closure's id() can be REUSED
+# by the next factory call, hitting a stale executable.
+# (a) each factory pins its jit to the returned wrapper — a
+#     STILL-REFERENCED step can never be evicted out from under its
+#     caller (the old deque's 65th-call hazard);
+# (b) the bounded deque ALSO retains the last 64 steps so a
+#     drop-and-recreate config sweep (wrapper rebound each iteration)
+#     cannot recycle a dead closure's id into a live cache entry.
+_LIVE_STEPS: deque = deque(maxlen=64)
+
+
+def _sharded_prelude(mesh, cfg: SimConfig, tp: TopicParams):
+    from .sharding import DCN_AXIS, PEER_AXIS, state_shardings
+    if cfg.sharded_route not in ("replicated", "halo"):
+        raise ValueError(f"unknown sharded_route {cfg.sharded_route!r}; "
+                         "expected 'replicated' or 'halo'")
+    shardings = state_shardings(mesh, cfg)
+    repl = NamedSharding(mesh, P())
+    tp_sh = jax.tree.map(lambda _: repl, tp)
+    peer_axes = tuple(ax for ax in (DCN_AXIS, PEER_AXIS)
+                      if ax in mesh.axis_names)
+    return shardings, repl, tp_sh, peer_axes
+
+
+def sharded_step_plan(mesh, cfg: SimConfig, tp: TopicParams):
+    """jit the full network step with explicit peer-sharded in/out state.
+
+    Entering :func:`kernel_context.kernel_mesh` while the step traces
+    makes the Pallas kernel dispatch sites (ops/permgather, ops/hopkernel)
+    wrap themselves in shard_map — without it the SPMD partitioner could
+    only replicate the pallas_calls (full-size kernel on every device).
+    The XLA-formulation paths ignore the context and auto-partition."""
+    from ..sim.engine import step
+    from .kernel_context import kernel_mesh
+
+    shardings, repl, tp_sh, peer_axes = _sharded_prelude(mesh, cfg, tp)
+
+    # tp is passed as a traced ARGUMENT, not closed over: closure arrays
+    # become hoisted constants, and round 4 hit a jit AOT/dispatch
+    # disagreement about them ("compiled for 60 inputs but called with
+    # 41" whenever a .lower().compile() of the program preceded a regular
+    # dispatch anywhere in the process). With no captured arrays the
+    # lowered parameter list equals the explicit arguments and both
+    # execution paths agree.
+    @partial(jax.jit,
+             in_shardings=(shardings, tp_sh, repl), out_shardings=shardings)
+    def _step(state: SimState, tp_arg: TopicParams,
+              key: jax.Array) -> SimState:
+        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
+                         capacity_factor=cfg.halo_capacity_factor):
+            return step(state, cfg, tp_arg, key)
+
+    def sharded_step(state: SimState, key: jax.Array) -> SimState:
+        # commit the key before dispatch: the jit fast path was observed
+        # re-sharding an uncommitted PRNG key with a STATE leaf's spec
+        return _step(state, tp, jax.device_put(key, repl))
+
+    sharded_step._step = _step
+    _LIVE_STEPS.append(_step)
+    sharded_step.lower = lambda st, k: _step.lower(
+        st, tp, jax.device_put(k, repl))
+    return sharded_step
+
+
+def sharded_chunk_plan(mesh, cfg: SimConfig, tp: TopicParams,
+                       telemetry: bool = False, donate: bool = False):
+    """jit a whole chunk — ``lax.scan`` of the sharded step over explicit
+    per-tick keys — with the peer-sharded in/out state, the multi-host
+    execution unit (parallel/multihost.py drives supervised chunks
+    through this instead of ``engine.run_keys``, whose unsharded trace
+    would lower the halo routes away). Same key discipline as
+    ``engine.run_keys``: the caller pre-splits one master key and scans
+    contiguous windows, so the chunked sharded trajectory is
+    bit-identical to the single-scan unsharded one.
+
+    ``telemetry=True`` stacks per-tick ``HealthRecord`` aggregates whose
+    reductions the SPMD partitioner lowers over the same peer sharding
+    as the step, emitted REPLICATED — every rank holds the full ``[C]``
+    record buffer, so rank 0 can journal without any extra gather; the
+    runner then returns ``(state, HealthRecord)``. ``donate=True``
+    aliases the carried state in place (the multihost driver keeps the
+    default False: boundary gathers and rank-local retries need the
+    input alive)."""
+    from ..sim.engine import step
+    from ..sim.telemetry import health_record
+    from .kernel_context import kernel_mesh
+
+    shardings, repl, tp_sh, peer_axes = _sharded_prelude(mesh, cfg, tp)
+    # health aggregates replicate (repl is a pytree PREFIX spec for the
+    # whole HealthRecord subtree)
+    out_sh = (shardings, repl) if telemetry else shardings
+
+    # tp rides as a traced argument, not a closure, for the same AOT/
+    # dispatch-agreement reason documented on sharded_step_plan
+    @partial(jax.jit,
+             in_shardings=(shardings, tp_sh, repl), out_shardings=out_sh,
+             donate_argnums=(0,) if donate else ())
+    def _run(state: SimState, tp_arg: TopicParams, keys: jax.Array):
+        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
+                         capacity_factor=cfg.halo_capacity_factor):
+            def body(carry, k):
+                nxt = step(carry, cfg, tp_arg, k)
+                return nxt, health_record(nxt, cfg, tp_arg) \
+                    if telemetry else None
+            out, health = jax.lax.scan(body, state, keys)
+        return (out, health) if telemetry else out
+
+    def sharded_run_keys(state: SimState, keys: jax.Array,
+                         tp_arg: TopicParams | None = None):
+        # tp is a traced argument of the compiled scan, so a caller may
+        # swap it per call (the supervisor run_fn hook hands one) without
+        # invalidating the executable; default is the build-time tp
+        return _run(state, tp if tp_arg is None else tp_arg,
+                    jax.device_put(keys, repl))
+
+    sharded_run_keys._run = _run
+    _LIVE_STEPS.append(_run)
+    sharded_run_keys.lower = lambda st, keys: _run.lower(
+        st, tp, jax.device_put(keys, repl))
+    return sharded_run_keys
+
+
+# ---------------------------------------------------------------------------
+# donation audit: introspect what an executable actually promises
+
+# compiled HLO: `input_output_alias={ {0}: (0, {}, may-alias), ... }` —
+# the first tuple element is the donated PARAMETER number
+_ALIAS_RE = re.compile(
+    r"\(\s*(\d+)\s*,\s*\{[^}]*\}\s*,\s*(?:may|must)-alias\s*\)")
+
+
+def donated_param_count(obj) -> int:
+    """How many input buffers a lowered/compiled executable donates,
+    parsed from its text form. Accepts either a ``jax.stages.Lowered``
+    (StableHLO: one ``tf.aliasing_output`` arg attribute per donated
+    input) or a ``jax.stages.Compiled`` (HLO: the ``input_output_alias``
+    table). 0 means the executable donates nothing — the audit's
+    negative control."""
+    txt = obj.as_text()
+    n = len(re.findall(r"tf\.aliasing_output", txt))
+    if n:
+        return n
+    return len(set(_ALIAS_RE.findall(txt)))
+
+
+def clear_caches() -> None:
+    """Drop the AOT cache and fleet first-use marks (tests that need a
+    cold plan)."""
+    _ENGINE_AOT.clear()
+    _FLEET_SEEN.clear()

@@ -17,8 +17,6 @@ shard_map themselves (tables replicated, receiver rows local).
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -97,125 +95,20 @@ def shard_state(state: SimState, mesh: Mesh, cfg: SimConfig) -> SimState:
 def make_sharded_step(mesh: Mesh, cfg: SimConfig, tp: TopicParams):
     """jit the full network step with explicit peer-sharded in/out state.
 
-    Entering :func:`kernel_context.kernel_mesh` while the step traces makes
-    the Pallas kernel dispatch sites (ops/permgather, ops/hopkernel) wrap
-    themselves in shard_map — without it the SPMD partitioner could only
-    replicate the pallas_calls (full-size kernel on every device). The
-    XLA-formulation paths ignore the context and auto-partition as before.
-    """
-    from ..sim.engine import step
-    from .kernel_context import kernel_mesh
-
-    if cfg.sharded_route not in ("replicated", "halo"):
-        raise ValueError(f"unknown sharded_route {cfg.sharded_route!r}; "
-                         "expected 'replicated' or 'halo'")
-    shardings = state_shardings(mesh, cfg)
-    key_sh = NamedSharding(mesh, P())
-    repl = NamedSharding(mesh, P())
-    tp_sh = jax.tree.map(lambda _: repl, tp)
-    peer_axes = tuple(ax for ax in (DCN_AXIS, PEER_AXIS)
-                      if ax in mesh.axis_names)
-
-    # tp is passed as a traced ARGUMENT, not closed over: closure arrays
-    # become hoisted constants, and round 4 hit a jit AOT/dispatch
-    # disagreement about them ("compiled for 60 inputs but called with
-    # 41" whenever a .lower().compile() of the program preceded a regular
-    # dispatch anywhere in the process). With no captured arrays the
-    # lowered parameter list equals the explicit arguments and both
-    # execution paths agree.
-    @partial(jax.jit,
-             in_shardings=(shardings, tp_sh, key_sh), out_shardings=shardings)
-    def _step(state: SimState, tp_arg: TopicParams,
-              key: jax.Array) -> SimState:
-        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
-                         capacity_factor=cfg.halo_capacity_factor):
-            return step(state, cfg, tp_arg, key)
-
-    def sharded_step(state: SimState, key: jax.Array) -> SimState:
-        # commit the key before dispatch: the jit fast path was observed
-        # re-sharding an uncommitted PRNG key with a STATE leaf's spec
-        return _step(state, tp, jax.device_put(key, key_sh))
-
-    # stale-id protection, both directions: the dispatch cache keys on
-    # function identity, and a garbage-collected closure's id() can be
-    # REUSED by the next factory call, hitting a stale executable.
-    # (a) pin _step to the returned wrapper — a STILL-REFERENCED step can
-    #     never be evicted out from under its caller (the old deque's
-    #     65th-call hazard, round-4 advisor finding);
-    # (b) the bounded deque ALSO retains the last 64 steps so a
-    #     drop-and-recreate config sweep (wrapper rebound each iteration)
-    #     cannot recycle a dead closure's id into a live cache entry.
-    sharded_step._step = _step
-    _LIVE_STEPS.append(_step)
-    sharded_step.lower = lambda st, k: _step.lower(
-        st, tp, jax.device_put(k, key_sh))
-    return sharded_step
+    Delegates to :func:`parallel.compile_plan.sharded_step_plan` — the
+    centralized compile plan owns every plane's shardings/donation/AOT
+    caching (ISSUE 12); this name survives as the public factory."""
+    from .compile_plan import sharded_step_plan
+    return sharded_step_plan(mesh, cfg, tp)
 
 
 def make_sharded_run_keys(mesh: Mesh, cfg: SimConfig, tp: TopicParams,
                           telemetry: bool = False):
     """jit a whole chunk — ``lax.scan`` of the sharded step over explicit
     per-tick keys — with the peer-sharded in/out state, the multi-host
-    execution unit (parallel/multihost.py drives supervised chunks through
-    this instead of ``engine.run_keys``, whose unsharded trace would lower
-    the halo routes away). Same key discipline as ``engine.run_keys``:
-    the caller pre-splits one master key and scans contiguous windows, so
-    the chunked sharded trajectory is bit-identical to the single-scan
-    unsharded one (tests/test_sharding.py, tests/test_multihost.py).
-
-    ``telemetry=True`` is the sharded flavor of the streaming-telemetry
-    lane (sim/telemetry.py): the scan stacks per-tick ``HealthRecord``
-    aggregates whose reductions the SPMD partitioner lowers over the
-    same peer sharding as the step (cross-shard sums become the scan's
-    collectives), emitted REPLICATED — every rank holds the full ``[C]``
-    record buffer, so rank 0 can journal without any extra gather. The
-    runner then returns ``(state, HealthRecord)``."""
-    from ..sim.engine import step
-    from ..sim.telemetry import health_record
-    from .kernel_context import kernel_mesh
-
-    if cfg.sharded_route not in ("replicated", "halo"):
-        raise ValueError(f"unknown sharded_route {cfg.sharded_route!r}; "
-                         "expected 'replicated' or 'halo'")
-    shardings = state_shardings(mesh, cfg)
-    repl = NamedSharding(mesh, P())         # keys and tp both replicate
-    tp_sh = jax.tree.map(lambda _: repl, tp)
-    peer_axes = tuple(ax for ax in (DCN_AXIS, PEER_AXIS)
-                      if ax in mesh.axis_names)
-    # health aggregates replicate (repl is a pytree PREFIX spec for the
-    # whole HealthRecord subtree)
-    out_sh = (shardings, repl) if telemetry else shardings
-
-    # tp rides as a traced argument, not a closure, for the same AOT/
-    # dispatch-agreement reason documented on make_sharded_step
-    @partial(jax.jit,
-             in_shardings=(shardings, tp_sh, repl), out_shardings=out_sh)
-    def _run(state: SimState, tp_arg: TopicParams, keys: jax.Array):
-        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
-                         capacity_factor=cfg.halo_capacity_factor):
-            def body(carry, k):
-                nxt = step(carry, cfg, tp_arg, k)
-                return nxt, health_record(nxt, cfg, tp_arg) \
-                    if telemetry else None
-            out, health = jax.lax.scan(body, state, keys)
-        return (out, health) if telemetry else out
-
-    def sharded_run_keys(state: SimState, keys: jax.Array,
-                         tp_arg: TopicParams | None = None):
-        # tp is a traced argument of the compiled scan, so a caller may
-        # swap it per call (the supervisor run_fn hook hands one) without
-        # invalidating the executable; default is the build-time tp
-        return _run(state, tp if tp_arg is None else tp_arg,
-                    jax.device_put(keys, repl))
-
-    # same stale-id protection as make_sharded_step
-    sharded_run_keys._run = _run
-    _LIVE_STEPS.append(_run)
-    sharded_run_keys.lower = lambda st, keys: _run.lower(
-        st, tp, jax.device_put(keys, repl))
-    return sharded_run_keys
-
-
-from collections import deque                                  # noqa: E402
-
-_LIVE_STEPS: deque = deque(maxlen=64)
+    execution unit. Delegates to
+    :func:`parallel.compile_plan.sharded_chunk_plan` (see there for the
+    telemetry lane and donation flavor); this name survives as the
+    public factory."""
+    from .compile_plan import sharded_chunk_plan
+    return sharded_chunk_plan(mesh, cfg, tp, telemetry=telemetry)

@@ -204,6 +204,18 @@ class SimConfig:
     # (callers must use engine.run_checked); "off" skips checks and flag
     # writes entirely
     invariant_mode: str = "record"
+    # per-tick key derivation schedule (ISSUE 12): "host" pre-splits ONE
+    # master key into [n_ticks] per-tick keys on the host and ships the
+    # window in (the historical discipline — kept as default because
+    # fold_in provably CANNOT reproduce the split tree's streams);
+    # "fold_in" derives each tick's key inside the scan as
+    # jax.random.fold_in(master, state.tick) — no host pre-split, no
+    # shipped [C, 2] key window, and chunking-invariance/resume-
+    # consistency by construction (the key depends only on the master
+    # and the ABSOLUTE tick the state carries). Parity is pinned PER
+    # schedule (tests/test_overlap.py); the schedules' trajectories
+    # intentionally differ from each other.
+    key_schedule: str = "host"
 
     @staticmethod
     def from_params(n_peers: int, k_slots: int, n_topics: int = 1,

@@ -213,10 +213,51 @@ def _run_keys_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
     return (state, health) if telemetry else state
 
 
+def _run_window_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
+                     key: jax.Array, n_ticks: int, telemetry: bool = False):
+    """The ``key_schedule="fold_in"`` scan core: each tick's key is
+    derived INSIDE the scan as ``jax.random.fold_in(master, state.tick)``
+    — no host pre-split, no shipped ``[C, 2]`` key window (at 1M peers
+    the window was real HBM and real PCIe). Because the per-tick key is a
+    function of the master and the ABSOLUTE tick the carry holds, any
+    chunking of a window — and any resume from a checkpointed tick — lands
+    on the bit-identical trajectory by construction, with no key array to
+    keep aligned."""
+    from .telemetry import health_record
+
+    def body(carry, _):
+        k = jax.random.fold_in(key, carry.tick)
+        nxt = step(carry, cfg, tp, k)
+        return nxt, health_record(nxt, cfg, tp) if telemetry else None
+
+    state, health = jax.lax.scan(body, state, None, length=n_ticks)
+    return (state, health) if telemetry else state
+
+
 def _run_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
               key: jax.Array, n_ticks: int) -> SimState:
     """Advance the whole network ``n_ticks`` heartbeats on device."""
+    if cfg.key_schedule == "fold_in":
+        return _run_window_impl(state, cfg, tp, key, n_ticks)
+    if cfg.key_schedule != "host":
+        raise ValueError(f"unknown key_schedule {cfg.key_schedule!r}; "
+                         "expected 'host' or 'fold_in'")
     return _run_keys_impl(state, cfg, tp, jax.random.split(key, n_ticks))
+
+
+def window_keys(cfg: SimConfig, key: jax.Array, start_tick: int,
+                lo: int, hi: int, n_ticks: int) -> jax.Array:
+    """The per-tick keys a run of ``n_ticks`` from ``start_tick`` consumes
+    for its run-relative window ``[lo, hi)`` — the schedule-aware form the
+    supervisor's crash dumps and traced/checkified chunk paths use.
+    Under "host" this is a contiguous slice of the ONE master pre-split
+    (``run``'s exact discipline); under "fold_in" the keys are folds of
+    the ABSOLUTE tick numbers, materialized here only because the caller
+    needs them on host (crash.json) or as explicit scan rows."""
+    if cfg.key_schedule == "fold_in":
+        ticks = jnp.arange(start_tick + lo, start_tick + hi)
+        return jax.vmap(lambda t: jax.random.fold_in(key, t))(ticks)
+    return jax.random.split(key, n_ticks)[lo:hi]
 
 
 run = jax.jit(_run_impl, static_argnames=("cfg", "n_ticks"))
@@ -230,6 +271,20 @@ run_donated = jax.jit(_run_impl, static_argnames=("cfg", "n_ticks"),
 # telemetry is a static lane flag: the default program is byte-identical
 # to the historical one, telemetry=True returns (state, HealthRecord)
 run_keys = jax.jit(_run_keys_impl, static_argnames=("cfg", "telemetry"))
+# donated flavor: the async supervisor pipeline owns its carry chain and
+# donates chunk inputs it will never reuse (parallel/compile_plan.py
+# decides which chunks those are; anchors and boundary states stay
+# undonated so retries and off-path checkpoint fetches keep a live input)
+run_keys_donated = jax.jit(_run_keys_impl,
+                           static_argnames=("cfg", "telemetry"),
+                           donate_argnums=(0,))
+# the fold_in chunk unit: per-tick keys derive on device, so the chunk
+# length is a STATIC argument instead of a key-array shape dimension
+run_window = jax.jit(_run_window_impl,
+                     static_argnames=("cfg", "n_ticks", "telemetry"))
+run_window_donated = jax.jit(_run_window_impl,
+                             static_argnames=("cfg", "n_ticks", "telemetry"),
+                             donate_argnums=(0,))
 
 step_jit = jax.jit(step, static_argnames=("cfg",))
 

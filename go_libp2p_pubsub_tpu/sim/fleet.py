@@ -59,11 +59,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import checkpoint
+from ..parallel import compile_plan
 from .config import SimConfig, TopicParams
 from .state import SimState
-from .supervisor import (SupervisorConfig, SupervisorCrash, SupervisorReport,
-                         _degrade, _key_data, _prune_checkpoints,
-                         _with_deadline, list_checkpoints)
+from .supervisor import (_CONFIRM_GRACE_S, SupervisorConfig, SupervisorCrash,
+                         SupervisorReport, _degrade, _key_data,
+                         _prune_checkpoints, _with_deadline, _Writer,
+                         list_checkpoints)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -214,46 +216,64 @@ def _exec_cfg(cfg: SimConfig) -> SimConfig:
     return cfg
 
 
-# window shapes already compiled, keyed by (cfg, C, B, key dtype): a
-# first-use (compiling) window runs under the COMPILE deadline, repeats
-# under the run watchdog. NOT the supervisor's .lower().compile() AOT
-# cache: on this jax, a second fresh trace of the batched scan hoists the
-# module-level scalar constants (state.NEVER, selection.NEG_INF) into
-# executable parameters that Compiled.__call__ then fails to thread
-# ("compiled for 61 inputs but called with 59") — the plain jit call
-# manages its consts consistently, at the cost of one cache lookup per
-# window
-_FLEET_COMPILED: set = set()
+# The fleet window runs split into a DISPATCH phase (hook + enqueue the
+# batched scan — returns futures) and a CONFIRM phase (block on the
+# window's tick, deadline re-anchored to time already spent in flight),
+# the fleet flavor of the supervisor's latency-hiding pipeline: while
+# window k runs on device, the driver builds and dispatches window k+1
+# and the writer thread drains window k-1's journal/checkpoint I/O.
+# First-use bookkeeping (which shapes compiled, and hence which deadline
+# applies) lives in parallel/compile_plan.fleet_chunk — plain-jit on
+# purpose, see the const-hoisting rationale there.
 
 
-def _run_window(states, exec_cfg, tps, keys_win, sup, hook, info,
-                telemetry: bool = False):
-    """One window attempt under the supervisor's deadlines. Returns
-    ``(states, HealthRecord | None)`` — records when the telemetry lane
-    is on (``sup.health_path``)."""
-    cache_key = (exec_cfg, int(keys_win.shape[0]), int(keys_win.shape[1]),
-                 str(keys_win.dtype), telemetry)
-    first_use = cache_key not in _FLEET_COMPILED
+def _dispatch_window(w, exec_cfg, sup, hook, telemetry: bool = False):
+    """Enqueue one window attempt; returns a pending dict whose ``out``/
+    ``health`` leaves are device futures. Only the hook + dispatch run
+    under the deadline here — the device-time budget is enforced by
+    :func:`_confirm_window`."""
+    run_fn, first_use = compile_plan.fleet_chunk(
+        exec_cfg, w["keys"].shape, w["keys"].dtype, telemetry=telemetry,
+        mark=False)
 
     def worker():
         if hook is not None:            # test/smoke fault-injection point
-            hook(info)
-        res = fleet_run_keys(states, exec_cfg, tps, keys_win,
-                             telemetry=telemetry)
-        out, health = res if telemetry else (res, None)
-        np.asarray(out.tick)            # real sync by value fetch
-        return out, health
+            hook(w["info"])
+        res = run_fn(w["sub"], exec_cfg, w["sub_tps"], w["keys"],
+                     telemetry=telemetry)
+        return res if telemetry else (res, None)
 
     # a first-use window compiles AND runs: bound it by the compile
     # deadline (unbounded by default — compile time is not execution
     # time, sim/supervisor.py rationale), steady-state windows by the
     # run watchdog
     deadline = sup.compile_deadline_s if first_use else sup.deadline_s
-    out = _with_deadline(worker, deadline,
-                         "fleet compile+window" if first_use
-                         else "fleet window", info)
-    _FLEET_COMPILED.add(cache_key)
-    return out
+    out, health = _with_deadline(worker, deadline,
+                                 "fleet compile+window" if first_use
+                                 else "fleet window", w["info"])
+    return {"w": w, "out": out, "health": health, "cfg": exec_cfg,
+            "telemetry": telemetry, "first_use": first_use,
+            "at": time.monotonic()}
+
+
+def _confirm_window(pend, sup) -> None:
+    """Block until the pending window's device result lands, under the
+    remainder of its deadline (total budget minus time already in flight
+    since dispatch, floored at the grace period so a window that ran
+    while the driver was busy elsewhere is not spuriously killed)."""
+    budget = sup.compile_deadline_s if pend["first_use"] else sup.deadline_s
+    deadline = None
+    if budget is not None:
+        deadline = max(_CONFIRM_GRACE_S,
+                       budget - (time.monotonic() - pend["at"]))
+    _with_deadline(lambda: np.asarray(pend["out"].tick), deadline,
+                   "fleet compile+window" if pend["first_use"]
+                   else "fleet window", pend["w"]["info"])
+    # mark the shape compiled only now: a window that died mid-compile
+    # keeps its compile-deadline budget on retry
+    compile_plan.fleet_chunk(pend["cfg"], pend["w"]["keys"].shape,
+                             pend["w"]["keys"].dtype,
+                             telemetry=pend["telemetry"])
 
 
 # ---------------------------------------------------------------------------
@@ -355,7 +375,7 @@ def _write_fleet_crash_dump(sup, group_cfg, full, keys_win, gi, active,
 
 
 def _drive_group(gi, idxs, members, sup, report, dumps, hook,
-                 journal=None, collect_health=False) -> dict:
+                 journal=None, collect_health=False, writer=None) -> dict:
     """Run one config group to completion; {input_index: FleetResult}."""
     from .invariants import VIOLATION_MASK, decode_flags
 
@@ -394,85 +414,119 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook,
     next_ckpt = done + every
     failures = 0
     prev_active = b
+    if writer is None:                  # direct callers outside _drive
+        writer = _Writer(maxsize=sup.writer_queue,
+                         flush=journal.sync if journal is not None else None,
+                         threaded=False)
+    # Speculating window k+1 needs its active set/length to be a pure
+    # function of (done, tripped) BEFORE window k confirms — but escalate
+    # lanes retire on confirmed violation flags, so a group holding any
+    # raise-mode member runs the degenerate (sync) pipeline instead.
+    pipelined = bool(sup.async_chunks) and not any(escalate)
+    telemetry = journal is not None or collect_health
     # collect_health: per-member telemetry row accumulation (input-index
     # keyed — compaction changes lane positions, never ids). A RESUMED
     # run's pre-restore ticks are not re-collected; contract evaluation
     # over a resumed fleet should read the journal instead.
     health_rows: dict = {int(i): [] for i in idxs} if collect_health else {}
-    while True:
-        active = [j for j in range(b)
-                  if not tripped[j] and done < n_ticks[j]]
-        if not active:
-            break
-        if len(active) < prev_active:
-            report.log("compact", group=gi, active=len(active),
+    def build_window(state_now, done_now):
+        """The next window spec from a state pytree — which may still be
+        an in-flight device future: compaction slicing (`_take_rows`) and
+        key stacking compose asynchronously, so speculation builds window
+        k+1's inputs from window k's unconfirmed output for free."""
+        act = [j for j in range(b)
+               if not tripped[j] and done_now < n_ticks[j]]
+        if not act:
+            return None
+        tw = min(chunk_ticks, min(n_ticks[j] - done_now for j in act))
+        whole = len(act) == b
+        idx = None if whole else jnp.asarray(act, jnp.int32)
+        return {
+            "active": act, "this_win": tw, "whole": whole, "idx": idx,
+            "done": done_now,
+            "sub": state_now if whole else _take_rows(state_now, idx),
+            "sub_tps": tps if whole else _take_rows(tps, idx),
+            "keys": jnp.stack([all_keys[j][done_now:done_now + tw]
+                               for j in act], axis=1),
+            "info": {"group": gi, "window_start": done_now,
+                     "window_ticks": tw, "b_active": len(act),
+                     "attempt": failures,
+                     "degrade_level": report.degrade_level},
+        }
+
+    def note_compact(w):
+        nonlocal prev_active
+        if len(w["active"]) < prev_active:
+            report.log("compact", group=gi, active=len(w["active"]),
                        retired=[names[j] for j in range(b)
-                                if j not in active])
-        prev_active = len(active)
-        this_win = min(chunk_ticks, min(n_ticks[j] - done for j in active))
-        whole = len(active) == b
-        idx = None if whole else jnp.asarray(active, jnp.int32)
-        sub = full if whole else _take_rows(full, idx)
-        sub_tps = tps if whole else _take_rows(tps, idx)
-        keys_win = jnp.stack([all_keys[j][done:done + this_win]
-                              for j in active], axis=1)
-        info = {"group": gi, "window_start": done, "window_ticks": this_win,
-                "b_active": len(active), "attempt": failures,
-                "degrade_level": report.degrade_level}
-        try:
-            out, health = _run_window(sub, exec_cfg, sub_tps, keys_win, sup,
-                                      hook, info,
-                                      telemetry=journal is not None
-                                      or collect_health)
-        except Exception as e:
-            if not dumps:
-                raise       # plain fleet_run: no retry net, no dumps
-            failures += 1
-            if failures > sup.max_retries:
-                dump = _write_fleet_crash_dump(
-                    sup, group_cfg, full, keys_win, gi, active, names,
-                    idxs, done, this_win, e, report)
-                report.crash_dump = dump
-                if journal is not None:
-                    journal.note("crash", group=gi, dump=dump,
-                                 error=str(e)[:200])
-                raise SupervisorCrash(
-                    f"fleet group {gi} gave up at window start {done} "
-                    f"({failures} consecutive failure(s)); crash dump: "
-                    f"{dump}", dump_dir=dump, report=report) from e
-            report.retries += 1
-            report.log("chunk_failed", error=str(e)[:200], **info)
-            exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup,
-                                             report)
-            delay = min(sup.backoff_cap_s, sup.backoff_base_s
-                        * sup.backoff_factor ** (failures - 1))
-            report.log("backoff", delay_s=round(delay, 3))
-            sup.sleep(delay)
-            continue
+                                if j not in w["active"]])
+        prev_active = len(w["active"])
+
+    def handle_failure(e, w):
+        nonlocal exec_cfg, chunk_ticks, failures
+        if not dumps:
+            raise e     # plain fleet_run: no retry net, no dumps
+        failures += 1
+        if failures > sup.max_retries:
+            # durability first: land every queued journal row/checkpoint
+            # before dumping, so the dump describes a settled run
+            writer.drain(raise_errors=False)
+            dump = _write_fleet_crash_dump(
+                sup, group_cfg, full, w["keys"], gi, w["active"], names,
+                idxs, w["done"], w["this_win"], e, report)
+            report.crash_dump = dump
+            if journal is not None:
+                journal.note("crash", group=gi, dump=dump,
+                             error=str(e)[:200])
+                journal.sync()
+            raise SupervisorCrash(
+                f"fleet group {gi} gave up at window start {w['done']} "
+                f"({failures} consecutive failure(s)); crash dump: "
+                f"{dump}", dump_dir=dump, report=report) from e
+        report.retries += 1
+        report.log("chunk_failed", error=str(e)[:200], **w["info"])
+        exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup,
+                                         report)
+        delay = min(sup.backoff_cap_s, sup.backoff_base_s
+                    * sup.backoff_factor ** (failures - 1))
+        report.log("backoff", delay_s=round(delay, 3))
+        sup.sleep(delay)
+
+    def process(p):
+        """Fold a confirmed window in: merge state, advance progress,
+        hand journal/checkpoint I/O to the writer thread."""
+        nonlocal full, done, failures, next_ckpt
+        w = p["w"]
+        act, tw = w["active"], w["this_win"]
+        done_wall = time.time()     # dispatch-complete stamp (dashboard)
         failures = 0
-        full = out if whole else _put_rows(full, idx, out)
-        done += this_win
+        full = p["out"] if w["whole"] \
+            else _put_rows(full, w["idx"], p["out"])
+        done = w["done"] + tw
         report.chunks_run += 1
-        report.ticks_run += this_win * len(active)      # member-ticks
-        report.log("chunk_ok", **info)
-        if journal is not None and health is not None:
-            # [C, B_active] records, one device fetch, rows bound to the
-            # members' INPUT indices (compaction changes lane positions,
-            # never ids); a failed attempt's records never reach here
-            journal.append_records(
-                health, member_ids=[int(idxs[j]) for j in active],
-                group=gi, window_start=done - this_win, ticks=this_win)
-        if collect_health and health is not None:
+        report.ticks_run += tw * len(act)       # member-ticks
+        report.log("chunk_ok", **w["info"])
+        if journal is not None and p["health"] is not None:
+            # [C, B_active] records, fetched OFF the critical path on the
+            # writer thread, rows bound to the members' INPUT indices
+            # (compaction changes lane positions, never ids); a failed
+            # attempt's records never reach here
+            writer.submit(
+                lambda h=p["health"], m=[int(idxs[j]) for j in act],
+                t0=w["done"], dw=done_wall: journal.append_records(
+                    h, member_ids=m, group=gi, window_start=t0,
+                    ticks=tw, done_wall=dw))
+        if collect_health and p["health"] is not None:
             from .telemetry import records_to_rows, rows_to_dicts
             mat, cols = records_to_rows(
-                health, member_ids=[int(idxs[j]) for j in active])
+                p["health"], member_ids=[int(idxs[j]) for j in act])
             for r in rows_to_dicts(mat, cols):
                 health_rows[r["member"]].append(r)
         # per-member sentinel surfacing: a raise-mode lane whose violation
         # bits lit retires HERE, its siblings keep running
         if any(escalate):
-            flags = np.asarray(out.fault_flags)
-            for pos, j in enumerate(active):
+            flags = np.asarray(p["out"].fault_flags)
+            for pos, j in enumerate(act):
                 if escalate[j] and not tripped[j] \
                         and int(flags[pos]) & VIOLATION_MASK:
                     tripped[j] = True
@@ -482,15 +536,82 @@ def _drive_group(gi, idxs, members, sup, report, dumps, hook,
         if ckpt_dir and (done >= next_ckpt
                          or not any(not tripped[j] and done < n_ticks[j]
                                     for j in range(b))):
-            os.makedirs(ckpt_dir, exist_ok=True)
             path = _ckpt_path(ckpt_dir, done)
-            checkpoint.save(path, full, cfg=group_cfg)  # fleet-axis bound
+
+            def save(full_now=full, path=path):    # fleet-axis bound
+                os.makedirs(ckpt_dir, exist_ok=True)
+                checkpoint.save(path, full_now, cfg=group_cfg)
+                _prune_checkpoints(ckpt_dir, sup.keep_checkpoints)
+
+            writer.submit(save)
             report.checkpoints.append(path)
             report.log("checkpoint", group=gi, done=done, path=path)
             if journal is not None:
-                journal.note("checkpoint", group=gi, done=done, path=path)
-            _prune_checkpoints(ckpt_dir, sup.keep_checkpoints)
+                writer.submit(lambda d=done, pth=path: journal.note(
+                    "checkpoint", group=gi, done=d, path=pth))
             next_ckpt = done + every
+
+    pend = None
+    while True:
+        if pend is None:                # start, or refill after failure
+            w = build_window(full, done)
+            if w is None:
+                break
+            note_compact(w)
+            try:
+                pend = _dispatch_window(w, exec_cfg, sup, hook,
+                                        telemetry=telemetry)
+            except Exception as e:
+                handle_failure(e, w)
+                continue
+        # speculate window k+1 against window k's in-flight output while
+        # the device still runs k (fleet never donates, so a failed k
+        # retries from the intact `full` and the speculation just drops)
+        spec = None
+        spec_exc = None
+        if pipelined and failures == 0:
+            w_p = pend["w"]
+            merged = pend["out"] if w_p["whole"] \
+                else _put_rows(full, w_p["idx"], pend["out"])
+            w2 = build_window(merged, w_p["done"] + w_p["this_win"])
+            if w2 is not None:
+                try:
+                    spec = _dispatch_window(w2, exec_cfg, sup, hook,
+                                            telemetry=telemetry)
+                except Exception as e:
+                    spec_exc = (e, w2)  # settle pend first, then ladder
+                except BaseException:
+                    # KeyboardInterrupt/SystemExit mid-speculation: land
+                    # the in-flight window's checkpoint/journal rows
+                    # before surfacing, so resume starts from them
+                    try:
+                        _confirm_window(pend, sup)
+                        process(pend)
+                        writer.drain(raise_errors=False)
+                    except Exception:
+                        pass
+                    raise
+        try:
+            _confirm_window(pend, sup)
+        except Exception as e:
+            if spec is not None or spec_exc is not None:
+                report.log("spec_discarded", group=gi,
+                           window_start=pend["w"]["done"]
+                           + pend["w"]["this_win"])
+            w_failed = pend["w"]
+            pend = None
+            handle_failure(e, w_failed)
+            continue
+        process(pend)
+        pend = None
+        if spec_exc is not None:
+            e2, w2 = spec_exc
+            note_compact(w2)
+            handle_failure(e2, w2)
+            continue
+        if spec is not None:
+            note_compact(spec["w"])
+        pend = spec
 
     flags = np.asarray(full.fault_flags)
     ticks = np.asarray(full.tick, np.int64)
@@ -520,18 +641,34 @@ def _drive(members, sup, dumps, hook, collect_health=False):
     report.log("fleet_plan", members=len(members), groups=len(groups),
                sizes=[len(v) for v in groups.values()])
     # streaming-telemetry lane (sim/telemetry.py): one journal for the
-    # whole fleet, rows [B]-batched per window and bound to input indices
+    # whole fleet, rows [B]-batched per window and bound to input indices.
+    # Under the async pipeline the journal batches fsyncs per writer-queue
+    # drain instead of per write (the writer flushes whenever its queue
+    # runs dry, and drain() barriers bound the loss window).
+    pipelined = bool(sup.async_chunks)
     journal = None
     if sup.health_path and sup.write_files:
         from .telemetry import HealthJournal
-        journal = HealthJournal(sup.health_path)
+        journal = HealthJournal(sup.health_path,
+                                sync_every_write=not pipelined)
+    # ONE off-critical-path writer for the whole fleet: checkpoint
+    # serialization and journal encode+fsync ride it; sync mode degrades
+    # to inline execution at submit (sim/supervisor.py._Writer)
+    writer = _Writer(maxsize=sup.writer_queue,
+                     flush=journal.sync if journal is not None else None,
+                     threaded=pipelined)
     results: dict = {}
     try:
         for gi, idxs in enumerate(groups.values()):
             results.update(_drive_group(gi, idxs, members, sup, report,
                                         dumps, hook, journal=journal,
-                                        collect_health=collect_health))
+                                        collect_health=collect_health,
+                                        writer=writer))
+            # group-end barrier: queued I/O lands (and any deferred
+            # writer error surfaces) before the next group's header
+            writer.drain()
     finally:
+        writer.close()
         if journal is not None:
             journal.close()
     return [results[i] for i in range(len(members))], report

@@ -1,0 +1,54 @@
+"""Host-transfer utilities shared by every plane that fetches device
+values (ISSUE 12 satellite: the addressable-shard unwrap used to live as
+private copies in ``sim/supervisor.py`` and ``sim/telemetry.py``; this
+module is the single home).
+
+Two cases make a plain ``np.asarray`` insufficient:
+
+- a **multi-process replicated global** array is not fully addressable,
+  so ``np.asarray`` raises — read the local replica instead (every
+  process holds the same value by construction);
+- a **typed PRNG key** array refuses direct ``np.asarray`` — unwrap to
+  its uint32 key data first.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def fetch_local(x) -> np.ndarray:
+    """Host value of a (possibly multi-process global) array. Replicated
+    leaves of a multihost state are not fully addressable, so
+    ``np.asarray`` raises — read the local replica (every process holds
+    the same value by construction). This is also the supervisor's
+    real-sync primitive: fetching by VALUE blocks through async dispatch
+    and the axon tunnel, which ``block_until_ready`` does not."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    return np.asarray(x.addressable_shards[0].data)
+
+
+def key_data(keys) -> np.ndarray:
+    """uint32 view of a key array, old-style (raw uint32) or typed (typed
+    keys refuse direct np.asarray; unwrap them first)."""
+    try:
+        if jax.dtypes.issubdtype(keys.dtype, jax.dtypes.prng_key):
+            return fetch_local(jax.random.key_data(keys))
+    except (AttributeError, TypeError):
+        pass
+    return fetch_local(keys)
+
+
+def is_deleted(tree) -> bool:
+    """True when any leaf of a state pytree has been consumed by a
+    donated executable. The async supervisor pipeline donates carried
+    chunk inputs; on a failure it must know whether the failing chunk's
+    input still exists (retry in place) or was consumed by the
+    speculative next dispatch (replay from the host anchor)."""
+    for leaf in jax.tree.leaves(tree):
+        fn = getattr(leaf, "is_deleted", None)
+        if fn is not None and fn():
+            return True
+    return False

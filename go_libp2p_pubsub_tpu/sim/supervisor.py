@@ -1,6 +1,6 @@
 """Supervised execution plane: chunked scans with checkpoints, a
 wall-clock watchdog, retry/backoff down a degraded-mode ladder, and
-replayable crash dumps.
+replayable crash dumps — run as a latency-hiding pipeline.
 
 PR 4 made the *simulated network* fault-tolerant (FaultPlan + invariant
 sentinel); this module makes the *runner itself* fault-tolerant — the
@@ -13,21 +13,53 @@ promise timeouts, gossipsub v1.1 hardening).
 :func:`supervised_run` wraps ``engine.run`` (or, with ``traced=True``,
 ``trace_export.run_traced``) as a sequence of chunked scans:
 
-- **bit-identical chunking**: ONE master key is pre-split into per-tick
-  keys exactly as ``engine.run`` does internally, and each chunk scans a
-  contiguous window of that key array (``engine.run_keys``) — the chunked
-  trajectory equals the single-scan trajectory bit for bit, checkpoints
-  or not, faults or not (tests/test_supervisor.py, the core correctness
-  claim).
+- **bit-identical chunking**: under the default ``key_schedule="host"``
+  ONE master key is pre-split into per-tick keys exactly as
+  ``engine.run`` does internally, and each chunk scans a contiguous
+  window of that key array (``engine.run_keys``); under
+  ``key_schedule="fold_in"`` the per-tick keys derive ON DEVICE from the
+  master key and the carried absolute tick (``engine.run_window``), so
+  no key window ships at all. Either way the chunked trajectory equals
+  the single-scan trajectory bit for bit, checkpoints or not, faults or
+  not (tests/test_supervisor.py, the core correctness claim).
+- **latency-hiding pipeline** (``async_chunks``, default on): JAX arrays
+  are futures — dispatch returns immediately and only the *fetch*
+  blocks — so chunk k+1's AOT executable launches the moment chunk k's
+  dispatch returns, and chunk k's confirmation, telemetry fetch, and
+  checkpoint staging happen while k+1 runs on device::
+
+      dispatch k ──► speculate k+1 ──► confirm k ──► fold k in ──► ...
+                     (device: k)       (blocks on k)  (writer thread:
+                                                       journal + ckpt)
+
+  The watchdog re-anchors each chunk's deadline to its dispatch-complete
+  time; any failure of chunk k discards the in-flight k+1 result and
+  retries from the last good state — bit-exact retry semantics
+  unchanged. A mid-cadence chunk's input state may be DONATED into its
+  successor's dispatch (in-place XLA aliasing, parallel/compile_plan.py
+  owns the flavors); retries that land on a donated input silently
+  replay the already-confirmed gap from the last undonated anchor with
+  the same keys. Checkpoint serialization, journal encode+fsync, and
+  terminal notes run on ONE bounded-queue writer thread off the critical
+  path (``writer_queue``); a ``drain()`` barrier at window end, failure,
+  and KeyboardInterrupt keeps the crash-atomicity guarantees — a chunk
+  is journaled/checkpointed only after its device result was confirmed
+  good. Traced and ``invariant_mode="raise"`` chunks are host-blocking
+  calls with nothing to overlap: they keep the fully synchronous
+  discipline (which ``async_chunks=False`` forces everywhere — the
+  positive control bench.py measures).
 - **checkpoints**: every ``checkpoint_every_ticks`` (default: every chunk
   boundary) the state lands in ``checkpoint_dir`` through the
   crash-atomic ``sim/checkpoint.save`` with the caller's config
   fingerprint stamped; a re-invocation resumes from the newest checkpoint
   that restores cleanly, falling back past torn ones
   (``CheckpointCorrupt``).
-- **watchdog**: each chunk runs under a wall-clock ``deadline_s`` in a
-  worker thread; an overrun abandons the dispatch (device work cannot be
-  cancelled — the result is discarded) and counts as a transient failure.
+- **watchdog**: each chunk's dispatch runs under a wall-clock
+  ``deadline_s`` in a worker thread, and its confirmation (the real
+  sync-by-value fetch) runs under the remainder of that budget
+  re-anchored to the dispatch-complete time; an overrun abandons the
+  work (device work cannot be cancelled — the result is discarded) and
+  counts as a transient failure.
 - **retry + degraded-mode ladder**: transient failures back off
   exponentially and escalate — first ``hop_mode``/``edge_gather_mode``
   fall back to the conservative XLA formulations (bit-identical by the
@@ -44,7 +76,9 @@ promise timeouts, gossipsub v1.1 hardening).
   crashed traced run leaves a readable partial trace.
 
 Env knobs (``SupervisorConfig.from_env``): ``GRAFT_CHUNK_TICKS``,
-``GRAFT_DEADLINE_S``, ``GRAFT_CRASH_DIR``, ``GRAFT_CHECKPOINT_DIR``.
+``GRAFT_DEADLINE_S``, ``GRAFT_CRASH_DIR``, ``GRAFT_CHECKPOINT_DIR``,
+``GRAFT_HEALTH_STREAM``, ``GRAFT_ASYNC_CHUNKS`` (``0`` disables the
+pipeline), ``GRAFT_WRITER_QUEUE``.
 
 The fleet plane (sim/fleet.py) builds its batched-run supervision on the
 SAME primitives — ``SupervisorConfig``/``SupervisorReport``, the
@@ -58,6 +92,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import queue
 import re
 import shutil
 import threading
@@ -65,13 +100,27 @@ import time
 from typing import Callable
 
 import jax
-import numpy as np
 
 from . import checkpoint
 from .config import SimConfig, TopicParams
+# the one host-transfer utility module (sim/hostio.py): the
+# addressable-shard unwrap and the typed-key unwrap used to live here
+# and in sim/telemetry.py as separate copies — the names stay importable
+# (sim/fleet.py imports both) but are now aliases
+from .hostio import fetch_local as _fetch_scalar  # noqa: F401
+from .hostio import is_deleted as _is_deleted
+from .hostio import key_data as _key_data  # noqa: F401
 from .state import SimState
 
 _CKPT_RE = re.compile(r"^ckpt_t(\d+)(?:\.npz)?$")
+
+# confirmation never gets less than this much wall clock, even when the
+# writer or speculation ate most of the chunk's re-anchored budget: a
+# finished device result fetches in microseconds, so the floor only
+# matters when the device is genuinely still running AND the host fell
+# behind — and failing the chunk for HOST lateness would retry work the
+# device already did
+_CONFIRM_GRACE_S = 0.2
 
 
 class SupervisorCrash(RuntimeError):
@@ -116,6 +165,17 @@ class SupervisorConfig:
     sinks: tuple = ()                 # trace sinks hard_flush()ed on failure
     # injectable for tests/smoke (real backoff sleeps are pointless there)
     sleep: Callable[[float], None] = time.sleep
+    # --- latency-hiding pipeline (module docstring) ---
+    # double-buffered async dispatch: chunk k+1 launches while chunk k is
+    # still on device, and checkpoint/journal writes move to a background
+    # writer thread. Failure semantics are unchanged (in-flight work is
+    # discarded, retries are bit-exact). Traced and "raise" chunks always
+    # run synchronously regardless. Env: GRAFT_ASYNC_CHUNKS=0 disables.
+    async_chunks: bool = True
+    # bounded writer-queue depth: a full queue blocks the main loop
+    # (backpressure — staged checkpoint/journal memory stays bounded
+    # instead of growing with device/host skew). Env: GRAFT_WRITER_QUEUE.
+    writer_queue: int = 4
     # --- multi-process hooks (parallel/multihost.py) ---
     # custom chunk runner (state, exec_cfg, tp, keys) -> state, replacing
     # engine.run_keys: the multihost launcher dispatches the SHARDED scan
@@ -129,7 +189,10 @@ class SupervisorConfig:
     # state -> host-complete state for checkpoint/crash writes. COLLECTIVE
     # when set (multihost.gather_state all-gathers non-addressable
     # shards): every process must reach the checkpoint boundary, while
-    # only write_files=True processes (rank 0) touch the filesystem
+    # only write_files=True processes (rank 0) touch the filesystem.
+    # Collectives must stay rank-symmetric, so the gather runs on the
+    # MAIN thread at boundaries — only the file serialization that
+    # follows it rides the writer thread
     state_to_host: Callable | None = None
     # host-complete state -> this process's sharded state (resume path:
     # every process restores rank 0's checkpoint from the shared
@@ -171,6 +234,11 @@ class SupervisorConfig:
             kw["checkpoint_dir"] = os.environ["GRAFT_CHECKPOINT_DIR"]
         if os.environ.get("GRAFT_HEALTH_STREAM"):
             kw["health_path"] = os.environ["GRAFT_HEALTH_STREAM"]
+        if os.environ.get("GRAFT_ASYNC_CHUNKS"):
+            kw["async_chunks"] = os.environ["GRAFT_ASYNC_CHUNKS"].lower() \
+                not in ("0", "false", "no", "off")
+        if os.environ.get("GRAFT_WRITER_QUEUE"):
+            kw["writer_queue"] = int(os.environ["GRAFT_WRITER_QUEUE"])
         kw.update(overrides)
         return SupervisorConfig(**kw)
 
@@ -194,27 +262,6 @@ class SupervisorReport:
 
     def log(self, event: str, **info) -> None:
         self.events.append({"event": event, **info})
-
-
-def _fetch_scalar(x) -> np.ndarray:
-    """Host value of a (possibly multi-process global) scalar array: a
-    replicated leaf of a multihost state is not fully addressable, so
-    ``np.asarray`` raises — read the local replica instead (every process
-    holds the same value by construction)."""
-    if getattr(x, "is_fully_addressable", True):
-        return np.asarray(x)
-    return np.asarray(x.addressable_shards[0].data)
-
-
-def _key_data(keys) -> np.ndarray:
-    """uint32 view of a key array, old-style (raw uint32) or typed (typed
-    keys refuse direct np.asarray; unwrap them first)."""
-    try:
-        if jax.dtypes.issubdtype(keys.dtype, jax.dtypes.prng_key):
-            return np.asarray(jax.random.key_data(keys))
-    except (AttributeError, TypeError):
-        pass
-    return np.asarray(keys)
 
 
 def _hard_flush(sinks) -> None:
@@ -353,7 +400,9 @@ def _write_crash_dump(sup: SupervisorConfig, cfg: SimConfig,
         "fault_flags": flags,
         "fault_flag_names": decode_flags(flags),
         # the failing window's exact per-tick keys: replay_crash.py feeds
-        # these straight back into engine.run_checked_keys
+        # these straight back into engine.run_checked_keys (under
+        # key_schedule="fold_in" the window is re-derived on the host —
+        # engine.window_keys — so the dump format is schedule-agnostic)
         "window_key_data": _key_data(keys_chunk).tolist(),
         "degrade_level": report.degrade_level,
         "retries": report.retries,
@@ -369,28 +418,6 @@ def _write_crash_dump(sup: SupervisorConfig, cfg: SimConfig,
     os.replace(tmp, os.path.join(dump, "crash.json"))
     report.log("crash_dump", path=dump)
     return dump
-
-
-# AOT-compiled chunk executables, keyed by (exec_cfg, chunk_len, key
-# dtype): compiling through .lower().compile() ahead of the watchdog keeps
-# compile time out of the run deadline, and re-dispatching the SAME
-# executable across chunks/retries skips the jit cache lookup entirely.
-# SimConfig is frozen/hashable, so the dict stays small (one entry per
-# ladder rung per tail-chunk shape).
-_AOT_CACHE: dict = {}
-
-
-def _chunk_executable(exec_cfg: SimConfig, state: SimState, tp: TopicParams,
-                      keys_chunk, telemetry: bool = False):
-    from .engine import run_keys
-    cache_key = (exec_cfg, int(keys_chunk.shape[0]), str(keys_chunk.dtype),
-                 telemetry)
-    exe = _AOT_CACHE.get(cache_key)
-    if exe is None:
-        exe = run_keys.lower(state, exec_cfg, tp, keys_chunk,
-                             telemetry=telemetry).compile()
-        _AOT_CACHE[cache_key] = exe
-    return exe
 
 
 def _with_deadline(fn, deadline_s, what: str, info: dict):
@@ -431,28 +458,175 @@ def _with_deadline(fn, deadline_s, what: str, info: dict):
     return val
 
 
-def _run_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
-               keys_chunk, sup: SupervisorConfig, traced: bool,
-               chunk_events: list, chunk_health: list,
-               chunk_hook, info: dict) -> tuple:
-    """One chunk attempt: compile (its own deadline) then run (the
-    watchdog deadline). Returns ``(state, HealthRecord | None)`` — the
-    chunk's device-stacked telemetry records when ``sup.health_path``
-    turned the lane on (sim/telemetry.py); the traced path keeps its
-    per-tick dict rows in ``chunk_health`` instead."""
+class _Writer:
+    """The off-critical-path writer: checkpoint serialization, journal
+    encode+fsync, and terminal notes run as FIFO callables on ONE
+    background daemon thread behind a bounded queue, so a chunk boundary
+    costs the main loop a queue put instead of a multi-hundred-ms fsync.
+
+    ``threaded=False`` (the synchronous path: ``async_chunks=False``,
+    traced, or ``invariant_mode="raise"``) executes every task inline at
+    submit — today's write-at-the-site discipline, the bench's positive
+    control. The queue bound is backpressure, not loss: a full queue
+    blocks ``submit`` (the main loop) until the writer catches up, so
+    host memory staged for checkpoints/records stays bounded however far
+    the device runs ahead. ``flush`` (the journal's batched fsync,
+    HealthJournal.sync) fires whenever the queue runs dry and at every
+    :meth:`drain` — crash-atomicity keeps its marker discipline because
+    tasks are only ever submitted AFTER their chunk's device result was
+    confirmed good. The first task error is stored and re-raised at the
+    next submit or drain, where the synchronous path would have raised
+    it at the write site."""
+
+    def __init__(self, maxsize: int = 4, flush=None, threaded: bool = True):
+        self._flush = flush
+        self._threaded = threaded
+        self._err: BaseException | None = None
+        self._thread = None
+        if threaded:
+            self._q: queue.Queue = queue.Queue(max(1, int(maxsize)))
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="graft-writer")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            task = self._q.get()
+            try:
+                if task is None:
+                    return
+                if self._err is None:   # first error wins; skip the rest
+                    task()
+                    if self._q.empty() and self._flush is not None:
+                        self._flush()
+            except BaseException as e:
+                self._err = e
+            finally:
+                self._q.task_done()
+
+    def _reraise(self) -> None:
+        err, self._err = self._err, None
+        raise err
+
+    def submit(self, task: Callable[[], None]) -> None:
+        if self._err is not None:
+            self._reraise()
+        if not self._threaded:
+            task()
+            if self._flush is not None:
+                self._flush()
+            return
+        while True:     # interruptible bounded put (backpressure point)
+            try:
+                self._q.put(task, timeout=0.2)
+                return
+            except queue.Full:
+                if self._err is not None:
+                    self._reraise()
+
+    def drain(self, raise_errors: bool = True) -> None:
+        """Barrier: every submitted task has fully executed — and the
+        journal is fsync'd — when this returns."""
+        if self._threaded:
+            self._q.join()
+        if raise_errors and self._err is not None:
+            self._reraise()
+
+    def close(self) -> None:
+        """Drain and stop the thread. Errors stay stored — close runs in
+        ``finally`` and must not mask the in-flight exception; the
+        caller's drain/submit already surfaced anything actionable."""
+        if not self._threaded or self._thread is None:
+            return
+        try:
+            self._q.put(None)
+            self._thread.join(timeout=30.0)
+        finally:
+            self._thread = None
+
+
+@dataclasses.dataclass
+class _Pending:
+    """One dispatched-but-unconfirmed chunk. JAX arrays are futures, so
+    ``out`` exists the moment dispatch returns while the device still
+    computes; ``tick_ref`` is an independently-buffered copy of
+    ``out.tick`` (a tiny jit, fresh output buffer) so confirmation can
+    still block on the device result after ``out``'s own buffers were
+    donated into the NEXT chunk's dispatch. ``src`` is the input state
+    the chunk ran from — the retry anchor, unless a donating speculative
+    dispatch consumed it (then ``hostio.is_deleted`` flags it and the
+    retry replays from the last undonated anchor instead)."""
+
+    out: SimState
+    tick_ref: object
+    records: object             # device-stacked HealthRecord | None
+    events: list                # traced-mode per-chunk events
+    health: list                # traced-mode per-tick host rows
+    info: dict
+    ticks: int
+    src: SimState
+    dispatched_at: float        # monotonic stamp at dispatch-complete
+
+
+_TICK_VIEW = None
+
+
+def _tick_view(tick):
+    global _TICK_VIEW
+    if _TICK_VIEW is None:
+        # t + 0 (not identity): jit may forward an untouched input buffer,
+        # and the whole point is a buffer that survives donation of the
+        # parent state
+        _TICK_VIEW = jax.jit(lambda t: t + 0)
+    return _TICK_VIEW(tick)
+
+
+def _dispatch_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
+                    keys_chunk, master_key, sup: SupervisorConfig,
+                    traced: bool, hook, info: dict, *,
+                    donate: bool = False) -> _Pending:
+    """Dispatch one chunk attempt WITHOUT waiting for the device: compile
+    (its own deadline, parallel/compile_plan.py's AOT cache), run the
+    fault-injection hook + enqueue under the run deadline, and capture an
+    independent tick future for later confirmation. ``keys_chunk is
+    None`` selects the ``key_schedule="fold_in"`` window executable (no
+    key window ships — the master key and the carried tick derive them on
+    device); ``donate=True`` hands the input state's buffers to XLA (the
+    caller guarantees it owns them and will never retry from them
+    directly)."""
     telemetry = sup.health_path is not None and not traced
+    engine_lane = not traced and exec_cfg.invariant_mode != "raise" \
+        and sup.run_fn is None
     exe = None
-    if not traced and exec_cfg.invariant_mode != "raise" \
-            and sup.run_fn is None:
-        exe = _with_deadline(
-            lambda: _chunk_executable(exec_cfg, state, tp, keys_chunk,
-                                      telemetry=telemetry),
-            sup.compile_deadline_s, "compile", info)
+    if engine_lane:
+        from ..parallel import compile_plan
+        if keys_chunk is None:
+            exe = _with_deadline(
+                lambda: compile_plan.engine_window(
+                    exec_cfg, state, tp, master_key,
+                    int(info["chunk_ticks"]),
+                    telemetry=telemetry, donate=donate),
+                sup.compile_deadline_s, "compile", info)
+        else:
+            exe = _with_deadline(
+                lambda: compile_plan.engine_chunk(
+                    exec_cfg, state, tp, keys_chunk,
+                    telemetry=telemetry, donate=donate),
+                sup.compile_deadline_s, "compile", info)
+
+    cancelled = threading.Event()
+    events: list = []
+    health: list = []
 
     def worker():
-        if chunk_hook is not None:      # test/smoke fault-injection point
-            chunk_hook(info)
-        health = None
+        if hook is not None:        # test/smoke fault-injection point
+            hook(info)
+        if cancelled.is_set():
+            # the watchdog already abandoned this attempt: a late dispatch
+            # from the orphaned thread must not donate buffers the retry
+            # is about to re-run from
+            return None
+        rec = None
         if sup.run_fn is not None:
             # custom chunk runner (multihost sharded scan); it owns its
             # own compile caching, so first use rides the run deadline.
@@ -464,17 +638,17 @@ def _run_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
             # subclass), so isinstance would mis-unpack a plain runner's
             # bare state into 2-of-30 fields
             if type(out) is tuple:
-                out, health = out
+                out, rec = out
         elif traced:
             from .trace_export import run_traced
             out, evs = run_traced(state, exec_cfg, tp, None, 0,
-                                  health_out=chunk_health, keys=keys_chunk)
-            chunk_events.extend(evs)
+                                  health_out=health, keys=keys_chunk)
+            events.extend(evs)
         elif exe is not None:
+            out = exe(state, tp, master_key if keys_chunk is None
+                      else keys_chunk)
             if telemetry:
-                out, health = exe(state, tp, keys_chunk)
-            else:
-                out = exe(state, tp, keys_chunk)
+                out, rec = out
         else:
             # "raise" mode: per-call checkify transform (the debugging
             # path — compile rides the run deadline here)
@@ -482,14 +656,44 @@ def _run_chunk(state: SimState, exec_cfg: SimConfig, tp: TopicParams,
             out = run_checked_keys(state, exec_cfg, tp, keys_chunk,
                                    telemetry=telemetry)
             if telemetry:
-                out, health = out
-        # real sync by value fetch: async dispatch (and the axon tunnel,
-        # which block_until_ready does not block through) must not let a
-        # wedged chunk slide past the deadline
-        _fetch_scalar(out.tick)
-        return out, health
+                out, rec = out
+        return out, rec
 
-    return _with_deadline(worker, sup.deadline_s, "chunk", info)
+    try:
+        res = _with_deadline(worker, sup.deadline_s, "chunk", info)
+    except BaseException:
+        cancelled.set()
+        raise
+    if res is None:     # defensive: cancelled is only set above, post-raise
+        raise ChunkDeadline(f"chunk at tick {info.get('chunk_start', '?')} "
+                            "was cancelled before dispatch")
+    out, rec = res
+    # engine-lane outputs may later be donated into the next dispatch;
+    # the traced/"raise"/run_fn lanes never donate, so the leaf itself is
+    # a fine confirmation handle there
+    tick_ref = _tick_view(out.tick) if engine_lane else out.tick
+    return _Pending(out=out, tick_ref=tick_ref, records=rec, events=events,
+                    health=health, info=info,
+                    ticks=int(info["chunk_ticks"]), src=state,
+                    dispatched_at=time.monotonic())
+
+
+def _confirm(pend: _Pending, sup: SupervisorConfig,
+             scale: float = 1.0) -> None:
+    """Block until the chunk's device result is real: the sync-by-value
+    fetch of the tick future (async dispatch — and the axon tunnel, which
+    block_until_ready does not block through — must not let a wedged
+    chunk slide past the watchdog). The deadline is the chunk's budget
+    RE-ANCHORED to its dispatch-complete time: however long the host
+    spent speculating/writing since dispatch comes out of the same
+    ``deadline_s`` the synchronous path would have charged, floored at
+    ``_CONFIRM_GRACE_S``."""
+    deadline = None
+    if sup.deadline_s is not None:
+        deadline = max(_CONFIRM_GRACE_S, sup.deadline_s * scale
+                       - (time.monotonic() - pend.dispatched_at))
+    _with_deadline(lambda: _fetch_scalar(pend.tick_ref), deadline,
+                   "chunk", pend.info)
 
 
 def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
@@ -503,7 +707,9 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
 
     Returns ``(final_state, report)``; the final state is bit-identical to
     ``engine.run(state, cfg, tp, key, n_ticks)`` regardless of chunking,
-    checkpointing, resumption, retries, or degraded modes. Raises
+    checkpointing, resumption, retries, degraded modes, or the async
+    pipeline (``sup.async_chunks`` — speculation is discarded on any
+    failure, so the confirmed carry chain IS the synchronous one). Raises
     :class:`SupervisorCrash` after writing a crash dump when the run
     cannot make progress.
 
@@ -517,7 +723,17 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
     sup = sup or SupervisorConfig.from_env()
     report = SupervisorReport()
     start_tick = int(_fetch_scalar(state.tick))
-    all_keys = jax.random.split(key, n_ticks)   # run's exact discipline
+    fold = cfg.key_schedule == "fold_in"
+    # "host": ONE master pre-split, run's exact discipline. "fold_in":
+    # keys derive on device inside the scan — nothing to pre-split (crash
+    # dumps and the traced/"raise" chunk paths materialize their windows
+    # lazily through engine.window_keys).
+    all_keys = None if fold else jax.random.split(key, n_ticks)
+    # the pipeline lane. Traced and checkified chunks are host-blocking
+    # calls with nothing to overlap — they keep the synchronous
+    # discipline, writer inline (per-write fsync), no speculation.
+    pipelined = bool(sup.async_chunks) and not traced \
+        and cfg.invariant_mode != "raise"
 
     done = 0
     if sup.checkpoint_dir:
@@ -530,165 +746,339 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
     journal = None
     if sup.health_path and sup.write_files:
         from .telemetry import HealthJournal
-        journal = HealthJournal(sup.health_path)
+        # pipelined: ONE fsync per writer-queue drain instead of one per
+        # line (the torn-tail-tolerant reader copes either way); inline:
+        # the historical per-write fsync
+        journal = HealthJournal(sup.health_path,
+                                sync_every_write=not pipelined)
         journal.header(cfg, scenario=sup.scenario, start_tick=start_tick,
                        n_ticks=n_ticks, resumed_tick=report.resumed_tick,
                        traced=traced, **(sup.health_meta or {}))
+
+    writer = _Writer(maxsize=sup.writer_queue,
+                     flush=journal.sync if journal is not None else None,
+                     threaded=pipelined)
 
     exec_cfg = cfg
     chunk_ticks = max(1, int(sup.chunk_ticks))
     every = sup.checkpoint_every_ticks or chunk_ticks
     next_ckpt = done + every
     failures = 0            # consecutive; reset on every successful chunk
+    # retry/dump anchor: the newest confirmed state NEVER handed to a
+    # donating dispatch, and the progress offset it holds. Mid-cadence
+    # chunk inputs may be donated into their successor; a retry that
+    # lands on a deleted input silently replays [anchor_done, done) from
+    # here — same keys, bit-exact — to rebuild its starting state.
+    anchor_state, anchor_done = state, done
     # multihost: the newest HOST-COMPLETE copy and the tick offset it was
     # gathered at, refreshed at every checkpoint-cadence boundary (where
-    # state_to_host — a collective — legally runs on every rank; NEVER in
-    # the error path, where a one-rank failure would deadlock it). The
-    # crash path dumps THIS with its key window re-anchored to the
-    # gathered tick, so last_good + keys stay a replayable pair even when
-    # the gather is chunks old.
+    # state_to_host — a collective — legally runs on every rank's MAIN
+    # thread; NEVER in the error path, where a one-rank failure would
+    # deadlock it, and never on the writer thread, where rank-asymmetric
+    # timing would misorder collectives). The crash path dumps THIS with
+    # its key window re-anchored to the gathered tick.
     last_host_state, last_host_done = None, done
     if sup.state_to_host is not None:
         # run-start gather: a first-window crash still has a dumpable
         # copy (and a run with no checkpoint_dir dumps at all)
         last_host_state = sup.state_to_host(state)
-    try:
-        while done < n_ticks:
-            this_chunk = min(chunk_ticks, n_ticks - done)
-            keys_chunk = all_keys[done:done + this_chunk]
-            info = {"chunk_start": start_tick + done, "chunk_ticks": this_chunk,
-                    "attempt": failures, "degrade_level": report.degrade_level}
-            chunk_events: list = []
-            chunk_health: list = []
-            try:
-                out, chunk_records = _run_chunk(state, exec_cfg, tp, keys_chunk,
-                                                sup, traced, chunk_events,
-                                                chunk_health, _chunk_hook, info)
-            except Exception as e:
-                _hard_flush(sup.sinks)
-                failures += 1
-                # a MULTI-PROCESS run fails fast: the retry/degrade ladder is
-                # rank-LOCAL, so one rank re-dispatching a degraded (different
-                # collective sequence) or re-sized program while its peers sit
-                # in the original chunk's collectives would deadlock or pair
-                # wrong collectives. Recovery that IS rank-symmetric by
-                # construction: crash, relaunch every rank, resume from the
-                # last checkpoint (scripts/run_multihost.py).
-                multiproc = sup.run_fn is not None and jax.process_count() > 1
-                if _is_invariant_trip(e) or multiproc \
-                        or failures > sup.max_retries:
-                    # invariant trips are never retried: the trajectory itself
-                    # is poisoned and would trip again on the same keys
-                    dump = None
-                    if sup.write_files and sup.state_to_host is None:
-                        dump = _write_crash_dump(sup, cfg, state,
-                                                 keys_chunk, start_tick, done,
-                                                 this_chunk, n_ticks, e, report)
-                    elif sup.write_files and last_host_state is not None:
-                        # the gathered copy may be chunks old: re-anchor the
-                        # dumped window to ITS tick so replay_crash.py feeds
-                        # last_good exactly the keys that advance it into the
-                        # failure
-                        w0, w1 = last_host_done, done + this_chunk
-                        dump = _write_crash_dump(sup, cfg, last_host_state,
-                                                 all_keys[w0:w1], start_tick,
-                                                 w0, w1 - w0, n_ticks, e,
-                                                 report)
-                    report.crash_dump = dump
-                    if journal is not None:
-                        # the dashboard's post-mortem hook: the journal ends
-                        # with WHERE it died and which dump replays it
-                        journal.note("crash", tick=start_tick + done,
-                                     dump=dump, error=str(e)[:200])
-                    raise SupervisorCrash(
-                        f"supervised run gave up at tick {start_tick + done} "
-                        f"({failures} consecutive failure(s)); crash dump: "
-                        f"{dump}", dump_dir=dump, report=report) from e
-                report.retries += 1
-                report.log("chunk_failed",
-                           kind="deadline" if isinstance(e, ChunkDeadline)
-                           else "error", error=str(e)[:200], **info)
-                exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup,
-                                                 report)
-                delay = min(sup.backoff_cap_s, sup.backoff_base_s
-                            * sup.backoff_factor ** (failures - 1))
-                report.log("backoff", delay_s=round(delay, 3))
-                sup.sleep(delay)
-                continue
-            failures = 0
-            state = out
-            done += this_chunk
-            report.chunks_run += 1
-            report.ticks_run += this_chunk
-            report.log("chunk_ok", **info)
-            if events_out is not None:
-                events_out.extend(chunk_events)
-            if health_out is not None:
-                health_out.extend(chunk_health)
+
+    def chunk_keys(lo: int, hi: int):
+        if all_keys is not None:
+            return all_keys[lo:hi]
+        from .engine import window_keys
+        return window_keys(cfg, key, start_tick, lo, hi, n_ticks)
+
+    def dispatch(src, c_done: int, ticks: int, info: dict, donate: bool,
+                 hook=_chunk_hook) -> _Pending:
+        keys_chunk = None
+        if not (fold and not traced and cfg.invariant_mode != "raise"
+                and sup.run_fn is None):
+            keys_chunk = chunk_keys(c_done, c_done + ticks)
+        return _dispatch_chunk(src, exec_cfg, tp, keys_chunk, key, sup,
+                               traced, hook, info, donate=donate)
+
+    def handle_failure(e: Exception, info: dict, fail_done: int,
+                       this_chunk: int, last_good, good_done: int) -> None:
+        """The retry/degrade/crash ladder, shared by every failure site
+        (fresh dispatch, speculative dispatch, confirmation, catch-up).
+        Raises :class:`SupervisorCrash` or records retry bookkeeping and
+        sleeps the backoff."""
+        nonlocal exec_cfg, chunk_ticks, failures
+        _hard_flush(sup.sinks)
+        failures += 1
+        # a MULTI-PROCESS run fails fast: the retry/degrade ladder is
+        # rank-LOCAL, so one rank re-dispatching a degraded (different
+        # collective sequence) or re-sized program while its peers sit
+        # in the original chunk's collectives would deadlock or pair
+        # wrong collectives. Recovery that IS rank-symmetric by
+        # construction: crash, relaunch every rank, resume from the
+        # last checkpoint (scripts/run_multihost.py).
+        multiproc = sup.run_fn is not None and jax.process_count() > 1
+        if _is_invariant_trip(e) or multiproc or failures > sup.max_retries:
+            # invariant trips are never retried: the trajectory itself
+            # is poisoned and would trip again on the same keys
+            writer.drain(raise_errors=False)    # pending checkpoints land
+            dump = None
+            if sup.write_files and sup.state_to_host is None:
+                if last_good is None or _is_deleted(last_good):
+                    # the failing chunk's direct input was donated away;
+                    # the anchor is the newest state a replay can feed —
+                    # re-anchor the dumped window to ITS tick so
+                    # replay_crash.py advances it into the failure
+                    last_good, good_done = anchor_state, anchor_done
+                w0, w1 = good_done, fail_done + this_chunk
+                dump = _write_crash_dump(sup, cfg, last_good,
+                                         chunk_keys(w0, w1), start_tick,
+                                         w0, w1 - w0, n_ticks, e, report)
+            elif sup.write_files and last_host_state is not None:
+                # the gathered copy may be chunks old: same re-anchoring
+                w0, w1 = last_host_done, fail_done + this_chunk
+                dump = _write_crash_dump(sup, cfg, last_host_state,
+                                         chunk_keys(w0, w1), start_tick,
+                                         w0, w1 - w0, n_ticks, e, report)
+            report.crash_dump = dump
             if journal is not None:
-                # stream the SUCCESSFUL chunk (a failed attempt's records died
-                # with its discarded output — the journal never double-counts
-                # a retried tick): one fetch of the [C]-stacked device buffer,
-                # encoded native-first, fsync'd before the loop moves on
-                if chunk_records is not None:
-                    journal.append_records(chunk_records,
-                                           tick_start=start_tick + done
-                                           - this_chunk, ticks=this_chunk)
-                elif traced and chunk_health:
-                    journal.append_dicts(chunk_health,
-                                         tick_start=start_tick + done
-                                         - this_chunk, ticks=this_chunk)
-                else:
-                    # a runner that yields no records (a plain custom
-                    # run_fn) still marks progress: the dashboard's hb/s
-                    # and chunk cadence come from these markers
-                    journal.note("chunk", rows=0,
-                                 tick_start=start_tick + done - this_chunk,
-                                 ticks=this_chunk)
-            window_end = sup.max_chunks is not None \
-                and report.chunks_run >= sup.max_chunks and done < n_ticks
-            # a window end is ALWAYS a boundary: the max_chunks contract says
-            # "stop cleanly (checkpoint written if a dir is set)" — without
-            # this, a stop off the checkpoint cadence would discard the whole
-            # window's progress on resume
-            at_boundary = done >= next_ckpt or done >= n_ticks or window_end
-            if at_boundary and sup.state_to_host is not None:
+                # the dashboard's post-mortem hook: the journal ends
+                # with WHERE it died and which dump replays it
+                writer.submit(lambda: journal.note(
+                    "crash", tick=start_tick + fail_done, dump=dump,
+                    error=str(e)[:200]))
+                writer.drain(raise_errors=False)
+            raise SupervisorCrash(
+                f"supervised run gave up at tick {start_tick + fail_done} "
+                f"({failures} consecutive failure(s)); crash dump: "
+                f"{dump}", dump_dir=dump, report=report) from e
+        report.retries += 1
+        report.log("chunk_failed",
+                   kind="deadline" if isinstance(e, ChunkDeadline)
+                   else "error", error=str(e)[:200], **info)
+        exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup, report)
+        delay = min(sup.backoff_cap_s, sup.backoff_base_s
+                    * sup.backoff_factor ** (failures - 1))
+        report.log("backoff", delay_s=round(delay, 3))
+        sup.sleep(delay)
+
+    carry, carry_done = state, done     # confirmed head of the carry chain
+    pend: _Pending | None = None
+    window_end_hit = False
+
+    def process(p: _Pending) -> None:
+        """Fold a CONFIRMED chunk into the run: counters, journal rows
+        (through the writer, off the critical path), the boundary
+        gather/checkpoint/anchor, window accounting. Main thread only."""
+        nonlocal done, carry, carry_done, next_ckpt, failures
+        nonlocal anchor_state, anchor_done, last_host_state, last_host_done
+        nonlocal window_end_hit
+        # dispatch-complete stamp at confirm time: the honest hb/s clock
+        # for overlapped runs (wall stamps at ENQUEUE time would credit a
+        # chunk before the device ran it — scripts/dashboard.py prefers
+        # this field and falls back to wall for old journals)
+        done_wall = time.time()
+        failures = 0
+        done += p.ticks
+        carry, carry_done = p.out, done
+        report.chunks_run += 1
+        report.ticks_run += p.ticks
+        report.log("chunk_ok", **p.info)
+        if events_out is not None:
+            events_out.extend(p.events)
+        if health_out is not None:
+            health_out.extend(p.health)
+        if journal is not None:
+            # stream the SUCCESSFUL chunk (a failed attempt's records died
+            # with its discarded output — the journal never double-counts
+            # a retried tick): one fetch of the [C]-stacked device buffer,
+            # encoded native-first — on the writer thread, while the next
+            # chunk runs
+            t0, tks = start_tick + done - p.ticks, p.ticks
+            if p.records is not None:
+                writer.submit(lambda rec=p.records: journal.append_records(
+                    rec, tick_start=t0, ticks=tks, done_wall=done_wall))
+            elif traced and p.health:
+                writer.submit(lambda rows=list(p.health):
+                              journal.append_dicts(
+                                  rows, tick_start=t0, ticks=tks,
+                                  done_wall=done_wall))
+            else:
+                # a runner that yields no records (a plain custom
+                # run_fn) still marks progress: the dashboard's hb/s
+                # and chunk cadence come from these markers
+                writer.submit(lambda: journal.note(
+                    "chunk", rows=0, tick_start=t0, ticks=tks,
+                    done_wall=done_wall))
+        window_end = sup.max_chunks is not None \
+            and report.chunks_run >= sup.max_chunks and done < n_ticks
+        # a window end is ALWAYS a boundary: the max_chunks contract says
+        # "stop cleanly (checkpoint written if a dir is set)" — without
+        # this, a stop off the checkpoint cadence would discard the whole
+        # window's progress on resume
+        at_boundary = done >= next_ckpt or done >= n_ticks or window_end
+        if at_boundary:
+            pause_t0 = time.perf_counter()
+            # a boundary output is never donated (speculation held its
+            # input back, see the donate policy below): it anchors
+            # retries/crash dumps and the writer can still fetch it
+            anchor_state, anchor_done = p.out, done
+            if sup.state_to_host is not None:
                 # collective on EVERY rank (multihost.gather_state) at the
-                # checkpoint cadence even with no checkpoint_dir — the crash
-                # dump's freshness rides this; only write_files ranks then
-                # touch the filesystem
-                last_host_state, last_host_done = sup.state_to_host(state), done
-            if at_boundary and sup.checkpoint_dir:
-                to_save = state if sup.state_to_host is None else last_host_state
-                if sup.write_files:
-                    path = _ckpt_path(sup.checkpoint_dir, start_tick + done)
+                # checkpoint cadence even with no checkpoint_dir — the
+                # crash dump's freshness rides this; main thread only
+                last_host_state = sup.state_to_host(p.out)
+                last_host_done = done
+            if sup.checkpoint_dir and sup.write_files:
+                to_save = p.out if sup.state_to_host is None \
+                    else last_host_state
+                path = _ckpt_path(sup.checkpoint_dir, start_tick + done)
+                report.checkpoints.append(path)
+                report.log("checkpoint", tick=start_tick + done, path=path)
+
+                def save(to_save=to_save, path=path):
                     os.makedirs(sup.checkpoint_dir, exist_ok=True)
-                    checkpoint.save(path, to_save, cfg=cfg)   # crash-atomic
-                    report.checkpoints.append(path)
-                    report.log("checkpoint", tick=start_tick + done, path=path)
-                    if journal is not None:
-                        journal.note("checkpoint", tick=start_tick + done,
-                                     path=path)
-                    _prune_checkpoints(sup.checkpoint_dir, sup.keep_checkpoints)
-            if at_boundary:
-                next_ckpt = done + every
-            if window_end:
-                # clean window end: the caller resumes the same (key, n_ticks)
-                # schedule later — the per-tick keys are a function of BOTH,
-                # so a resumed run must re-request the full n_ticks
-                report.log("window_end", chunks=report.chunks_run,
-                           tick=start_tick + done)
-                break
+                    checkpoint.save(path, to_save, cfg=cfg)  # crash-atomic
+                    _prune_checkpoints(sup.checkpoint_dir,
+                                       sup.keep_checkpoints)
+                writer.submit(save)
+                if journal is not None:
+                    writer.submit(lambda t=start_tick + done, pth=path:
+                                  journal.note("checkpoint", tick=t,
+                                               path=pth))
+            next_ckpt = done + every
+            # the main-thread stall this boundary cost (bench.py's
+            # per-checkpoint visible pause): submits under the async
+            # writer, the full serialization+fsync inline otherwise
+            report.log("boundary", tick=start_tick + done,
+                       pause_ms=round((time.perf_counter() - pause_t0)
+                                      * 1e3, 3))
+        if window_end:
+            # clean window end: the caller resumes the same (key, n_ticks)
+            # schedule later — the per-tick keys are a function of BOTH,
+            # so a resumed run must re-request the full n_ticks
+            report.log("window_end", chunks=report.chunks_run,
+                       tick=start_tick + done)
+            window_end_hit = True
+
+    try:
+        while done < n_ticks and not window_end_hit:
+            # ---- refill: nothing in flight → dispatch the next chunk
+            if pend is None:
+                if _is_deleted(carry):
+                    # a donating dispatch consumed the carry before its
+                    # chunk failed: fall back to the undonated anchor
+                    carry, carry_done = anchor_state, anchor_done
+                if carry_done < done:
+                    # replay the already-confirmed gap a retry left when
+                    # it landed on a donated input: same keys, bit-exact,
+                    # NO journal/report side effects (those ticks are
+                    # already counted) and no fault hook (not an attempt)
+                    cu_info = {"chunk_start": start_tick + carry_done,
+                               "chunk_ticks": done - carry_done,
+                               "attempt": failures, "catchup": True,
+                               "degrade_level": report.degrade_level}
+                    try:
+                        cu = dispatch(carry, carry_done, done - carry_done,
+                                      cu_info, donate=False, hook=None)
+                        _confirm(cu, sup, scale=max(
+                            1.0, (done - carry_done) / chunk_ticks))
+                    except Exception as e:
+                        handle_failure(e, cu_info, carry_done,
+                                       done - carry_done, carry, carry_done)
+                        continue
+                    report.log("catchup", **cu_info)
+                    carry, carry_done = cu.out, done
+                this_chunk = min(chunk_ticks, n_ticks - done)
+                info = {"chunk_start": start_tick + done,
+                        "chunk_ticks": this_chunk, "attempt": failures,
+                        "degrade_level": report.degrade_level}
+                try:
+                    pend = dispatch(carry, done, this_chunk, info,
+                                    donate=False)
+                except Exception as e:
+                    handle_failure(e, info, done, this_chunk, carry, done)
+                    continue
+
+            # ---- speculate: launch chunk k+1 while chunk k is in flight
+            spec: _Pending | None = None
+            spec_exc = None
+            p_end = done + pend.ticks
+            window_after = sup.max_chunks is not None \
+                and report.chunks_run + 1 >= sup.max_chunks
+            # the input of a boundary-ending chunk stays undonated: its
+            # output is the checkpoint/anchor the writer fetches off-path
+            p_boundary = p_end >= next_ckpt or p_end >= n_ticks \
+                or window_after
+            if pipelined and failures == 0 and p_end < n_ticks \
+                    and not window_after:
+                s_ticks = min(chunk_ticks, n_ticks - p_end)
+                s_info = {"chunk_start": start_tick + p_end,
+                          "chunk_ticks": s_ticks, "attempt": 0,
+                          "degrade_level": report.degrade_level}
+                donate = not p_boundary and sup.run_fn is None
+                try:
+                    spec = dispatch(pend.out, p_end, s_ticks, s_info,
+                                    donate=donate)
+                except Exception as e:
+                    spec_exc = (e, s_info, s_ticks)
+                except BaseException:
+                    # KeyboardInterrupt/SystemExit mid-overlap: chunk k is
+                    # still good — confirm it and push its journal rows
+                    # and checkpoint through the writer so a kill resumes
+                    # from the last DRAINED checkpoint, then let the
+                    # interrupt go (the finally below stops the writer)
+                    try:
+                        _confirm(pend, sup)
+                        process(pend)
+                        writer.drain(raise_errors=False)
+                    except Exception:
+                        pass
+                    raise
+
+            # ---- confirm chunk k (re-anchored deadline) and fold it in
+            try:
+                _confirm(pend, sup)
+            except Exception as e:
+                if spec is not None or spec_exc is not None:
+                    # the in-flight k+1 consumed a poisoned input: its
+                    # result is discarded unseen (bit-exact retry — the
+                    # confirmed carry chain never includes it)
+                    report.log("spec_discarded",
+                               chunk_start=start_tick + p_end)
+                info, ticks, src = pend.info, pend.ticks, pend.src
+                pend, spec, spec_exc = None, None, None
+                handle_failure(e, info, done, ticks, src, done)
+                # reset the carry for the retry: the direct input when it
+                # survived, else the anchor (+ silent catch-up above)
+                if src is not None and not _is_deleted(src):
+                    carry, carry_done = src, done
+                else:
+                    carry, carry_done = anchor_state, anchor_done
+                continue
+            process(pend)
+            pend = None
+            if spec_exc is not None:
+                e, s_info, s_ticks = spec_exc
+                handle_failure(e, s_info, done, s_ticks, carry, done)
+                continue
+            pend = spec
+
         if journal is not None:
             # terminal marker: a bounded-window stop (max_chunks) is a
             # PAUSE the caller resumes — the dashboard keeps tailing a
             # "window_end" journal; only true completion is "run_end"
-            journal.note("window_end" if done < n_ticks else "run_end",
-                         tick=start_tick + done, chunks=report.chunks_run)
+            writer.submit(lambda: journal.note(
+                "window_end" if done < n_ticks else "run_end",
+                tick=start_tick + done, chunks=report.chunks_run))
+        # drain barrier at window end: every checkpoint is durable and the
+        # journal fsync'd before the caller sees the final state (a
+        # deferred writer error — failed checkpoint save — raises here,
+        # where the synchronous path would have raised at the site)
+        writer.drain()
     finally:
-        # close no matter how the loop left — a checkpoint-save error or
-        # a KeyboardInterrupt in a backoff sleep must not leak the fd
-        # (the crash branch already noted its marker before raising)
+        # stop the writer and close the journal no matter how the loop
+        # left — a checkpoint-save error or a KeyboardInterrupt in a
+        # backoff sleep must not leak the thread or the fd (the crash
+        # branch already drained and noted its marker before raising)
+        writer.close()
         if journal is not None:
             journal.close()
-    return state, report
+    return carry, report

@@ -231,13 +231,10 @@ def health_columns(n_topics: int) -> list:
     return [(nm, nm in _INT_COLS) for nm in names]
 
 
-def _fetch(x) -> np.ndarray:
-    """Host value of a record leaf; a multi-process replicated global
-    array is not fully addressable — read the local replica (every
-    process holds the same aggregates by construction)."""
-    if getattr(x, "is_fully_addressable", True):
-        return np.asarray(x)
-    return np.asarray(x.addressable_shards[0].data)
+# host value of a record leaf (sim/hostio.py is the shared unwrap: a
+# multi-process replicated global array is not fully addressable, so the
+# local replica is read instead)
+from .hostio import fetch_local as _fetch  # noqa: E402
 
 
 def records_to_rows(records: HealthRecord,
@@ -337,15 +334,27 @@ class HealthJournal:
     Line kinds: ``run`` (header: config fingerprint, shape, schema),
     ``chunk`` (one per streamed chunk: window bounds + wall-clock stamp —
     the dashboard's hb/s source), ``health`` (the record rows),
-    ``checkpoint`` / ``crash`` markers. Every append ends in
+    ``checkpoint`` / ``crash`` markers. By default every append ends in
     flush+fsync, so a kill leaves at most one torn tail line —
     :func:`read_journal` skips it and a resume keeps appending (readers
-    dedup health rows by ``(member, tick)``, last wins)."""
+    dedup health rows by ``(member, tick)``, last wins).
 
-    def __init__(self, path: str, prefer_native: bool = True):
+    ``sync_every_write=False`` is the async supervisor's writer-thread
+    mode (ISSUE 12): appends still flush to the OS in order (the marker
+    discipline — a chunk line only exists once its device result was
+    confirmed good), but the fsync is batched into an explicit
+    :func:`sync` the writer issues once per queue drain instead of per
+    chunk line. A crash between drains loses at most the un-synced tail,
+    which the torn-tail reader and the ``(member, tick)`` dedup already
+    absorb — the same contract a single torn line always had."""
+
+    def __init__(self, path: str, prefer_native: bool = True,
+                 sync_every_write: bool = True):
         self.path = path
         self.prefer_native = prefer_native
+        self.sync_every_write = sync_every_write
         self.encoder = "python"
+        self._dirty = False
         d = os.path.dirname(os.path.abspath(path))
         os.makedirs(d, exist_ok=True)
         self._fh = open(path, "ab")
@@ -353,7 +362,18 @@ class HealthJournal:
     def _write(self, payload: bytes) -> None:
         self._fh.write(payload)
         self._fh.flush()
-        os.fsync(self._fh.fileno())
+        if self.sync_every_write:
+            os.fsync(self._fh.fileno())
+        else:
+            self._dirty = True
+
+    def sync(self) -> None:
+        """fsync everything appended since the last sync (the batched
+        counterpart of the default per-write fsync)."""
+        if self._dirty and not self._fh.closed:
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._dirty = False
 
     def note(self, kind: str, **meta) -> None:
         self._write((json.dumps({"kind": kind, "wall": time.time(),
@@ -398,6 +418,7 @@ class HealthJournal:
 
     def close(self) -> None:
         if not self._fh.closed:
+            self.sync()
             self._fh.close()
 
     def __enter__(self):

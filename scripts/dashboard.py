@@ -76,15 +76,21 @@ def _topic_fracs(row: dict) -> list:
 
 
 def _hbps(chunks: list, window: int = 8):
-    """Recent heartbeats/sec from consecutive chunk markers: each marker
-    stamps wall time at append, so rows/(wall delta) prices the chunk
-    INCLUDING its journal write. ``rows`` is member-ticks (ticks × active
-    members under fleet, == ticks unbatched), so the number is the
-    AGGREGATE rate — comparable to bench.py's metric lines, fleet
-    included. Median of the last few deltas."""
+    """Recent heartbeats/sec from consecutive chunk markers: prefer each
+    marker's ``done_wall`` — stamped when the chunk's DEVICE result was
+    confirmed — over ``wall`` (stamped at journal append). Under the
+    async supervisor the writer thread appends markers in bursts whenever
+    its queue drains, so append-time deltas alias to ~0 or the whole
+    burst; dispatch-complete deltas price the device work itself. Old
+    journals (no ``done_wall`` field) fall back to ``wall`` per stamp.
+    ``rows`` is member-ticks (ticks × active members under fleet, ==
+    ticks unbatched), so the number is the AGGREGATE rate — comparable
+    to bench.py's metric lines, fleet included. Median of the last few
+    deltas."""
     rates = []
     for a, b in list(zip(chunks, chunks[1:]))[-window:]:
-        dt = b.get("wall", 0) - a.get("wall", 0)
+        dt = (b.get("done_wall") or b.get("wall", 0)) \
+            - (a.get("done_wall") or a.get("wall", 0))
         ticks = b.get("rows") or b.get("ticks") or 0
         if dt > 0 and ticks:
             rates.append(ticks / dt)

@@ -14,6 +14,7 @@ for step in "supervisor_smoke:python scripts/supervisor_smoke.py" \
             "bench_frontier:env BENCH_SCENARIOS=frontier_250k,frontier_500k,frontier_1m GRAFT_DEADLINE_S=900 python bench.py" \
             "sweep_scores:env SWEEP_JOURNAL=/tmp/tpu_recheck/sweep_scores.jsonl python scripts/sweep_scores.py --write-perf-model" \
             "telemetry:env BENCH_SCENARIOS=telemetry_1k,telemetry_10k python bench.py" \
+            "bench_overlap:env BENCH_SCENARIOS=supervised_overlap_1k,supervised_overlap_10k python bench.py" \
             "bench_attacks:env BENCH_SCENARIOS=eclipse_50k,flashcrowd_50k python bench.py" \
             "modes_sort:env GRAFT_EDGE_GATHER=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_mxu:env GRAFT_EDGE_GATHER=mxu BENCH_SCENARIOS=10k_beacon,headline python bench.py" \

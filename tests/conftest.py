@@ -99,6 +99,12 @@ _SLOW = {
                       "test_crash_dump_carries_per_member_flags",
                       "test_score_weight_variants_batch_together",
                       "test_record_member_with_flags_is_not_retired"),
+    # latency-hiding pipeline (ISSUE 12): the plain-plane parity/failure/
+    # kill/writer lenses stay tier-1 (shapes shared with test_supervisor);
+    # the fleet and 8-device sharded overlap parities are belt-and-braces
+    # (test_fleet/test_telemetry already exercise those planes under the
+    # async default in tier-1)
+    "test_overlap.py": ("TestFleetOverlap", "TestShardedOverlap"),
     # streaming telemetry plane (ISSUE 9): the core parity lenses (plain
     # scan, supervised chunked journal, fleet per-member) + encoders +
     # dashboard smoke stay tier-1; the retry/no-double-count and traced-

@@ -49,7 +49,7 @@ def test_bench_emits_driver_parseable_json():
 
 
 def test_full_suite_fits_budget_at_reduced_n():
-    """All 16 configs at reduced N must complete, rc=0, within
+    """All 18 configs at reduced N must complete, rc=0, within
     BENCH_TOTAL_BUDGET on CPU — the structural guarantee that the r5
     timeout (rc=124, headline line missing) cannot recur. Every metric
     line must be present, the 100k_default headline first AND last.
@@ -66,8 +66,8 @@ def test_full_suite_fits_budget_at_reduced_n():
         timeout=budget + 120)
     assert res.returncode == 0, res.stderr[-500:]
     assert elapsed < budget, f"suite blew the budget: {elapsed:.0f}s"
-    # 16 configs + the headline re-emit
-    assert len(metrics) == 17, [m["metric"] for m in metrics]
+    # 18 configs + the headline re-emit
+    assert len(metrics) == 19, [m["metric"] for m in metrics]
     for m in metrics:
         assert m["value"] > 0, m
         # every record carries the memory accounting (ISSUE 8 satellite)
@@ -82,6 +82,8 @@ def test_full_suite_fits_budget_at_reduced_n():
                      "frontier_250k_capped_0k", "frontier_500k_capped_0k",
                      "frontier_1m_capped_0k",
                      "telemetry_1k_capped_0k", "telemetry_10k_capped_0k",
+                     "supervised_overlap_1k_capped_0k",
+                     "supervised_overlap_10k_capped_0k",
                      "eclipse_50k_capped_0k", "flashcrowd_50k_capped_0k"}
     fleet = next(m for m in metrics if "fleet_4x0k" in m["metric"])
     assert fleet["fleet_size"] == 4
@@ -90,7 +92,13 @@ def test_full_suite_fits_budget_at_reduced_n():
     # present so the PERF_MODEL table can always be rebuilt from a record
     tele = next(m for m in metrics if "telemetry_1k" in m["metric"])
     assert tele["untraced_hbps"] > 0 and tele["json_sink_hbps"] > 0
-    assert tele["device_py_hbps"] > 0
+    assert tele["device_py_hbps"] > 0 and tele["batched_fsync_hbps"] > 0
+    # the supervised-overlap line (ISSUE 12): all three measurement legs
+    # present so PERF_MODEL's table can always be rebuilt from a record
+    ovl = next(m for m in metrics
+               if "supervised_overlap_1k" in m["metric"])
+    assert ovl["unsupervised_hbps"] > 0 and ovl["sync_hbps"] > 0
+    assert ovl["async_hbps"] > 0 and ovl["cadence_sweep"]
 
 
 def test_sigterm_flushes_partial_record():

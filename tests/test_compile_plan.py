@@ -1,0 +1,154 @@
+"""The centralized compile plan (parallel/compile_plan.py, ISSUE 12).
+
+Two audits: (a) donation — every plane's chunk executable exists in a
+donated flavor that really aliases its carried state (and the undonated
+flavor really doesn't: a silently-donating executable would delete the
+supervisor's retry anchors out from under it); (b) ownership — no plane
+compiles its own shardings outside compile_plan.py.
+"""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.parallel import compile_plan
+from go_libp2p_pubsub_tpu.sim import (SimConfig, TopicParams, init_state,
+                                      topology)
+from go_libp2p_pubsub_tpu.sim.engine import run_keys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = SimConfig(n_peers=64, k_slots=8, n_topics=1, msg_window=32,
+                    publishers_per_tick=2, prop_substeps=4,
+                    scoring_enabled=True)
+    tp = TopicParams.disabled(1)
+    st = init_state(cfg, topology.sparse(64, 8, degree=3))
+    keys = jax.random.split(jax.random.PRNGKey(0), 5)
+    return cfg, tp, st, keys
+
+
+class TestDonationAudit:
+    def test_engine_chunk_flavors(self, tiny):
+        cfg, tp, st, keys = tiny
+        donating = compile_plan.engine_chunk(cfg, st, tp, keys, donate=True)
+        plain = compile_plan.engine_chunk(cfg, st, tp, keys, donate=False)
+        assert compile_plan.donated_param_count(donating) >= 1
+        assert compile_plan.donated_param_count(plain) == 0
+
+    def test_engine_window_flavors(self, tiny):
+        cfg, tp, st, _ = tiny
+        fcfg = dataclasses.replace(cfg, key_schedule="fold_in")
+        key = jax.random.PRNGKey(0)
+        donating = compile_plan.engine_window(fcfg, st, tp, key, 5,
+                                              donate=True)
+        plain = compile_plan.engine_window(fcfg, st, tp, key, 5,
+                                           donate=False)
+        assert compile_plan.donated_param_count(donating) >= 1
+        assert compile_plan.donated_param_count(plain) == 0
+
+    def test_donated_executable_still_computes(self, tiny):
+        """Donation changes buffer ownership, not the trajectory: the
+        donated flavor (fed a copy it may consume) matches run_keys."""
+        cfg, tp, st, keys = tiny
+        ref = run_keys(st, cfg, tp, keys)
+        exe = compile_plan.engine_chunk(cfg, st, tp, keys, donate=True)
+        out = exe(jax.tree.map(jnp.copy, st), tp, keys)
+        for f, x, y in zip(ref._fields, ref, out):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                          err_msg=f"field {f}")
+
+    def test_fleet_entry_never_donates(self, tiny):
+        """The fleet plane's dispatch entry point must donate NOTHING
+        (failed windows retry from the intact full state); the donated
+        bench flavor is audited as the positive control. Lowering only —
+        AOT-compiling the fleet scan is the known const-hoisting hazard
+        (compile_plan module docstring)."""
+        from go_libp2p_pubsub_tpu.sim.fleet import (fleet_run_keys,
+                                                    fleet_run_keys_donated,
+                                                    stack_states)
+        cfg, tp, st, _ = tiny
+        states = stack_states([st, st])
+        tps = stack_states([tp, tp])
+        keys = jax.random.split(jax.random.PRNGKey(1), 3 * 2)
+        keys = keys.reshape(3, 2, 2)
+        run_fn, _ = compile_plan.fleet_chunk(cfg, keys.shape, keys.dtype)
+        assert run_fn is fleet_run_keys
+        plain = run_fn.lower(states, cfg, tps, keys)
+        donated = fleet_run_keys_donated.lower(states, cfg, tps, keys)
+        assert compile_plan.donated_param_count(plain) == 0
+        assert compile_plan.donated_param_count(donated) >= 1
+
+    @pytest.mark.slow
+    def test_sharded_chunk_flavors(self, tiny):
+        """Lowering-level audit of the 8-device sharded scan (the
+        multihost execution unit): the donate flavor aliases the carried
+        state, the default doesn't."""
+        from go_libp2p_pubsub_tpu.parallel.sharding import (make_mesh,
+                                                            shard_state)
+        cfg, tp, st, keys = tiny
+        mesh = make_mesh()
+        st_sh = shard_state(st, mesh, cfg)
+        donating = compile_plan.sharded_chunk_plan(mesh, cfg, tp,
+                                                   donate=True)
+        plain = compile_plan.sharded_chunk_plan(mesh, cfg, tp)
+        assert compile_plan.donated_param_count(
+            donating.lower(st_sh, keys)) >= 1
+        assert compile_plan.donated_param_count(
+            plain.lower(st_sh, keys)) == 0
+
+
+class TestPlanBookkeeping:
+    def test_engine_aot_cache_reuses_executables(self, tiny):
+        cfg, tp, st, keys = tiny
+        a = compile_plan.engine_chunk(cfg, st, tp, keys)
+        b = compile_plan.engine_chunk(cfg, st, tp, keys)
+        assert a is b       # same (cfg, shape, lane, flavor) → same exe
+        c = compile_plan.engine_chunk(cfg, st, tp, keys[:3])
+        assert c is not a   # tail-chunk shape is its own entry
+
+    def test_fleet_first_use_marks_on_demand(self):
+        """mark=False is a pure query (the async fleet driver marks on
+        CONFIRM, so a window that dies mid-compile keeps its compile
+        deadline on retry)."""
+        cfg = SimConfig(n_peers=64, k_slots=8, n_topics=1, msg_window=32)
+        compile_plan.clear_caches()
+        try:
+            shape, dt = (3, 2, 2), "uint32"
+            assert compile_plan.fleet_chunk(cfg, shape, dt,
+                                            mark=False)[1] is True
+            # the query did NOT consume the first use
+            assert compile_plan.fleet_chunk(cfg, shape, dt,
+                                            mark=False)[1] is True
+            assert compile_plan.fleet_chunk(cfg, shape, dt)[1] is True
+            assert compile_plan.fleet_chunk(cfg, shape, dt)[1] is False
+            # a different window shape is its own first use
+            assert compile_plan.fleet_chunk(cfg, (2, 2, 2), dt)[1] is True
+        finally:
+            compile_plan.clear_caches()
+
+
+class TestShardingOwnership:
+    def test_no_plane_compiles_its_own_shardings(self):
+        """The tentpole's ownership contract: compile_plan.py is the ONE
+        source file that binds in_shardings — every other plane goes
+        through its factories."""
+        offenders = []
+        for root in ("go_libp2p_pubsub_tpu", "scripts"):
+            for dirpath, _, names in os.walk(os.path.join(REPO, root)):
+                for name in names:
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, name)
+                    with open(path, encoding="utf-8") as f:
+                        if "in_shardings=" in f.read():
+                            offenders.append(os.path.relpath(path, REPO))
+        assert offenders == [
+            os.path.join("go_libp2p_pubsub_tpu", "parallel",
+                         "compile_plan.py")]

@@ -1,0 +1,302 @@
+"""Latency-hiding supervisor pipeline (ISSUE 12).
+
+The correctness claim of the async lane: double-buffered dispatch,
+speculation, the off-path writer thread, and on-device key generation
+change WHEN work happens, never WHAT is computed — the final state of an
+``async_chunks=True`` run is bit-identical to the synchronous supervised
+run and to the unsupervised single scan, on every plane (plain / fleet /
+sharded), through failures mid-overlap, donated-input retries, kills,
+and writer backpressure.
+
+Shapes are harmonized with test_supervisor.py (64 peers, chunk 5) so the
+chunk executables come out of the shared AOT cache.
+"""
+
+import dataclasses
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import (SimConfig, TopicParams, init_state,
+                                      topology)
+from go_libp2p_pubsub_tpu.sim import checkpoint
+from go_libp2p_pubsub_tpu.sim import supervisor as supervisor_mod
+from go_libp2p_pubsub_tpu.sim.engine import run
+from go_libp2p_pubsub_tpu.sim.supervisor import (ChunkDeadline,
+                                                 SupervisorConfig,
+                                                 supervised_run)
+
+pytestmark = pytest.mark.supervisor
+
+N_TICKS = 20
+
+
+def _assert_states_equal(a, b):
+    for f, x, y in zip(a._fields, a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"field {f}")
+
+
+@pytest.fixture(scope="module")
+def plain():
+    """Same tiny config as test_supervisor.py (shared jit cache), with a
+    20-tick reference so the chunk-5 pipeline gets a boundary mid-run
+    (ckpt cadence 10) AND donated mid-cadence chunks on both sides."""
+    cfg = SimConfig(n_peers=64, k_slots=8, n_topics=1, msg_window=32,
+                    publishers_per_tick=2, prop_substeps=4,
+                    scoring_enabled=True)
+    tp = TopicParams.disabled(1)
+    st = init_state(cfg, topology.sparse(64, 8, degree=3))
+    key = jax.random.PRNGKey(42)
+    return cfg, tp, st, key, run(st, cfg, tp, key, N_TICKS)
+
+
+def _sup(asynch, **kw):
+    kw.setdefault("chunk_ticks", 5)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    kw.setdefault("async_chunks", asynch)
+    return SupervisorConfig(**kw)
+
+
+def _events(rep, name):
+    return [e for e in rep.events if e["event"] == name]
+
+
+class TestAsyncParity:
+    def test_async_equals_sync_equals_unsupervised(self, plain, tmp_path):
+        """THE acceptance case: with checkpoints on a mid-run cadence
+        (mid-cadence chunk outputs are donated into their successor's
+        dispatch), the async pipeline lands bit-identical to both the
+        synchronous supervised run and the plain single scan."""
+        cfg, tp, st, key, ref = plain
+        out_a, rep_a = supervised_run(
+            st, cfg, tp, key, N_TICKS,
+            _sup(True, checkpoint_dir=str(tmp_path / "a"),
+                 checkpoint_every_ticks=10))
+        out_s, rep_s = supervised_run(
+            st, cfg, tp, key, N_TICKS,
+            _sup(False, checkpoint_dir=str(tmp_path / "s"),
+                 checkpoint_every_ticks=10))
+        _assert_states_equal(ref, out_a)
+        _assert_states_equal(out_a, out_s)
+        assert rep_a.chunks_run == rep_s.chunks_run == 4
+        assert rep_a.retries == 0
+        # both wrote the same checkpoint cadence
+        assert len(rep_a.checkpoints) == len(rep_s.checkpoints) == 2
+
+    def test_fold_in_schedule_parity(self, plain):
+        """key_schedule="fold_in" (per-tick keys derived ON DEVICE from
+        the master key + carried tick): supervised async == supervised
+        sync == engine.run under the same schedule."""
+        cfg, tp, st, key, _ = plain
+        fcfg = dataclasses.replace(cfg, key_schedule="fold_in")
+        ref = run(st, fcfg, tp, key, N_TICKS)
+        out_a, rep_a = supervised_run(st, fcfg, tp, key, N_TICKS,
+                                      _sup(True))
+        out_s, _ = supervised_run(st, fcfg, tp, key, N_TICKS, _sup(False))
+        _assert_states_equal(ref, out_a)
+        _assert_states_equal(out_a, out_s)
+        assert rep_a.retries == 0
+
+
+class TestOverlapFailures:
+    def test_spec_dispatch_failure_discards_and_retries(self, plain):
+        """A speculative dispatch that fails must not poison chunk k:
+        k's result is kept, the in-flight k+1 is discarded, and the
+        retry of k+1 is bit-exact."""
+        cfg, tp, st, key, ref = plain
+
+        def boom(info):
+            if info["chunk_start"] == 10 and info["attempt"] == 0:
+                raise RuntimeError("injected overlap fault")
+
+        out, rep = supervised_run(st, cfg, tp, key, N_TICKS, _sup(True),
+                                  _chunk_hook=boom)
+        _assert_states_equal(ref, out)
+        assert rep.retries == 1
+        assert len(_events(rep, "chunk_failed")) == 1
+        # the confirmed carry chain never includes the failed attempt
+        assert rep.ticks_run == N_TICKS and rep.chunks_run == 4
+
+    def test_confirm_failure_on_donated_input_catches_up(self, plain,
+                                                         monkeypatch):
+        """The hard donation case: chunk k=[5,10)'s input ([0,5).out,
+        mid-cadence under ckpt_every=10) was donated into k's own
+        dispatch, and k+1=[10,15) is already in flight when k's
+        confirmation trips the watchdog. The retry lands on a deleted
+        input, silently replays [0,5) from the anchor (the "catchup"
+        event — no journal/report double-count), discards the in-flight
+        speculation unseen ("spec_discarded"), and still finishes
+        bit-exact."""
+        cfg, tp, st, key, ref = plain
+        real = supervisor_mod._confirm
+        tripped = []
+
+        def flaky(pend, sup, scale=1.0):
+            if pend.info.get("chunk_start") == 5 and not tripped:
+                tripped.append(1)
+                raise ChunkDeadline("injected confirm deadline")
+            return real(pend, sup, scale)
+
+        monkeypatch.setattr(supervisor_mod, "_confirm", flaky)
+        out, rep = supervised_run(st, cfg, tp, key, N_TICKS,
+                                  _sup(True, checkpoint_every_ticks=10))
+        _assert_states_equal(ref, out)
+        assert rep.retries == 1
+        assert len(_events(rep, "spec_discarded")) == 1
+        assert len(_events(rep, "catchup")) == 1
+        # counters only ever saw confirmed chunks: the replay is silent
+        assert rep.ticks_run == N_TICKS and rep.chunks_run == 4
+
+    def test_kill_mid_overlap_resumes_from_drained_checkpoint(self, plain,
+                                                              tmp_path):
+        """A kill arriving while chunk k+1 speculates must not lose the
+        already-confirmed work: chunk k is confirmed and its writes
+        drained before the interrupt escapes, so the resume picks up the
+        last durable checkpoint."""
+        cfg, tp, st, key, ref = plain
+        ck = str(tmp_path / "ck")
+
+        def kill(info):
+            if info["chunk_start"] >= 15:
+                raise KeyboardInterrupt("simulated preemption")
+
+        with pytest.raises(KeyboardInterrupt):
+            supervised_run(st, cfg, tp, key, N_TICKS,
+                           _sup(True, checkpoint_dir=ck,
+                                checkpoint_every_ticks=10),
+                           _chunk_hook=kill)
+        out, rep = supervised_run(st, cfg, tp, key, N_TICKS,
+                                  _sup(True, checkpoint_dir=ck,
+                                       checkpoint_every_ticks=10))
+        assert rep.resumed_tick == 10   # the t10 checkpoint WAS drained
+        assert rep.ticks_run == 10      # only [10, 20) re-ran
+        _assert_states_equal(ref, out)
+
+
+class TestWriterPlane:
+    def test_writer_backpressure_stays_bounded(self, plain, tmp_path,
+                                               monkeypatch):
+        """A slow writer (50 ms per checkpoint save) against a depth-1
+        queue: submit blocks instead of queueing unboundedly, every
+        checkpoint still lands, and the result is bit-exact."""
+        cfg, tp, st, key, ref = plain
+        depths = []
+
+        class Probe(supervisor_mod._Writer):
+            def submit(self, task):
+                if self._thread is not None:
+                    depths.append(self._q.qsize())
+                super().submit(task)
+
+        real_save = checkpoint.save
+
+        def slow_save(*a, **kw):
+            time.sleep(0.05)
+            return real_save(*a, **kw)
+
+        monkeypatch.setattr(supervisor_mod, "_Writer", Probe)
+        monkeypatch.setattr(checkpoint, "save", slow_save)
+        out, rep = supervised_run(
+            st, cfg, tp, key, N_TICKS,
+            _sup(True, checkpoint_dir=str(tmp_path / "ck"),
+                 checkpoint_every_ticks=5, writer_queue=1))
+        _assert_states_equal(ref, out)
+        assert len(rep.checkpoints) == 4
+        assert depths and max(depths) <= 1   # the bound held throughout
+        # drain barrier: the newest checkpoints are durable on return
+        from go_libp2p_pubsub_tpu.sim.supervisor import list_checkpoints
+        assert [t for _, t in list_checkpoints(str(tmp_path / "ck"))] \
+            == [15, 20]
+
+    def test_journal_chunk_markers_carry_done_wall(self, plain, tmp_path):
+        """The dashboard's honest hb/s clock: every streamed chunk
+        marker carries a dispatch-complete ``done_wall`` stamp (wall
+        stamps at append time happen in writer-thread bursts and would
+        distort rates)."""
+        cfg, tp, st, key, _ = plain
+        hp = str(tmp_path / "health.jsonl")
+        supervised_run(st, cfg, tp, key, N_TICKS,
+                       _sup(True, health_path=hp))
+        with open(hp) as f:
+            chunks = [json.loads(ln) for ln in f
+                      if ln.startswith("{") and '"kind": "chunk"' in ln]
+        assert len(chunks) == 4
+        walls = [c["done_wall"] for c in chunks]
+        assert walls == sorted(walls)
+        # confirm-time stamp precedes (or equals) the writer's append
+        assert all(c["done_wall"] <= c["wall"] for c in chunks)
+
+
+class TestFleetOverlap:
+    def test_fleet_async_parity_heterogeneous_ticks(self, plain):
+        """Fleet windows pipeline too (speculation composes _take_rows /
+        _put_rows on in-flight futures): async == sync == per-member
+        engine.run, across a compaction boundary (member finishing
+        mid-run shrinks the batch)."""
+        from go_libp2p_pubsub_tpu.sim.fleet import (FleetMember,
+                                                    supervised_fleet_run)
+        cfg, tp, st, _, _ = plain
+        members = [FleetMember(cfg=cfg, tp=tp, state=st,
+                               key=jax.random.PRNGKey(100 + i),
+                               n_ticks=n, name=f"m{i}")
+                   for i, n in enumerate((12, 20))]
+        refs = [run(st, cfg, tp, m.key, m.n_ticks) for m in members]
+        res_a, rep_a = supervised_fleet_run(members, _sup(True))
+        res_s, rep_s = supervised_fleet_run(members, _sup(False))
+        for ref, ra, rs in zip(refs, res_a, res_s):
+            _assert_states_equal(ref, ra.state)
+            _assert_states_equal(ra.state, rs.state)
+        assert rep_a.retries == 0
+        assert [r.ticks_run for r in res_a] == [12, 20]
+
+    def test_fleet_failure_mid_overlap_retries_bit_exact(self, plain):
+        """A window failing while its successor speculates: the in-flight
+        window is discarded (fleet never donates — the retry re-runs
+        from the intact full state) and the fleet still lands bit-exact."""
+        from go_libp2p_pubsub_tpu.sim.fleet import (FleetMember,
+                                                    supervised_fleet_run)
+        cfg, tp, st, _, _ = plain
+        members = [FleetMember(cfg=cfg, tp=tp, state=st,
+                               key=jax.random.PRNGKey(200 + i), n_ticks=15,
+                               name=f"m{i}") for i in range(2)]
+        refs = [run(st, cfg, tp, m.key, m.n_ticks) for m in members]
+
+        def boom(info):
+            if info.get("window_start") == 10 and info["attempt"] == 0:
+                raise RuntimeError("injected fleet overlap fault")
+
+        res, rep = supervised_fleet_run(members, _sup(True),
+                                        _chunk_hook=boom)
+        for ref, r in zip(refs, res):
+            _assert_states_equal(ref, r.state)
+        assert rep.retries == 1
+        assert len(_events(rep, "chunk_failed")) == 1
+
+
+class TestShardedOverlap:
+    def test_sharded_async_parity(self):
+        """The run_fn lane (the multihost sharded scan's dispatch path)
+        pipelines without donation: async supervised over the 8-device
+        sharded chunk runner == plain unsharded engine.run."""
+        from go_libp2p_pubsub_tpu.parallel.sharding import (
+            make_mesh, make_sharded_run_keys, shard_state)
+        from go_libp2p_pubsub_tpu.sim import scenarios
+
+        cfg, tp, topo, sub = scenarios.frontier_spec(128)
+        st = init_state(cfg, topo, subscribed=sub)
+        key = jax.random.PRNGKey(11)
+        ref = run(st, cfg, tp, key, 10)
+        mesh = make_mesh()
+        runner = make_sharded_run_keys(mesh, cfg, tp)
+        out, rep = supervised_run(
+            shard_state(st, mesh, cfg), cfg, tp, key, 10,
+            _sup(True, max_retries=0,
+                 run_fn=lambda state, exec_cfg, tp_arg, keys:
+                     runner(state, keys, tp_arg)))
+        _assert_states_equal(ref, out)
+        assert rep.chunks_run == 2 and rep.retries == 0
