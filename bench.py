@@ -108,9 +108,12 @@ def _memory_record(cfg, fleet: int = 1) -> dict:
             "state_nbytes": state_nbytes(cfg)["total"] * fleet}
 
 
-def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
+def bench_one(name, cfg, tp, st, ticks, repeats, extra=None) -> str:
     """Run one config and print its JSON metric line; returns the line so
-    callers can re-emit the headline last (the one-line-parse contract)."""
+    callers can re-emit the headline last (the one-line-parse contract).
+    ``extra`` merges additional record keys (e.g. the host-side
+    construction cost the frontier family pays before the first
+    dispatch)."""
     import jax
     import numpy as np
     from go_libp2p_pubsub_tpu.sim.engine import (
@@ -176,6 +179,7 @@ def bench_one(name, cfg, tp, st, ticks, repeats) -> str:
         # measured per-process device memory + the modeled state estimate
         # (ISSUE 8: HBM-wall claims measured, not modeled)
         **_memory_record(cfg),
+        **(extra or {}),
     })
     print(line, flush=True)
     return line
@@ -185,6 +189,7 @@ NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
          "50k_churn_gater_px", "100k_sybil20", "100k_floodsub",
          "100k_randomsub", "100k_gossipsub_sweep",
          "frontier_250k", "frontier_500k", "frontier_1m",
+         "frontier_4m", "frontier_10m",
          "telemetry_1k", "telemetry_10k",
          "supervised_overlap_1k", "supervised_overlap_10k",
          "eclipse_50k", "flashcrowd_50k", "headline"]
@@ -207,6 +212,9 @@ TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60,
                  # frontier family (ROADMAP item 1): short windows — the
                  # per-tick cost at 250k+ dwarfs the dispatch RTT
                  "frontier_250k": 10, "frontier_500k": 5, "frontier_1m": 3,
+                 # XL tier (ISSUE 13): compact storage precision; per-tick
+                 # cost dominates everything — minimum honest window
+                 "frontier_4m": 2, "frontier_10m": 2,
                  # tracing-overhead A/B (ROADMAP item 5): windows long
                  # enough that the per-chunk journal write is amortized
                  # the way a real supervised stream amortizes it
@@ -642,11 +650,20 @@ def run_scenario(name: str) -> str | None:
                       k_slots=int(os.environ.get("BENCH_K", 32)),
                       degree=12, msg_window=64, publishers=8)
 
-    def _frontier(full_n):
+    def _frontier(full_n, **kw):
         # the frontier family's full peer counts live in
         # scenarios.FRONTIER_NS; BENCH_MAX_N gates them for reduced-N
-        # contract runs exactly like every other scenario
-        return scenarios.frontier(_cap_n(full_n))
+        # contract runs exactly like every other scenario. The state is
+        # PRICED before a single array allocates (sim/state.
+        # check_hbm_budget): with GRAFT_HBM_BUDGET set, an over-budget
+        # frontier line refuses by name — citing its worst planes —
+        # instead of OOMing mid-suite and eating the deadline
+        from go_libp2p_pubsub_tpu.sim.state import check_hbm_budget
+        n = _cap_n(full_n)
+        pre = scenarios.frontier_cfg(
+            n, state_precision=kw.get("state_precision", "f32"))
+        check_hbm_budget(pre, 1, what=f"frontier n={n} state")
+        return scenarios.frontier(n, **kw)
 
     builders = {
         "1k_single_topic":
@@ -657,6 +674,14 @@ def run_scenario(name: str) -> str | None:
             lambda: _frontier(scenarios.FRONTIER_NS["frontier_500k"]),
         "frontier_1m":
             lambda: _frontier(scenarios.FRONTIER_NS["frontier_1m"]),
+        # XL tier (ISSUE 13): compact storage precision by construction —
+        # the f32 layout would not survive pricing at these N
+        "frontier_4m":
+            lambda: _frontier(scenarios.FRONTIER_NS["frontier_4m"],
+                              state_precision="compact"),
+        "frontier_10m":
+            lambda: _frontier(scenarios.FRONTIER_NS["frontier_10m"],
+                              state_precision="compact"),
         "10k_beacon": lambda: scenarios.beacon_10k(n_peers=_cap_n(10_000)),
         "50k_churn_gater_px":
             lambda: scenarios.churn_50k(n_peers=_cap_n(50_000)),
@@ -683,7 +708,20 @@ def run_scenario(name: str) -> str | None:
         "scenario registry drifted from NAMES"
     assert FRONTIER_FULL_N == scenarios.FRONTIER_NS, \
         "bench FRONTIER_FULL_N drifted from scenarios.FRONTIER_NS"
+    # construction cost travels with the line: at frontier scale the
+    # host-side underlay build (topology.sparse_fast, measured ~14 s at
+    # 1M×32 — sim/topology.py docstring) and its O(N·K) host RAM are a
+    # real part of the launch price, and the record is where PERF_MODEL's
+    # construction-cost table comes from. ru_maxrss is the process-lifetime
+    # peak (KiB on Linux), so it upper-bounds the build's footprint.
+    import resource
+    t_build = time.perf_counter()
     cfg, tp, st = builders[name]()
+    build_extra = {
+        "build_wall_s": round(time.perf_counter() - t_build, 2),
+        "build_peak_rss_bytes":
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024,
+    }
     mode = os.environ.get("GRAFT_EDGE_GATHER")
     if mode:
         # formulation sweep knob for scripts/tpu_recheck.sh (ops/permgather)
@@ -752,7 +790,8 @@ def run_scenario(name: str) -> str | None:
         cfg = dataclasses.replace(cfg, invariant_mode=im)
         print(json.dumps({"info": "invariant mode sweep", "requested": im}),
               flush=True)
-    return bench_one(_label(name), cfg, tp, st, ticks, repeats)
+    return bench_one(_label(name), cfg, tp, st, ticks, repeats,
+                     extra=build_extra)
 
 
 def _headline_n() -> int:
@@ -768,7 +807,8 @@ def _headline_n() -> int:
 # import jax (platform-probe discipline); run_scenario (the child, where
 # jax is live) asserts the two stay in sync
 FRONTIER_FULL_N = {"frontier_250k": 262_144, "frontier_500k": 524_288,
-                   "frontier_1m": 1_048_576}
+                   "frontier_1m": 1_048_576,
+                   "frontier_4m": 4_194_304, "frontier_10m": 10_485_760}
 
 # full peer counts of the attack family (ISSUE 10) — parent-safe like
 # FRONTIER_FULL_N; capped runs are labeled by what ran

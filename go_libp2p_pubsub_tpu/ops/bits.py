@@ -35,6 +35,21 @@ def pack_bool(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.sum(xr * shifts, axis=-1, dtype=U32)
 
 
+def unpack_bool(p: jnp.ndarray, m: int) -> jnp.ndarray:
+    """uint32 [..., ceil(m/32)] -> bool [..., m] (inverse of pack_bool).
+
+    Last-axis counterpart of :func:`unpack_words` for planes stored
+    peer-major-packed (the compact SimState bool planes, sim/state.py):
+    bit ``j%32`` of word ``j//32`` is element ``j``."""
+    *lead, w = p.shape
+    if w != n_words(m):
+        raise ValueError(
+            f"unpack_bool: packed shape {p.shape} does not carry "
+            f"ceil({m}/32)={n_words(m)} words on the last axis")
+    bits = (p[..., :, None] >> jnp.arange(32, dtype=U32)) & U32(1)
+    return bits.reshape(*lead, w * 32)[..., :m].astype(bool)
+
+
 def pack_words(x: jnp.ndarray) -> jnp.ndarray:
     """bool [N, M] -> uint32 [W, N] (word-major, peer-minor).
 

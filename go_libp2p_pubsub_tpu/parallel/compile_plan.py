@@ -178,7 +178,8 @@ def sharded_step_plan(mesh, cfg: SimConfig, tp: TopicParams):
     def _step(state: SimState, tp_arg: TopicParams,
               key: jax.Array) -> SimState:
         with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
-                         capacity_factor=cfg.halo_capacity_factor):
+                         capacity_factor=cfg.halo_capacity_factor,
+                         bucket_capacity=cfg.halo_bucket_capacity):
             return step(state, cfg, tp_arg, key)
 
     def sharded_step(state: SimState, key: jax.Array) -> SimState:
@@ -228,7 +229,8 @@ def sharded_chunk_plan(mesh, cfg: SimConfig, tp: TopicParams,
              donate_argnums=(0,) if donate else ())
     def _run(state: SimState, tp_arg: TopicParams, keys: jax.Array):
         with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
-                         capacity_factor=cfg.halo_capacity_factor):
+                         capacity_factor=cfg.halo_capacity_factor,
+                         bucket_capacity=cfg.halo_bucket_capacity):
             def body(carry, k):
                 nxt = step(carry, cfg, tp_arg, k)
                 return nxt, health_record(nxt, cfg, tp_arg) \

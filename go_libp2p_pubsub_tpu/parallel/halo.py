@@ -76,6 +76,18 @@ def _capacity_factor() -> int:
     return ctx.capacity_factor if ctx is not None else 4
 
 
+def _bucket_capacity(ld: int, n_dev: int) -> int:
+    """The static per-(src,dst)-device bucket capacity: the context's
+    EXACT ``bucket_capacity`` when set (degree-aware pricing —
+    :func:`required_bucket_capacity`'s answer for the actual underlay),
+    else the uniform-degree factor rule."""
+    ctx = current_kernel_mesh()
+    exact = ctx.bucket_capacity if ctx is not None else 0
+    if exact > 0:
+        return min(ld, exact)
+    return min(ld, _capacity_factor() * (-(-ld // n_dev)))
+
+
 def required_capacity_factor(neighbors, reverse_slot, n_dev: int) -> int:
     """The smallest INTEGER capacity factor that fits every (src,dst)
     bucket of this underlay on an ``n_dev``-way peer sharding — host-side
@@ -102,12 +114,39 @@ def required_capacity_factor(neighbors, reverse_slot, n_dev: int) -> int:
     return math.ceil(int(counts.max()) / mean_cap) if mean_cap else 0
 
 
+def required_bucket_capacity(neighbors, reverse_slot, n_dev: int) -> int:
+    """The EXACT worst (src,dst)-device bucket population of this underlay
+    on an ``n_dev``-way peer sharding — the degree-aware price, directly
+    assignable to ``SimConfig.halo_bucket_capacity``. Where the factor
+    rule prices ``factor * ceil(Ld/D)`` from a UNIFORM-degree assumption
+    (over-allocating on heavy-tailed underlays, overflowing on clustered
+    ones), this is the degree histogram's own answer: the padded exchange
+    ships ``D * max_bucket`` entries per device instead of
+    ``D * factor * ceil(Ld/D)`` — for a star-like underlay that is the
+    difference between an exact fit and a poisoned run at any factor a
+    config would dare set."""
+    nbr = np.asarray(neighbors)
+    rks = np.asarray(reverse_slot)
+    n, k = nbr.shape
+    if n_dev <= 0 or n % n_dev:
+        raise ValueError(
+            f"required_bucket_capacity: n_peers={n} must divide evenly "
+            f"over n_dev={n_dev} (the peer sharding asserts the same)")
+    nl = n // n_dev
+    valid = (nbr >= 0) & (rks >= 0)
+    src_dev = np.repeat(np.arange(n) // nl, k).reshape(n, k)
+    dest_dev = np.clip(nbr, 0, n - 1) // nl
+    pair = (src_dev * n_dev + dest_dev)[valid]
+    counts = np.bincount(pair, minlength=n_dev * n_dev)
+    return int(counts.max()) if counts.size else 0
+
+
 def _route_local(keys, dest_dev, valid, vals, ld, n_dev, axis_name):
     """keys [Ld]: global destination key per local source slot (valid
     slots: the involution target; invalid: the slot's own global index —
     both bijective, disjoint). vals: list of [Ld] payloads. Returns
     (payloads in local destination-flat order, overflowed-bucket count)."""
-    cap = min(ld, _capacity_factor() * (-(-ld // n_dev)))
+    cap = _bucket_capacity(ld, n_dev)
     dd_ext = jnp.where(valid, dest_dev, n_dev)              # invalid -> tail
     srt = jax.lax.sort((dd_ext, keys, *vals), num_keys=2)
     dd_s, keys_s = srt[0], srt[1]

@@ -41,6 +41,12 @@ class KernelMesh(NamedTuple):
                                 # (parallel/halo.py)
     capacity_factor: int = 4    # halo bucket capacity over the uniform mean
                                 # (parallel/halo.py capacity rule)
+    bucket_capacity: int = 0    # EXACT per-(src,dst) bucket capacity; 0 =
+                                # derive from capacity_factor's uniform-
+                                # degree rule. Set from halo.
+                                # required_bucket_capacity for heavy-
+                                # tailed underlays (degree-aware pricing:
+                                # neither overflow nor over-allocation)
     overflow_notes: list = None # trace-time accumulator: halo overflow
                                 # counts (outer-trace scalars) noted by
                                 # route_*_halo, drained once per step by
@@ -57,10 +63,10 @@ _current: contextvars.ContextVar[KernelMesh | None] = \
 
 @contextmanager
 def kernel_mesh(mesh: Mesh, peer_axes, route: str = "replicated",
-                capacity_factor: int = 4):
+                capacity_factor: int = 4, bucket_capacity: int = 0):
     """Activate shard_map kernel dispatch for code traced inside."""
     tok = _current.set(KernelMesh(mesh, tuple(peer_axes), route,
-                                  capacity_factor, []))
+                                  capacity_factor, bucket_capacity, []))
     try:
         yield
     finally:

@@ -120,13 +120,22 @@ def init_state_local(cfg: SimConfig, topo: Topology,
                      subscribed: np.ndarray | None = None,
                      ip_group: np.ndarray | None = None,
                      app_score: np.ndarray | None = None,
-                     malicious: np.ndarray | None = None) -> SimState:
+                     malicious: np.ndarray | None = None,
+                     topo_local: bool = False) -> SimState:
     """This process's host-local SimState shard: peer-major planes cover
     rows ``[n0, n0+nl)`` only, replicated planes (message tables, scalars)
     are full. The per-peer inputs (``subscribed`` etc.) are the GLOBAL
     host-side numpy arrays — slicing happens here, and the cached
     ``nbr_subscribed`` receiver view is computed host-side from the full
     ``subscribed`` (a local row's neighbors can live on any process).
+
+    ``topo_local=True`` declares that ``topo`` already carries ONLY this
+    process's ``[N/P, K]`` rows (a sharded build —
+    ``sim.topology.sparse_hash(..., rows=...)``), so no global topology
+    table ever exists on any host: the 10M-peer construction path. The
+    flag is explicit (not shape-sniffed) because at P=1 the two cases
+    are indistinguishable by shape but mean different things; a
+    wrong-shape ``topo`` for the declared mode raises by name.
 
     With ``process_id``/``num_processes`` omitted, the live distributed
     runtime's rank/size apply (a plain single process builds the full
@@ -137,6 +146,15 @@ def init_state_local(cfg: SimConfig, topo: Topology,
         process_id = jax.process_index()
     n, k, t = cfg.n_peers, cfg.k_slots, cfg.n_topics
     n0, nl = local_peer_rows(n, num_processes, process_id)
+    want_rows = nl if topo_local else n
+    if topo.neighbors.shape[0] != want_rows:
+        raise ValueError(
+            f"init_state_local: topo carries {topo.neighbors.shape[0]} "
+            f"rows but topo_local={topo_local} expects {want_rows} "
+            f"(n_peers={n}, {num_processes} processes)")
+    # topo arrays index locally when they ARE the rows slice already; the
+    # global per-peer inputs (subscribed etc.) always slice globally
+    trows = slice(0, nl) if topo_local else slice(n0, n0 + nl)
     rows = slice(n0, n0 + nl)
 
     if subscribed is None:
@@ -148,7 +166,7 @@ def init_state_local(cfg: SimConfig, topo: Topology,
     if malicious is None:
         malicious = np.zeros(n, bool)
 
-    nbr_l = np.asarray(topo.neighbors[rows])
+    nbr_l = np.asarray(topo.neighbors[trows])
     # receiver view of neighbor subscriptions, host-side: index the FULL
     # subscribed table with this block's (global-id) neighbor rows
     nbr_sub_l = np.transpose(
@@ -163,8 +181,8 @@ def init_state_local(cfg: SimConfig, topo: Topology,
     # it indexes the full subscription table, which only exists host-side)
     return _device_init(
         cfg,
-        jnp.asarray(nbr_l), jnp.asarray(topo.outbound[rows]),
-        jnp.asarray(topo.reverse_slot[rows]), jnp.asarray(subscribed[rows]),
+        jnp.asarray(nbr_l), jnp.asarray(topo.outbound[trows]),
+        jnp.asarray(topo.reverse_slot[trows]), jnp.asarray(subscribed[rows]),
         jnp.asarray(ip_group[rows]), jnp.asarray(app_score[rows]),
         jnp.asarray(malicious[rows]),
         nbr_subscribed=jnp.asarray(nbr_sub_l), n_rows=nl)

@@ -150,6 +150,12 @@ def save(path: str, state: SimState, cfg=None) -> None:
                 # a mismatched resume can be REJECTED BY NAME (restore
                 # below) instead of as an anonymous digest mismatch
                 f.write(f"fleet={fleet}\n")
+            # storage precision travels in clear for the same reason: a
+            # compact checkpoint restored under f32 (or vice versa) is a
+            # layout change, not a knob tweak — name it
+            precision = getattr(cfg, "state_precision", None)
+            if precision is not None:
+                f.write(f"state_precision={precision}\n")
             f.flush()
             os.fsync(f.fileno())
         _replace_path(side_tmp, _sidecar(path))
@@ -198,6 +204,17 @@ def restore(path: str, like: SimState, cfg=None) -> SimState:
                     f"{_axis(saved_fleet)} but this run expects "
                     f"{_axis(fleet)} — a fleet journal can only resume at "
                     "its own batch size (sim/fleet.py)")
+            saved_prec = meta.get("state_precision")
+            want_prec = getattr(cfg, "state_precision", None)
+            if saved_prec is not None and want_prec is not None \
+                    and saved_prec != want_prec:
+                raise ValueError(
+                    f"checkpoint {path!r} state_precision mismatch: saved "
+                    f"under {saved_prec!r} but this run expects "
+                    f"{want_prec!r} — the storage layouts differ "
+                    "(sim/state.py codecs); resume under the saved "
+                    "precision, or round-trip through decode_state/"
+                    "encode_state explicitly")
             raise ValueError(
                 f"checkpoint {path!r} was saved under a different config "
                 f"(fingerprint {stamped[:12]}… != {want[:12]}…); restoring "

@@ -216,6 +216,23 @@ class SimConfig:
     # schedule (tests/test_overlap.py); the schedules' trajectories
     # intentionally differ from each other.
     key_schedule: str = "host"
+    # stored precision of the scan carry (sim/state.py codec tables):
+    # "f32" keeps the historical layout bit-exact; "compact" stores the
+    # f32 score-counter planes as bf16 bit patterns (u16), the bounded
+    # tick planes as i16 relative-to-current-tick, the [N,*,K] bool
+    # planes bit-packed into u32 words (the `have` discipline), and the
+    # slot-index planes as i8 — compute stays f32/i32: engine.step
+    # decodes at entry and re-encodes at exit, so ops never see the
+    # narrow types. Roughly halves the per-peer HBM bytes (PERF_MODEL
+    # "Frontier memory budget"); trajectories agree within the
+    # documented tolerance (tests/test_state_precision.py)
+    state_precision: str = "f32"
+    # exact halo bucket capacity (entries per (src_dev, dest_dev)
+    # bucket). 0 = derive from halo_capacity_factor's uniform-degree
+    # rule; a positive value (e.g. halo.required_bucket_capacity's
+    # answer for a heavy-tailed underlay) overrides the factor rule so
+    # clustered topologies neither overflow nor over-allocate
+    halo_bucket_capacity: int = 0
 
     @staticmethod
     def from_params(n_peers: int, k_slots: int, n_topics: int = 1,

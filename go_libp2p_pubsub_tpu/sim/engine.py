@@ -34,7 +34,7 @@ from ..ops.gater import gater_decay
 from ..ops.heartbeat import HeartbeatOut, heartbeat
 from ..ops.propagate import forward_tick, publish
 from .config import SimConfig, TopicParams
-from .state import NEVER, SimState
+from .state import NEVER, SimState, decode_state, encode_state
 
 
 def choose_publishers(state: SimState, cfg: SimConfig, key: jax.Array
@@ -102,6 +102,11 @@ def _iwant_answer_extras(state: SimState, cfg: SimConfig,
 
 def step(state: SimState, cfg: SimConfig, tp: TopicParams,
          key: jax.Array) -> SimState:
+    # the scan carry travels in the STORED layout (sim/state.py codec
+    # tables): decode to the f32/i32 compute layout here, encode on the
+    # way out — both identities under state_precision="f32", so every op
+    # below sees the historical types under either precision
+    state = decode_state(state, cfg)
     if cfg.fault_plan is not None:
         # the fault pass opens the tick: partition/outage transitions
         # (RemovePeer down, reconnect up) plus this tick's link/corruption
@@ -184,7 +189,7 @@ def step(state: SimState, cfg: SimConfig, tp: TopicParams,
         state = record_flags(state, cfg,
                              injected=fault.injected
                              if fault is not None else None)
-    return state._replace(tick=state.tick + 1)
+    return encode_state(state._replace(tick=state.tick + 1), cfg)
 
 
 def _run_keys_impl(state: SimState, cfg: SimConfig, tp: TopicParams,
@@ -327,7 +332,12 @@ def run_checked_keys(state: SimState, cfg: SimConfig, tp: TopicParams,
 
 
 def mesh_degrees(state: SimState) -> jnp.ndarray:
-    """[N, T] current mesh degree (for convergence checks)."""
+    """[N, T] current mesh degree (for convergence checks). Accepts the
+    compact storage layout too: a packed u32 mesh plane counts by
+    popcount (pad bits are zero), no cfg needed."""
+    if state.mesh.dtype == jnp.uint32:
+        return jnp.sum(jax.lax.population_count(state.mesh),
+                       axis=-1).astype(jnp.int32)
     return jnp.sum(state.mesh, axis=-1)
 
 
@@ -369,6 +379,8 @@ def delivery_latency_ticks(state: SimState, cfg: SimConfig) -> jnp.ndarray:
     one pair per live message; receivers' genuine same-tick deliveries
     still count as latency 0. Returns 0 when nothing but publishers
     delivered."""
+    if state.deliver_tick.dtype != jnp.int32:   # compact storage layout
+        state = decode_state(state, cfg)
     alive = (state.msg_publish_tick < NEVER) & \
         ((state.tick - state.msg_publish_tick) < cfg.history_length)
     dlv = (state.deliver_tick < NEVER) & alive[None, :]

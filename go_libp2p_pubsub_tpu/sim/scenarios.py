@@ -272,11 +272,16 @@ def outage_10k(n_peers: int = 10_000, k_slots: int = 32, degree: int = 12,
 # friendly at every shard size.
 
 FRONTIER_NS = {"frontier_250k": 262_144, "frontier_500k": 524_288,
-               "frontier_1m": 1_048_576}
+               "frontier_1m": 1_048_576,
+               # XL tier: compact storage precision by construction — the
+               # f32 layout prices over any sane per-shard budget at these
+               # N (sim/state.state_nbytes, PERF_MODEL.md frontier table)
+               "frontier_4m": 4_194_304, "frontier_10m": 10_485_760}
 
 
 def frontier_cfg(n_peers: int, k_slots: int = 32, n_topics: int = 2,
-                 msg_window: int = 64) -> SimConfig:
+                 msg_window: int = 64,
+                 state_precision: str = "f32") -> SimConfig:
     """The frontier SimConfig alone — no topology build. Memory accounting
     (``state_nbytes``) needs only these shapes, so budget checks price the
     REAL scenario config without minutes of 1M underlay construction
@@ -287,12 +292,15 @@ def frontier_cfg(n_peers: int, k_slots: int = 32, n_topics: int = 2,
         scoring_enabled=True, behaviour_penalty_weight=-10.0,
         behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
         publish_threshold=-200.0, graylist_threshold=-300.0,
-        edge_gather_mode="sort", sharded_route="halo")
+        edge_gather_mode="sort", sharded_route="halo",
+        state_precision=state_precision)
 
 
 def frontier_spec(n_peers: int, k_slots: int = 32, degree: int = 8,
                   n_topics: int = 2, msg_window: int = 64,
                   subnet_fraction: float = 0.3,
+                  state_precision: str = "f32",
+                  rows: tuple[int, int] | None = None,
                   ) -> tuple[SimConfig, TopicParams, "topology.Topology",
                              np.ndarray]:
     """The frontier scenario WITHOUT device state: ``(cfg, tp, topo,
@@ -300,15 +308,29 @@ def frontier_spec(n_peers: int, k_slots: int = 32, degree: int = 8,
     ``parallel.multihost.init_state_local`` so each process builds only
     its own ``[N/P, ...]`` rows (a 1M-peer state never materializes on
     one host). Single-process callers use :func:`frontier`, which
-    composes this with ``init_state``."""
+    composes this with ``init_state``.
+
+    ``rows=(start, count)`` switches to the SHARDED construction path:
+    ``topology.sparse_hash`` materializes only those rows of the seeded
+    circulant underlay (10M peers never build a global [N, K] table on
+    any host — feed the result to ``init_state_local(...,
+    topo_local=True)``). The ``subscribed`` table stays global either
+    way: at [N, T] bool it is ~20 MB at 10M, and every process needs it
+    to compute its neighbors' subscription view."""
     cfg = frontier_cfg(n_peers, k_slots=k_slots, n_topics=n_topics,
-                       msg_window=msg_window)
+                       msg_window=msg_window,
+                       state_precision=state_precision)
     rng = np.random.default_rng(SEED)
     subscribed = np.zeros((n_peers, n_topics), dtype=bool)
     subscribed[:, 0] = True                      # one global topic
     for t in range(1, n_topics):                 # random subnets
         subscribed[:, t] = rng.random(n_peers) < subnet_fraction
-    topo = topology.sparse_fast(n_peers, k_slots, degree=degree, seed=SEED)
+    if rows is None:
+        topo = topology.sparse_fast(n_peers, k_slots, degree=degree,
+                                    seed=SEED)
+    else:
+        topo = topology.sparse_hash(n_peers, k_slots, degree=degree,
+                                    seed=SEED, rows=rows)
     return cfg, default_topic_params(n_topics), topo, subscribed
 
 
@@ -328,6 +350,26 @@ def frontier_500k(n_peers: int = FRONTIER_NS["frontier_500k"], **kw):
 
 
 def frontier_1m(n_peers: int = FRONTIER_NS["frontier_1m"], **kw):
+    return frontier(n_peers, **kw)
+
+
+def frontier_4m(n_peers: int = FRONTIER_NS["frontier_4m"], **kw):
+    """XL frontier: compact storage precision by default — the f32 layout
+    at 4M peers prices ~1.8 GiB/shard on 8 devices for state alone, and
+    10M would not fit a 16 GiB chip with transients (PERF_MODEL.md
+    frontier-memory table). Callers can still force f32 explicitly."""
+    kw.setdefault("state_precision", "compact")
+    return frontier(n_peers, **kw)
+
+
+def frontier_10m(n_peers: int = FRONTIER_NS["frontier_10m"], **kw):
+    """The 10M-peer frontier: compact storage precision and the sharded
+    construction path are the POINT of this scenario (ROADMAP item 4) —
+    full-table builds take O(N·K) host RAM, so multi-process launches
+    should pair it with the sharded topology builder
+    (``topology.sparse_hash(..., rows=...)`` via scripts/run_multihost.py
+    ``--topology sharded``)."""
+    kw.setdefault("state_precision", "compact")
     return frontier(n_peers, **kw)
 
 
@@ -445,4 +487,6 @@ SCENARIOS = {
     "frontier_250k": frontier_250k,
     "frontier_500k": frontier_500k,
     "frontier_1m": frontier_1m,
+    "frontier_4m": frontier_4m,
+    "frontier_10m": frontier_10m,
 }

@@ -15,6 +15,7 @@ Array roles (reference state being modeled):
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import NamedTuple
 
@@ -162,12 +163,95 @@ def have_set_bit(have: jnp.ndarray, peer, slot) -> jnp.ndarray:
     return have.at[peer, w].set(have[peer, w] | bit)
 
 
+# --- compact storage codecs (cfg.state_precision="compact") ------------
+#
+# Every SimState field names its storage codec here; the tier-1 audit
+# (tests/test_state_precision.py) FAILS if a field is missing, so a new
+# plane cannot land without a precision decision AND a byte ceiling.
+# Compute always happens in the historical f32/i32 layout — engine.step
+# decodes at entry and re-encodes at exit, so no op ever sees a narrow
+# type; "f32" precision bypasses both directions entirely (bit-exact).
+#
+#   bf16    f32 counter -> bfloat16, STORED as its uint16 bit pattern
+#           (bitcast_convert_type) so checkpoints / np.savez / gathers
+#           never meet an ml_dtypes array — 2x smaller, ~3 decimal
+#           digits of mantissa (the score counters are decayed
+#           magnitudes; tolerance pinned in tests/test_state_precision)
+#   tick16  bounded i32 tick plane -> int16 RELATIVE to state.tick;
+#           NEVER maps to the reserved +32767 and round-trips exactly,
+#           other deltas saturate at +/-32766 (safe: every consumer asks
+#           expired-vs-tick questions and |delta| < 32766 for any
+#           horizon the planes encode — backoffs, RetainScore windows,
+#           the msg_window, gater quiet periods are all << 32766 ticks;
+#           gater_last_throttle's -NEVER fill saturates to "throttled
+#           32766 ticks ago", which every quiet-period compare treats
+#           exactly like -NEVER)
+#   packK   bool [..., K] slot plane -> u32 [..., ceil(K/32)] words,
+#           the `have` discipline (ops/bits.py pack_bool/unpack_bool) —
+#           lossless, 8x (bit-exact round trip pinned in tests)
+#   slot8   neighbor-slot index i32 -> int8 (values in [-1, k_slots);
+#           compact refuses k_slots > 127 by name) — lossless, 4x
+#   None    stored as-is (peer ids need 24+ bits at 10M peers; tiny /
+#           replicated / scalar planes are not worth a codec)
+_COMPACT_CODECS = dict(
+    tick=None,
+    neighbors=None, connected="packK", outbound="packK",
+    reverse_slot="slot8", subscribed=None, nbr_subscribed="packK",
+    disconnect_tick="tick16", direct="packK",
+    ip_group=None, app_score=None, malicious=None,
+    mesh="packK", fanout="packK", fanout_lastpub="tick16",
+    backoff="tick16", graft_tick="tick16", mesh_active="packK",
+    first_message_deliveries="bf16", mesh_message_deliveries="bf16",
+    mesh_failure_penalty="bf16", invalid_message_deliveries="bf16",
+    behaviour_penalty="bf16",
+    gater_validate=None, gater_throttle=None,
+    gater_last_throttle="tick16",
+    gater_deliver="bf16", gater_duplicate="bf16",
+    gater_ignore="bf16", gater_reject="bf16",
+    msg_topic=None, msg_publish_tick=None, msg_invalid=None,
+    msg_ignored=None, msg_publisher=None,
+    have=None, deliver_tick="tick16", deliver_from="slot8",
+    iwant_pending=None,
+    delivered_total=None, halo_overflow=None, fault_flags=None,
+)
+
+_TICK16_NEVER = 32767     # reserved int16 encoding of the NEVER sentinel
+_TICK16_SAT = 32766       # saturation bound for live relative ticks
+
+
+def _check_compact(cfg: SimConfig) -> None:
+    if cfg.state_precision != "compact":
+        raise ValueError(
+            f"state_precision={cfg.state_precision!r}: expected 'f32' or "
+            "'compact'")
+    if cfg.k_slots > 127:
+        raise ValueError(
+            f"state_precision='compact': the slot8 codec stores neighbor "
+            f"slots as int8, so k_slots={cfg.k_slots} > 127 is refused")
+    if set(_COMPACT_CODECS) != set(SimState._fields):
+        raise RuntimeError("_COMPACT_CODECS drifted from SimState._fields")
+
+
+def _compact_entry(codec, shape, dtype):
+    """(shape, dtype) a codec stores the f32-layout (shape, dtype) as."""
+    if codec == "bf16":
+        return shape, np.uint16
+    if codec == "tick16":
+        return shape, np.int16
+    if codec == "slot8":
+        return shape, np.int8
+    if codec == "packK":
+        return shape[:-1] + ((shape[-1] + 31) // 32,), np.uint32
+    return shape, dtype
+
+
 def state_spec(cfg: SimConfig) -> dict:
     """field -> (shape, dtype, peer_major): the single source of truth for
-    the SimState layout. ``peer_major`` fields shard their leading N axis
-    over the peer mesh (parallel/sharding.state_shardings); the rest
-    (message tables, scalars) replicate. state_nbytes prices exactly these
-    shapes; init builds them."""
+    the SimState layout AS STORED (scan carry, checkpoints, shardings)
+    under ``cfg.state_precision``. ``peer_major`` fields shard their
+    leading N axis over the peer mesh (parallel/sharding.state_shardings);
+    the rest (message tables, scalars) replicate. state_nbytes prices
+    exactly these shapes; init builds them."""
     n, k, t, m = cfg.n_peers, cfg.k_slots, cfg.n_topics, cfg.msg_window
     w = n_msg_words(cfg)
     i32, f32, b, u32 = np.int32, np.float32, np.bool_, np.uint32
@@ -202,16 +286,145 @@ def state_spec(cfg: SimConfig) -> dict:
     )
     if set(spec) != set(SimState._fields):
         raise RuntimeError("state_spec drifted from SimState._fields")
-    return spec
+    if cfg.state_precision == "f32":
+        return spec
+    _check_compact(cfg)
+    return {f: _compact_entry(_COMPACT_CODECS[f], shape, dtype)
+            + (peer_major,)
+            for f, (shape, dtype, peer_major) in spec.items()}
 
 
-def state_nbytes(cfg: SimConfig, n_dev: int = 1) -> dict:
+def encode_state(state: SimState, cfg: SimConfig) -> SimState:
+    """The STORED representation of a compute-layout state (the scan
+    carry, checkpoints, HBM-resident planes). Identity under
+    ``state_precision="f32"``; under "compact" applies _COMPACT_CODECS
+    field by field. engine.step calls this at exit; callers holding a
+    decoded state (init paths, trace replay) must encode before handing
+    the state to a scan."""
+    if cfg.state_precision == "f32":
+        return state
+    _check_compact(cfg)
+    if state.mesh.dtype != jnp.bool_:
+        raise TypeError(
+            "encode_state: state is already in the compact storage "
+            f"layout (mesh dtype {state.mesh.dtype})")
+    from ..ops.bits import pack_bool
+    tick = state.tick
+    out = {}
+    for f, codec in _COMPACT_CODECS.items():
+        if codec is None:
+            continue
+        v = getattr(state, f)
+        if codec == "bf16":
+            out[f] = jax.lax.bitcast_convert_type(
+                v.astype(jnp.bfloat16), jnp.uint16)
+        elif codec == "tick16":
+            rel = jnp.clip(v - tick, -_TICK16_SAT, _TICK16_SAT)
+            out[f] = jnp.where(v == NEVER, _TICK16_NEVER,
+                               rel).astype(jnp.int16)
+        elif codec == "packK":
+            out[f] = pack_bool(v)
+        else:                                   # slot8
+            out[f] = v.astype(jnp.int8)
+    return state._replace(**out)
+
+
+def decode_state(state: SimState, cfg: SimConfig) -> SimState:
+    """Inverse of :func:`encode_state`: the f32/i32 compute layout every
+    op consumes. Identity under "f32". The tick16 planes decode relative
+    to ``state.tick``, so decode must see the SAME tick the encode saw —
+    engine.step's decode-at-entry / encode-at-exit bracketing guarantees
+    it (the tick increments inside the bracket)."""
+    if cfg.state_precision == "f32":
+        return state
+    _check_compact(cfg)
+    if state.mesh.dtype == jnp.bool_:
+        raise TypeError(
+            "decode_state: state is already in the compute layout")
+    from ..ops.bits import unpack_bool
+    tick = state.tick
+    out = {}
+    for f, codec in _COMPACT_CODECS.items():
+        if codec is None:
+            continue
+        v = getattr(state, f)
+        if codec == "bf16":
+            out[f] = jax.lax.bitcast_convert_type(
+                v, jnp.bfloat16).astype(jnp.float32)
+        elif codec == "tick16":
+            e = v.astype(jnp.int32)
+            out[f] = jnp.where(e == _TICK16_NEVER, jnp.int32(int(NEVER)),
+                               tick + e)
+        elif codec == "packK":
+            out[f] = unpack_bool(v, cfg.k_slots)
+        else:                                   # slot8
+            out[f] = v.astype(jnp.int32)
+    return state._replace(**out)
+
+
+def per_peer_byte_ceilings(cfg: SimConfig) -> dict:
+    """field -> MAX bytes-per-peer each peer-major plane may price under
+    ``cfg.state_precision`` — the audit contract
+    (tests/test_state_precision.py walks state_spec against this). The
+    ceilings are written as independent formulas, NOT derived from
+    state_spec: a layout regression moves the spec, trips the audit, and
+    must be re-priced here deliberately."""
+    k, t, m = cfg.k_slots, cfg.n_topics, cfg.msg_window
+    w, kw = (m + 31) // 32, (k + 31) // 32
+    if cfg.state_precision == "compact":
+        return dict(
+            neighbors=4 * k, connected=4 * kw, outbound=4 * kw,
+            reverse_slot=k, subscribed=t, nbr_subscribed=4 * t * kw,
+            disconnect_tick=2 * k, direct=4 * kw, ip_group=4,
+            app_score=4, malicious=1,
+            mesh=4 * t * kw, fanout=4 * t * kw, fanout_lastpub=2 * t,
+            backoff=2 * t * k, graft_tick=2 * t * k,
+            mesh_active=4 * t * kw,
+            first_message_deliveries=2 * t * k,
+            mesh_message_deliveries=2 * t * k,
+            mesh_failure_penalty=2 * t * k,
+            invalid_message_deliveries=2 * t * k,
+            behaviour_penalty=2 * k,
+            gater_validate=4, gater_throttle=4, gater_last_throttle=2,
+            gater_deliver=2 * k, gater_duplicate=2 * k,
+            gater_ignore=2 * k, gater_reject=2 * k,
+            have=4 * w, deliver_tick=2 * m, deliver_from=m,
+            iwant_pending=4 * m,
+        )
+    return dict(
+        neighbors=4 * k, connected=k, outbound=k, reverse_slot=4 * k,
+        subscribed=t, nbr_subscribed=t * k, disconnect_tick=4 * k,
+        direct=k, ip_group=4, app_score=4, malicious=1,
+        mesh=t * k, fanout=t * k, fanout_lastpub=4 * t,
+        backoff=4 * t * k, graft_tick=4 * t * k, mesh_active=t * k,
+        first_message_deliveries=4 * t * k,
+        mesh_message_deliveries=4 * t * k,
+        mesh_failure_penalty=4 * t * k,
+        invalid_message_deliveries=4 * t * k,
+        behaviour_penalty=4 * k,
+        gater_validate=4, gater_throttle=4, gater_last_throttle=4,
+        gater_deliver=4 * k, gater_duplicate=4 * k, gater_ignore=4 * k,
+        gater_reject=4 * k,
+        have=4 * w, deliver_tick=4 * m, deliver_from=4 * m,
+        iwant_pending=4 * m,
+    )
+
+
+def state_nbytes(cfg: SimConfig, n_dev: int | dict = 1) -> dict:
     """Host-side accounting of the SimState HBM footprint: per-field bytes,
     the global total, and the per-shard bytes on an ``n_dev``-way peer
     sharding (peer-major fields divide their leading N; message tables and
-    scalars replicate onto every shard). This is the number a frontier
-    config must fit under the per-chip HBM budget BEFORE anything is
-    allocated — bench.py records it next to the measured peak."""
+    scalars replicate onto every shard). ``n_dev`` may also be a mesh dict
+    like ``{'dcn': 2, 'peers': 4}`` (parallel/sharding.make_mesh_2d): the
+    peer-major leading axis shards over EVERY mesh axis
+    (state_partition_specs names them all), so per-shard divides by the
+    product. This is the number a frontier config must fit under the
+    per-chip HBM budget BEFORE anything is allocated — bench.py records
+    it next to the measured peak."""
+    mesh = None
+    if isinstance(n_dev, dict):
+        mesh = dict(n_dev)
+        n_dev = int(np.prod(list(mesh.values()), dtype=np.int64))
     n = cfg.n_peers
     if n_dev <= 0 or n % n_dev:
         raise ValueError(
@@ -223,8 +436,61 @@ def state_nbytes(cfg: SimConfig, n_dev: int = 1) -> dict:
         fields[f] = nbytes
         total += nbytes
         per_shard += nbytes // n_dev if peer_major else nbytes
-    return {"total": total, "per_shard": per_shard, "n_dev": n_dev,
-            "fields": fields}
+    out = {"total": total, "per_shard": per_shard, "n_dev": n_dev,
+           "fields": fields}
+    if mesh is not None:
+        out["mesh"] = mesh
+    return out
+
+
+def hbm_budget_bytes() -> int | None:
+    """The ``GRAFT_HBM_BUDGET`` gate value in bytes (suffixes KiB / MiB /
+    GiB / K / M / G accepted, case-insensitive); None when unset/empty."""
+    raw = os.environ.get("GRAFT_HBM_BUDGET", "").strip()
+    if not raw:
+        return None
+    low = raw.lower()
+    mult = 1
+    for suf, m in (("kib", 2 ** 10), ("mib", 2 ** 20), ("gib", 2 ** 30),
+                   ("k", 2 ** 10), ("m", 2 ** 20), ("g", 2 ** 30)):
+        if low.endswith(suf):
+            low, mult = low[: -len(suf)], m
+            break
+    try:
+        return int(float(low) * mult)
+    except ValueError as e:
+        raise ValueError(
+            f"GRAFT_HBM_BUDGET={raw!r}: expected bytes with an optional "
+            "KiB/MiB/GiB suffix") from e
+
+
+def check_hbm_budget(cfg: SimConfig, n_dev: int | dict = 1,
+                     budget: int | None = None, what: str = "state") -> dict:
+    """Price the state and REFUSE (ValueError naming the worst planes)
+    when the per-shard bytes exceed the budget — accounting BEFORE
+    allocation, so a 10M launch fails by name instead of OOMing the host
+    it was going to kill anyway. ``budget=None`` reads GRAFT_HBM_BUDGET;
+    with no gate set the pricing is returned and nothing raises.
+    Launchers (scripts/run_multihost.py, bench.py) call this before
+    building a single array."""
+    acct = state_nbytes(cfg, n_dev)
+    if budget is None:
+        budget = hbm_budget_bytes()
+    if budget is None or acct["per_shard"] <= budget:
+        return acct
+    spec = state_spec(cfg)
+    shard_fields = {f: (b // acct["n_dev"] if spec[f][2] else b)
+                    for f, b in acct["fields"].items()}
+    worst = sorted(shard_fields.items(), key=lambda kv: -kv[1])[:4]
+    names = ", ".join(f"{f}={b / 2 ** 20:.1f}MiB" for f, b in worst)
+    raise ValueError(
+        f"GRAFT_HBM_BUDGET: {what} prices "
+        f"{acct['per_shard'] / 2 ** 30:.2f} GiB/shard on {acct['n_dev']} "
+        f"shards, over the {budget / 2 ** 30:.2f} GiB budget "
+        f"(n_peers={cfg.n_peers}, "
+        f"state_precision={cfg.state_precision!r}); worst fields: "
+        f"{names}. Shrink the config, raise the budget, or set "
+        "state_precision='compact'.")
 
 
 def init_state(cfg: SimConfig, topo: Topology,
@@ -278,7 +544,7 @@ def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
         nbr_subscribed = jnp.transpose(
             subscribed[jnp.clip(neighbors, 0, cfg.n_peers - 1)], (0, 2, 1)) \
             & (neighbors >= 0)[:, None, :]
-    return SimState(
+    raw = SimState(
         tick=jnp.int32(0),
         neighbors=neighbors,
         connected=neighbors >= 0,
@@ -322,3 +588,6 @@ def _device_init(cfg: SimConfig, neighbors, outbound, reverse_slot,
         halo_overflow=jnp.int32(0),
         fault_flags=jnp.uint32(0),
     )
+    # the state ships in its STORED layout (identity under "f32"): every
+    # consumer — scans, checkpoints, shardings — holds encoded planes
+    return encode_state(raw, cfg)

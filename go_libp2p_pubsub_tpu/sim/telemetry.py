@@ -109,6 +109,11 @@ def health_record(state: SimState, cfg: SimConfig,
     must reproduce the streamed records bit for bit."""
     from ..ops.score_ops import compute_scores
 
+    if state.mesh.dtype != jnp.bool_:
+        # the scan hands in the post-step carry, which travels in the
+        # STORED layout (sim/state.py); reduce over the compute layout
+        from .state import decode_state
+        state = decode_state(state, cfg)
     n, t_topics, k = state.mesh.shape
     tick = state.tick
 

@@ -93,7 +93,9 @@ def sparse_fast(n: int, k: int, degree: int = 8,
     same KIND of graph (each peer dials ``degree`` random targets, edges
     symmetric, per-peer degree capped at ``k``, ``reverse_slot`` a true
     involution, sorted-neighbor slot order exactly like ``_finalize``) in
-    a handful of numpy passes: ~2 s at 1M×32 host-side. It is NOT
+    a handful of numpy passes: ~14 s at 1M×32 host-side (measured, see
+    ROADMAP item 4 — and O(N·degree) host RAM: the build is global, so
+    10M needs :func:`sparse_hash` instead). It is NOT
     sample-identical to ``sparse`` for the same seed — the frontier
     scenario family (sim/scenarios.py) owns it; the BASELINE scenarios
     keep their historical builder and seeds.
@@ -151,6 +153,90 @@ def sparse_fast(n: int, k: int, degree: int = 8,
     outbound[u, slot] = outbound_dir
     reverse_slot[u, slot] = rev.astype(np.int32)
     degree_arr = (neighbors >= 0).sum(axis=1).astype(np.int32)
+    return Topology(neighbors, outbound, reverse_slot, degree_arr)
+
+
+def hash_offsets(n: int, degree: int, seed: int = 314159) -> np.ndarray:
+    """The ``degree`` seed-derived circulant offsets :func:`sparse_hash`
+    builds from — distinct, never 0 or n/2, and no two complements mod n
+    (rejection-sampled), so every peer's 2·degree targets are distinct
+    and every edge appears exactly once per direction."""
+    if degree < 1 or degree > max(0, (n - 1) // 2):
+        raise ValueError(
+            f"sparse_hash: degree={degree} needs 1 <= degree <= "
+            f"(n-1)//2 = {(n - 1) // 2} distinct offset classes at n={n}")
+    rng = np.random.default_rng(seed)
+    offs: list[int] = []
+    taken: set[int] = set()
+    while len(offs) < degree:
+        o = int(rng.integers(1, n))
+        if o in taken or (n - o) in taken or 2 * o == n:
+            continue
+        taken.add(o)
+        offs.append(o)
+    return np.array(sorted(offs), np.int64)
+
+
+def sparse_hash(n: int, k: int, degree: int = 8, seed: int = 314159,
+                rows: tuple[int, int] | None = None,
+                chunk_rows: int = 16384) -> Topology:
+    """Shard-constructible pseudo-random underlay: a circulant graph on
+    seeded-hash offsets, where EVERY row is a pure function of
+    ``(n, degree, seed, row)`` — no global table, ever.
+
+    ``sparse_fast``'s pair-dedup / capacity-rank passes are global (row
+    i's slots depend on every other row's draws), so a 1M×32 build costs
+    ~14 s and O(N·degree) host RAM on ONE host — ~10x worse at 10M, the
+    wall ROADMAP item 4 names. Here peer i's neighbors are
+    ``{(i ± o_d) mod n}`` for ``degree`` offsets drawn once from the
+    seed (:func:`hash_offsets`): each multihost process materializes
+    ONLY its ``rows=(start, count)`` shard of every ``[N, K]`` plane
+    (``parallel.multihost.init_state_local(..., topo_local=True)``
+    consumes it directly), and the concat across processes equals the
+    single-host build bit for bit BY CONSTRUCTION
+    (tests/test_topology_sharded.py pins parity at P∈{2,4} plus a
+    peak-RSS ceiling on the shard build).
+
+    Graph shape: 2·degree-regular (uniform — the degree-histogram
+    analogue of ``sparse_fast``'s Poisson spread), symmetric, slots in
+    sorted-neighbor order like ``_finalize``; the "+" offset direction
+    is the dialed (outbound) side. ``reverse_slot`` is computed locally
+    by ranking ``i`` inside its neighbor's formulaic neighbor set —
+    [chunk, 2·degree, 2·degree] comparisons per chunk, never a global
+    pass. Like ``sparse_fast`` it is not sample-identical to ``sparse``.
+    """
+    if n < 2:
+        raise ValueError(f"sparse_hash needs n >= 2, got {n}")
+    if 2 * degree > k:
+        raise ValueError(
+            f"sparse_hash: 2*degree={2 * degree} slots needed > k={k}")
+    offs = hash_offsets(n, degree, seed)
+    r0, cnt = (0, n) if rows is None else rows
+    if r0 < 0 or cnt < 0 or r0 + cnt > n:
+        raise ValueError(f"sparse_hash: rows=({r0}, {cnt}) outside [0, {n})")
+    neighbors = np.full((cnt, k), -1, np.int32)
+    outbound = np.zeros((cnt, k), bool)
+    reverse_slot = np.full((cnt, k), -1, np.int32)
+    d2 = 2 * degree
+    for c0 in range(0, cnt, chunk_rows):
+        c1 = min(c0 + chunk_rows, cnt)
+        i = np.arange(r0 + c0, r0 + c1, dtype=np.int64)[:, None]   # [R, 1]
+        nbrs = np.concatenate([(i + offs) % n, (i - offs) % n], 1)  # [R, 2D]
+        dialed = np.concatenate([np.ones_like(offs, bool),
+                                 np.zeros_like(offs, bool)])        # [2D]
+        order = np.argsort(nbrs, axis=1, kind="stable")
+        nb_s = np.take_along_axis(nbrs, order, 1)
+        out_s = np.take_along_axis(np.broadcast_to(dialed, nbrs.shape),
+                                   order, 1)
+        # my slot in neighbor j's table = rank of i among j's OWN sorted
+        # neighbor set {(j ± o) mod n} — formulaic, so strictly local
+        j_nbrs = np.concatenate([(nb_s[:, :, None] + offs) % n,
+                                 (nb_s[:, :, None] - offs) % n], 2)
+        rev = np.sum(j_nbrs < i[:, :, None], axis=2, dtype=np.int64)
+        neighbors[c0:c1, :d2] = nb_s.astype(np.int32)
+        outbound[c0:c1, :d2] = out_s
+        reverse_slot[c0:c1, :d2] = rev.astype(np.int32)
+    degree_arr = np.full(cnt, d2, np.int32)
     return Topology(neighbors, outbound, reverse_slot, degree_arr)
 
 

@@ -47,9 +47,20 @@ def main() -> None:
     ap.add_argument("--num-processes", type=int, default=None)
     ap.add_argument("--process-id", type=int, default=None)
     ap.add_argument("--scenario", default="frontier_250k",
-                    help="frontier family member (frontier_250k/500k/1m)")
+                    help="frontier family member "
+                         "(frontier_250k/500k/1m/4m/10m)")
     ap.add_argument("--n", type=int, default=None,
                     help="peer-count override (smoke runs)")
+    ap.add_argument("--topology", default="replicated",
+                    choices=["replicated", "sharded"],
+                    help="replicated: every process builds the full "
+                         "host-side [N, K] underlay table and slices its "
+                         "rows (topology.sparse_fast — the 1M-scale "
+                         "path). sharded: each process materializes ONLY "
+                         "its own [N/P, K] rows of the seeded circulant "
+                         "underlay (topology.sparse_hash — mandatory at "
+                         "10M, where the global table alone is ~2.7 GiB "
+                         "of host RAM per process)")
     ap.add_argument("--ticks", type=int, default=100)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--chunk-ticks", type=int, default=None)
@@ -84,7 +95,7 @@ def main() -> None:
     from go_libp2p_pubsub_tpu.parallel.sharding import (
         make_mesh_2d, make_sharded_run_keys)
     from go_libp2p_pubsub_tpu.sim import scenarios
-    from go_libp2p_pubsub_tpu.sim.state import state_nbytes
+    from go_libp2p_pubsub_tpu.sim.state import check_hbm_budget
     from go_libp2p_pubsub_tpu.sim.supervisor import (
         SupervisorConfig, supervised_run)
 
@@ -95,26 +106,42 @@ def main() -> None:
     if not args.scenario.startswith("frontier"):
         raise SystemExit(
             f"--scenario {args.scenario!r}: the multihost launcher drives "
-            "the frontier family (frontier_250k/500k/1m), whose spec-level "
-            "constructor builds host-local shards; other scenarios "
-            "construct full device states")
+            "the frontier family (frontier_250k/500k/1m/4m/10m), whose "
+            "spec-level constructor builds host-local shards; other "
+            "scenarios construct full device states")
     n = args.n or scenarios.FRONTIER_NS[args.scenario]
-    cfg, tp, topo, subscribed = scenarios.frontier_spec(n)
+    # XL scenarios run compact by construction (scenarios.frontier_4m/_10m);
+    # the spec path takes the precision explicitly
+    precision = "compact" if args.scenario in (
+        "frontier_4m", "frontier_10m") else "f32"
+    sharded_topo = args.topology == "sharded"
+    trows = multihost.local_peer_rows(n, n_proc, rank) if sharded_topo \
+        else None
+    cfg, tp, topo, subscribed = scenarios.frontier_spec(
+        n, state_precision=precision, rows=trows)
 
     # hosts-major device order so each host's contiguous peer block lands
     # on its own chips (make_mesh_2d layout contract)
     devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
     mesh = make_mesh_2d(n_proc, devs)
-    budget = state_nbytes(cfg, len(devs))
+    # price the state BEFORE any device allocation: with GRAFT_HBM_BUDGET
+    # set, an over-budget launch refuses here by name (the error cites the
+    # worst per-shard fields and the knobs that shrink them) instead of
+    # OOMing minutes into topology construction
+    budget = check_hbm_budget(cfg, len(devs),
+                              what=f"{args.scenario} state")
     if coord:
         print(json.dumps({
             "info": "multihost run", "scenario": args.scenario, "n_peers": n,
             "processes": n_proc, "devices": len(devs),
+            "topology": args.topology,
+            "state_precision": cfg.state_precision,
             "state_nbytes_total": budget["total"],
             "state_nbytes_per_shard": budget["per_shard"]}), flush=True)
 
     local = multihost.init_state_local(cfg, topo, rank, n_proc,
-                                       subscribed=subscribed)
+                                       subscribed=subscribed,
+                                       topo_local=sharded_topo)
     state = multihost.global_state(local, mesh, cfg)
 
     # sharded chunk runner: one compiled scan per (exec_cfg, chunk shape),

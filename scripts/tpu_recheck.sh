@@ -12,6 +12,7 @@ for step in "supervisor_smoke:python scripts/supervisor_smoke.py" \
             "bench:python bench.py" \
             "bench_fleet:env BENCH_SCENARIOS=fleet_256x1k,1k_single_topic python bench.py" \
             "bench_frontier:env BENCH_SCENARIOS=frontier_250k,frontier_500k,frontier_1m GRAFT_DEADLINE_S=900 python bench.py" \
+            "bench_frontier_xl:env BENCH_SCENARIOS=frontier_4m,frontier_10m GRAFT_DEADLINE_S=900 GRAFT_HBM_BUDGET=16GiB python bench.py" \
             "sweep_scores:env SWEEP_JOURNAL=/tmp/tpu_recheck/sweep_scores.jsonl python scripts/sweep_scores.py --write-perf-model" \
             "telemetry:env BENCH_SCENARIOS=telemetry_1k,telemetry_10k python bench.py" \
             "bench_overlap:env BENCH_SCENARIOS=supervised_overlap_1k,supervised_overlap_10k python bench.py" \

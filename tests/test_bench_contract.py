@@ -49,7 +49,7 @@ def test_bench_emits_driver_parseable_json():
 
 
 def test_full_suite_fits_budget_at_reduced_n():
-    """All 18 configs at reduced N must complete, rc=0, within
+    """All 20 configs at reduced N must complete, rc=0, within
     BENCH_TOTAL_BUDGET on CPU — the structural guarantee that the r5
     timeout (rc=124, headline line missing) cannot recur. Every metric
     line must be present, the 100k_default headline first AND last.
@@ -66,8 +66,8 @@ def test_full_suite_fits_budget_at_reduced_n():
         timeout=budget + 120)
     assert res.returncode == 0, res.stderr[-500:]
     assert elapsed < budget, f"suite blew the budget: {elapsed:.0f}s"
-    # 18 configs + the headline re-emit
-    assert len(metrics) == 19, [m["metric"] for m in metrics]
+    # 20 configs + the headline re-emit
+    assert len(metrics) == 21, [m["metric"] for m in metrics]
     for m in metrics:
         assert m["value"] > 0, m
         # every record carries the memory accounting (ISSUE 8 satellite)
@@ -81,6 +81,7 @@ def test_full_suite_fits_budget_at_reduced_n():
                      "100k_gossipsub_sweep",
                      "frontier_250k_capped_0k", "frontier_500k_capped_0k",
                      "frontier_1m_capped_0k",
+                     "frontier_4m_capped_0k", "frontier_10m_capped_0k",
                      "telemetry_1k_capped_0k", "telemetry_10k_capped_0k",
                      "supervised_overlap_1k_capped_0k",
                      "supervised_overlap_10k_capped_0k",
@@ -99,6 +100,11 @@ def test_full_suite_fits_budget_at_reduced_n():
                if "supervised_overlap_1k" in m["metric"])
     assert ovl["unsupervised_hbps"] > 0 and ovl["sync_hbps"] > 0
     assert ovl["async_hbps"] > 0 and ovl["cadence_sweep"]
+    # the construction-cost record (ISSUE 13): every scenario line
+    # carries the host-side build wall + peak RSS next to state_nbytes,
+    # including the XL frontier pair (compact storage by construction)
+    xl = next(m for m in metrics if "frontier_10m" in m["metric"])
+    assert xl["build_wall_s"] >= 0 and xl["build_peak_rss_bytes"] > 0
 
 
 def test_sigterm_flushes_partial_record():

@@ -108,3 +108,48 @@ def test_replicated_control_trips_the_grep(eight_devices):
     assert bad, ("control failed: the replicated-route step shows no "
                  "dense collective to the grep")
     assert max(e for e, _ in bad) >= N * K
+
+
+def test_halo_step_within_packed_budget_2d_mesh(eight_devices, tmp_path):
+    """The multihost layout: the SAME packed-budget guard on the 2-D
+    {'dcn': 2, 'peers': 4} make_mesh_2d mesh — the DCN axis must not
+    reintroduce a dense collective (the peer axis shards over both mesh
+    axes, parallel/sharding.state_partition_specs). Runs in a fresh
+    subprocess: a second mesh in one process hits the backend multi-mesh
+    poison test_sharding.py documents; the subprocess dumps the compiled
+    HLO and the grep runs here."""
+    import os
+    import subprocess
+    import sys
+
+    from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
+
+    hlo = tmp_path / "step_2d.hlo"
+    code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from tests.test_hlo_sharded_budget import _build
+from go_libp2p_pubsub_tpu.parallel.sharding import (
+    make_mesh_2d, make_sharded_step, shard_state)
+
+cfg, tp, st = _build("halo")
+mesh = make_mesh_2d(2, jax.devices()[:8])
+assert dict(mesh.shape) == {{'dcn': 2, 'peers': 4}}, dict(mesh.shape)
+sharded_step = make_sharded_step(mesh, cfg, tp)
+st_sh = shard_state(st, mesh, cfg)
+text = sharded_step.lower(st_sh, jax.random.PRNGKey(0)).compile().as_text()
+open({str(hlo)!r}, "w").write(text)
+print("HLO_2D_OK")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = cpu_mesh_env(dict(os.environ), 8)
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=540,
+                         cwd=repo)
+    assert "HLO_2D_OK" in res.stdout, res.stderr[-3000:]
+    bad = _dense_collectives(hlo.read_text(), BUDGET)
+    assert not bad, (
+        f"dense collectives above the packed budget ({BUDGET} words) in "
+        f"the 2-D halo-routed step: {bad[:5]}")

@@ -196,7 +196,124 @@ class TestMemoryBudget:
         measured = sum(np.asarray(x).nbytes for x in st)
         assert measured == state_nbytes(cfg)["total"]
 
+    def test_compact_accounting_matches_allocation(self):
+        """The compact layout's accounting is also what a real state
+        allocates — the codecs (sim/state.py) and the spec are the same
+        truth."""
+        from go_libp2p_pubsub_tpu.sim import init_state
+        cfg, _tp, topo, sub = scenarios.frontier_spec(
+            256, k_slots=16, degree=6, state_precision="compact")
+        st = init_state(cfg, topo, subscribed=sub)
+        measured = sum(np.asarray(x).nbytes for x in st)
+        assert measured == state_nbytes(cfg)["total"]
+
+    def test_compact_halves_frontier_1m_per_shard(self):
+        """The ISSUE 13 acceptance line: frontier_1m per-shard bytes on
+        the 8-way mesh drop >= 2x under state_precision='compact'."""
+        n = scenarios.FRONTIER_NS["frontier_1m"]
+        f32 = state_nbytes(scenarios.frontier_cfg(n), 8)["per_shard"]
+        compact = state_nbytes(scenarios.frontier_cfg(
+            n, state_precision="compact"), 8)["per_shard"]
+        assert f32 >= 2 * compact, (
+            f"compact saves only {f32 / compact:.3f}x "
+            f"({f32 / 2**30:.3f} -> {compact / 2**30:.3f} GiB/shard)")
+
+    def test_frontier_10m_compact_fits_8_way_mesh(self):
+        """The 10M frontier prices under the per-chip HBM budget on 8
+        shards BEFORE anything allocates — compact storage is what makes
+        the scenario priceable at all (f32 does not fit the same
+        fraction)."""
+        n = scenarios.FRONTIER_NS["frontier_10m"]
+        compact = state_nbytes(scenarios.frontier_cfg(
+            n, state_precision="compact"), 8)["per_shard"]
+        assert compact <= self.HBM_BYTES * self.STATE_BUDGET_FRACTION, (
+            f"frontier_10m compact per-shard {compact / 2**30:.2f} GiB "
+            "blows the budget")
+        f32 = state_nbytes(scenarios.frontier_cfg(n), 8)["per_shard"]
+        assert f32 > self.HBM_BYTES * self.STATE_BUDGET_FRACTION, (
+            "positive control: the f32 layout at 10M should NOT fit — "
+            "if it does, the compact tier is pointless")
+
+    def test_state_nbytes_2d_mesh_dict(self):
+        """A {'dcn': 2, 'peers': 4} mesh dict prices identically to the
+        flat 8-way sharding (the peer axis shards over every mesh axis)
+        and echoes the mesh in the accounting."""
+        cfg = scenarios.frontier_cfg(scenarios.FRONTIER_NS["frontier_1m"])
+        flat = state_nbytes(cfg, 8)
+        mesh = state_nbytes(cfg, {"dcn": 2, "peers": 4})
+        assert mesh["per_shard"] == flat["per_shard"]
+        assert mesh["n_dev"] == 8 and mesh["mesh"] == {"dcn": 2, "peers": 4}
+
+    def test_hbm_budget_gate_refuses_by_name(self):
+        """check_hbm_budget (the launcher/bench gate): an over-budget
+        config refuses citing the worst per-shard fields and the knobs
+        that shrink them; under-budget returns the accounting."""
+        from go_libp2p_pubsub_tpu.sim.state import (
+            check_hbm_budget, hbm_budget_bytes)
+        cfg = scenarios.frontier_cfg(scenarios.FRONTIER_NS["frontier_1m"])
+        with pytest.raises(ValueError, match="GRAFT_HBM_BUDGET") as ei:
+            check_hbm_budget(cfg, 8, budget=64 * 2 ** 20, what="test state")
+        msg = str(ei.value)
+        assert "worst fields" in msg and "state_precision" in msg
+        acct = check_hbm_budget(cfg, 8, budget=self.HBM_BYTES)
+        assert acct["per_shard"] == state_nbytes(cfg, 8)["per_shard"]
+        # env parsing: suffixes and the unparseable refusal
+        os.environ["GRAFT_HBM_BUDGET"] = "1.5GiB"
+        try:
+            assert hbm_budget_bytes() == int(1.5 * 2 ** 30)
+            os.environ["GRAFT_HBM_BUDGET"] = "lots"
+            with pytest.raises(ValueError, match="GRAFT_HBM_BUDGET"):
+                hbm_budget_bytes()
+        finally:
+            del os.environ["GRAFT_HBM_BUDGET"]
+
     def test_divisibility_raises_by_name(self):
         cfg = SimConfig(n_peers=100, k_slots=8)
         with pytest.raises(ValueError, match="divide evenly"):
             state_nbytes(cfg, n_dev=8)
+
+
+class TestShardedTopologyConstruction:
+    """init_state_local(..., topo_local=True): the 10M construction path
+    where each process's topology table carries ONLY its own rows
+    (topology.sparse_hash rows=...)."""
+
+    @pytest.mark.parametrize("n_proc", [2, 4])
+    def test_topo_local_concat_equals_full_build(self, n_proc):
+        from go_libp2p_pubsub_tpu.parallel.multihost import init_state_local
+        from go_libp2p_pubsub_tpu.sim import init_state, topology
+
+        n, k = 128, 16
+        cfg, tp, topo, sub = scenarios.frontier_spec(n, k_slots=k, degree=6)
+        # the full build on the SAME underlay the shards will construct
+        full_topo = topology.sparse_hash(n, k, degree=6)
+        full = init_state(cfg, full_topo, subscribed=sub)
+        nl = n // n_proc
+        locals_ = [
+            init_state_local(
+                cfg,
+                topology.sparse_hash(n, k, degree=6, rows=(p * nl, nl)),
+                p, n_proc, subscribed=sub, topo_local=True)
+            for p in range(n_proc)]
+        spec = state_spec(cfg)
+        for f in SimState._fields:
+            want = np.asarray(getattr(full, f))
+            if spec[f][2]:
+                got = np.concatenate(
+                    [np.asarray(getattr(s, f)) for s in locals_])
+            else:
+                got = np.asarray(getattr(locals_[0], f))
+            np.testing.assert_array_equal(want, got, err_msg=f)
+
+    def test_wrong_shape_for_declared_mode_refuses_by_name(self):
+        from go_libp2p_pubsub_tpu.parallel.multihost import init_state_local
+        from go_libp2p_pubsub_tpu.sim import topology
+
+        n, k = 128, 16
+        cfg = scenarios.frontier_cfg(n, k_slots=k)
+        full_topo = topology.sparse_hash(n, k, degree=6)
+        local_topo = topology.sparse_hash(n, k, degree=6, rows=(0, n // 2))
+        with pytest.raises(ValueError, match="topo_local"):
+            init_state_local(cfg, full_topo, 0, 2, topo_local=True)
+        with pytest.raises(ValueError, match="topo_local"):
+            init_state_local(cfg, local_topo, 0, 2)

@@ -399,3 +399,101 @@ print(f"OVERFLOW_OK {ovf}")
                          capture_output=True, text=True, timeout=420,
                          cwd=repo)
     assert "OVERFLOW_OK" in res.stdout, res.stderr[-2000:]
+
+
+def test_required_bucket_capacity_is_the_exact_worst_bucket():
+    """Degree-aware halo pricing (ISSUE 13): required_bucket_capacity is
+    the exact worst (src,dst)-device bucket population — cross-checked
+    against a brute-force count, bounded above by the factor rule's
+    allocation, and refusing ragged shardings by name."""
+    from go_libp2p_pubsub_tpu.parallel.halo import (
+        required_bucket_capacity, required_capacity_factor)
+
+    for n, k, degree, seed in [(96, 16, 6, 3), (256, 16, 6, 11)]:
+        topo = topology.sparse(n, k, degree=degree, seed=seed)
+        nbr, rks = np.asarray(topo.neighbors), np.asarray(topo.reverse_slot)
+        for d in (4, 8):
+            nl = n // d
+            brute = 0
+            for sd in range(d):
+                rows = slice(sd * nl, (sd + 1) * nl)
+                v = (nbr[rows] >= 0) & (rks[rows] >= 0)
+                dest = nbr[rows][v] // nl
+                brute = max(brute, int(np.bincount(dest, minlength=d).max()))
+            got = required_bucket_capacity(nbr, rks, d)
+            assert got == brute, (n, d, got, brute)
+            # the factor rule's allocation always covers the exact price
+            f = required_capacity_factor(nbr, rks, d)
+            assert got <= f * (-(-nl * k // d)), (n, d)
+    with pytest.raises(ValueError, match="divide evenly"):
+        required_bucket_capacity(nbr[:100], rks[:100], 8)
+
+
+def test_halo_exact_bucket_capacity_trajectory_and_starved_control():
+    """SimConfig.halo_bucket_capacity end to end (config -> compile plan
+    -> kernel context -> halo route): priced at EXACTLY the underlay's
+    required_bucket_capacity the sharded trajectory is bit-exact vs the
+    unsharded step with zero overflow; priced one below, some bucket
+    must overflow (the degree histogram's answer is tight, not padded).
+    Fresh subprocess: the second mesh in one process hits the backend
+    multi-mesh poison the 2-D test documents."""
+    import os
+    import subprocess
+    import sys
+
+    from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
+
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import dataclasses
+import numpy as np
+from go_libp2p_pubsub_tpu.sim import SimConfig, TopicParams, init_state, topology
+from go_libp2p_pubsub_tpu.sim.engine import step_jit
+from go_libp2p_pubsub_tpu.parallel.halo import required_bucket_capacity
+from go_libp2p_pubsub_tpu.parallel.sharding import (
+    make_mesh, make_sharded_step, shard_state)
+
+topo = topology.sparse(64, 8, degree=4, seed=7)
+need = required_bucket_capacity(topo.neighbors, topo.reverse_slot, 8)
+assert need > 0
+cfg = SimConfig(n_peers=64, k_slots=8, n_topics=2, msg_window=32,
+                publishers_per_tick=2, prop_substeps=4, scoring_enabled=True,
+                behaviour_penalty_weight=-1.0, gossip_threshold=-10.0,
+                publish_threshold=-20.0, graylist_threshold=-30.0,
+                edge_gather_mode="sort", sharded_route="halo",
+                halo_bucket_capacity=need)
+tp = TopicParams.disabled(2)
+st = init_state(cfg, topo)
+mesh = make_mesh(jax.devices()[:8])
+sharded = make_sharded_step(mesh, cfg, tp)
+s = shard_state(st, mesh, cfg)
+un = st
+key = jax.random.PRNGKey(31)
+for _ in range(3):
+    key, k = jax.random.split(key)
+    s = sharded(s, k)
+    un = step_jit(un, cfg, tp, k)
+for f in un._fields:
+    np.testing.assert_array_equal(np.asarray(getattr(un, f)),
+                                  np.asarray(getattr(s, f)), err_msg=f)
+assert int(np.asarray(s.halo_overflow)) == 0
+
+# starved control: one below the exact price must overflow somewhere
+cfg1 = dataclasses.replace(cfg, halo_bucket_capacity=need - 1)
+sharded1 = make_sharded_step(mesh, cfg1, tp)
+s1 = shard_state(st, mesh, cfg1)
+key = jax.random.PRNGKey(31)
+for _ in range(3):
+    key, k = jax.random.split(key)
+    s1 = sharded1(s1, k)
+ovf = int(np.asarray(s1.halo_overflow))
+assert ovf > 0, f"capacity need-1 must overflow: {ovf}"
+print(f"EXACT_CAP_OK {need} {ovf}")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = cpu_mesh_env(dict(os.environ), 8)
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=420,
+                         cwd=repo)
+    assert "EXACT_CAP_OK" in res.stdout, res.stderr[-2000:]

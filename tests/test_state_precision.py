@@ -1,0 +1,257 @@
+"""The precision ladder (ISSUE 13): compact storage planes vs the f32
+reference layout.
+
+``SimConfig.state_precision`` selects how the SimState is STORED between
+steps (sim/state.py codecs — bf16-as-u16 score planes, i16 relative-tick
+planes, u32 bit-packed bool planes, i8 slot planes); the step always
+COMPUTES in the f32/i32/bool layout (decode at entry, encode at exit).
+The ladder this file pins:
+
+- the codec round trip is bit-exact for every in-range value (packed
+  bool planes are lossless by construction);
+- a compact init equals the encoded f32 init bit-for-bit on every plane
+  except ``gater_last_throttle`` (its -NEVER sentinel saturates to the
+  i16 floor — documented in sim/state.py; quiet-period compares are
+  unaffected);
+- compact-vs-f32 trajectories: every DISCRETE plane (mesh topology,
+  connectivity, delivery provenance, tick planes) is bit-exact over the
+  asserted window; bf16-coded score planes stay within the documented
+  rounding tolerance; delivery fraction is identical;
+- contract verdicts (sim/adversary.py) are unchanged under compact;
+- the audit: state_spec walks every field against the independent
+  per-peer byte ceilings, so a layout regression cannot land silently;
+- refusals by name: k_slots > 127 under compact (the i8 slot codec),
+  cross-precision checkpoint restore (sim/checkpoint.py sidecar).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import checkpoint, scenarios
+from go_libp2p_pubsub_tpu.sim.config import SimConfig
+from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction, run
+from go_libp2p_pubsub_tpu.sim.state import (
+    _COMPACT_CODECS, NEVER, SimState, decode_state, encode_state,
+    per_peer_byte_ceilings, state_spec)
+
+# the one init-time exception: gater_last_throttle initializes to -NEVER,
+# which the i16 relative-tick codec saturates (sim/state.py _TICK16_SAT);
+# every quiet-period compare still resolves identically
+SATURATED = ("gater_last_throttle",)
+
+# bf16 rounding bound for the score planes over the short parity windows
+# below (measured max ≈ 0.04 at 8 ticks; the counters are O(1..100) so
+# bf16's ~2^-8 relative step prices well under this)
+SCORE_TOL = 0.25
+
+
+def _pair(n=256, k=16, degree=6, **kw):
+    """(f32, compact) builds of the same frontier scenario."""
+    cfg_f, tp, st_f = scenarios.frontier(n, k_slots=k, degree=degree, **kw)
+    cfg_c, _, st_c = scenarios.frontier(n, k_slots=k, degree=degree,
+                                        state_precision="compact", **kw)
+    return cfg_f, cfg_c, tp, st_f, st_c
+
+
+def _assert_parity(a, b_decoded, skip=SATURATED):
+    """a (f32-layout) vs b_decoded: discrete planes bit-exact, bf16-coded
+    score planes within SCORE_TOL."""
+    for f in SimState._fields:
+        av, bv = np.asarray(getattr(a, f)), np.asarray(getattr(b_decoded, f))
+        if f in skip:
+            continue
+        if _COMPACT_CODECS[f] == "bf16":
+            assert av.shape == bv.shape, f
+            if av.size:
+                d = float(np.max(np.abs(av - bv)))
+                assert d <= SCORE_TOL, (f, d)
+        else:
+            np.testing.assert_array_equal(av, bv, err_msg=f)
+
+
+class TestCodecs:
+    def test_compact_init_equals_encoded_f32_init(self):
+        cfg_f, cfg_c, tp, st_f, st_c = _pair()
+        enc = encode_state(st_f, cfg_c)
+        for f in SimState._fields:
+            if f in SATURATED:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(getattr(enc, f)), np.asarray(getattr(st_c, f)),
+                err_msg=f)
+
+    def test_round_trip_is_bit_exact_for_in_range_values(self):
+        """decode(encode(state)) == state for every plane whose values the
+        codecs represent exactly: all discrete planes, and score planes
+        holding bf16-representable values (the init state's zeros)."""
+        cfg_f, cfg_c, tp, st_f, st_c = _pair()
+        back = decode_state(encode_state(st_f, cfg_c), cfg_c)
+        _assert_parity(st_f, back)
+        # the saturated sentinel decodes to the i16 floor relative to tick,
+        # NOT the original -NEVER — pinned so the exception stays deliberate
+        glt = np.asarray(back.gater_last_throttle)
+        assert np.all(glt == int(np.asarray(st_f.tick)) - 32766), glt
+        assert np.all(np.asarray(st_f.gater_last_throttle) == -int(NEVER))
+
+    def test_packed_bool_planes_are_lossless(self):
+        """pack/unpack of every bool plane is exact — including ragged
+        last words (k=20 does not divide 32)."""
+        from go_libp2p_pubsub_tpu.ops.bits import pack_bool, unpack_bool
+        rng = np.random.default_rng(3)
+        for shape, m in [((7, 20), 20), ((3, 2, 33), 33), ((5, 64), 64)]:
+            v = rng.random(shape) < 0.5
+            import jax.numpy as jnp
+            got = np.asarray(unpack_bool(pack_bool(jnp.asarray(v)), m))
+            np.testing.assert_array_equal(v, got)
+
+    def test_never_sentinel_round_trips_on_tick_planes(self):
+        """NEVER (the far-future sentinel) must survive the i16 relative
+        codec exactly on every tick16 plane — a saturated NEVER would
+        un-stick backoffs and deliveries."""
+        cfg_f, cfg_c, tp, st_f, st_c = _pair()
+        back = decode_state(st_c, cfg_c)
+        for f in ("graft_tick", "deliver_tick", "fanout_lastpub",
+                  "disconnect_tick"):
+            v = np.asarray(getattr(back, f))
+            ref = np.asarray(getattr(st_f, f))
+            assert v.dtype == np.int32, f
+            np.testing.assert_array_equal(v, ref, err_msg=f)
+            assert np.any(ref == int(NEVER)), f  # the sentinel is present
+
+    def test_encode_decode_layout_guards_raise(self):
+        cfg_f, cfg_c, tp, st_f, st_c = _pair()
+        with pytest.raises(TypeError, match="compact storage layout"):
+            encode_state(st_c, cfg_c)          # already encoded
+        with pytest.raises(TypeError, match="compute layout"):
+            decode_state(st_f, cfg_c)          # already decoded
+
+
+class TestTrajectoryParity:
+    def test_parity_1k(self):
+        """The acceptance trajectory at 1k: 8 ticks of the frontier config,
+        same key — discrete planes bit-exact, scores within SCORE_TOL,
+        delivery fraction identical."""
+        cfg_f, cfg_c, tp, st_f, st_c = _pair(n=1024)
+        key = jax.random.PRNGKey(7)
+        a = run(st_f, cfg_f, tp, key, 8)
+        b = run(st_c, cfg_c, tp, key, 8)
+        _assert_parity(a, decode_state(b, cfg_c))
+        assert float(delivery_fraction(a, cfg_f)) == \
+            float(delivery_fraction(b, cfg_c))
+
+    def test_parity_10k(self):
+        """The 10k rung of the ladder (slow tier)."""
+        cfg_f, cfg_c, tp, st_f, st_c = _pair(n=10_240, k=32, degree=8)
+        key = jax.random.PRNGKey(11)
+        a = run(st_f, cfg_f, tp, key, 8)
+        b = run(st_c, cfg_c, tp, key, 8)
+        _assert_parity(a, decode_state(b, cfg_c))
+        assert float(delivery_fraction(a, cfg_f)) == \
+            float(delivery_fraction(b, cfg_c))
+
+
+def _compact_attack(scn):
+    """The same AttackScenario with the state re-encoded compact."""
+    from go_libp2p_pubsub_tpu.sim.adversary import AttackScenario
+    cfg_c = dataclasses.replace(scn.cfg, state_precision="compact")
+    return AttackScenario(cfg_c, scn.tp, encode_state(scn.state, cfg_c),
+                          scn.contracts, scn.n_ticks, scn.name)
+
+
+class TestContractVerdicts:
+    def _verdicts_match(self, name):
+        from go_libp2p_pubsub_tpu.sim import adversary
+        scn = adversary.ATTACKS[name]()
+        rep_f = adversary.run_with_contracts(scn)
+        rep_c = adversary.run_with_contracts(_compact_attack(scn))
+        assert [(r.kind, r.status) for r in rep_f.results] == \
+            [(r.kind, r.status) for r in rep_c.results], name
+        assert rep_f.fault_flags == rep_c.fault_flags, name
+        assert all(r.passed for r in rep_c.results), name
+
+    def test_eclipse_verdicts_unchanged_under_compact(self):
+        """Tier-1 sentinel: the eclipse family's enforced contracts give
+        the same verdicts under compact storage."""
+        self._verdicts_match("eclipse_small")
+
+    @pytest.mark.parametrize("name", ["censor_small", "flashcrowd_small",
+                                      "slowlink_small", "diurnal_small"])
+    def test_remaining_families_verdicts_unchanged(self, name):
+        """The other four families (slow tier — one pair of full contract
+        runs each)."""
+        self._verdicts_match(name)
+
+
+class TestAudit:
+    """The tier-1 layout audit: state_spec against the INDEPENDENT
+    per-peer byte ceilings — a codec or shape regression moves the spec
+    and trips here, and must be re-priced deliberately."""
+
+    @pytest.mark.parametrize("precision", ["f32", "compact"])
+    def test_every_field_prices_under_its_ceiling(self, precision):
+        cfg = scenarios.frontier_cfg(1024, state_precision=precision)
+        spec = state_spec(cfg)
+        ceil = per_peer_byte_ceilings(cfg)
+        assert set(spec) == set(SimState._fields)
+        for f, entry in spec.items():
+            assert len(entry) == 3, f"{f}: spec entry must be " \
+                "(shape, dtype, peer_major)"
+            shape, dtype, peer_major = entry
+            assert f in _COMPACT_CODECS, \
+                f"{f}: new SimState field has no codec decision " \
+                "(sim/state.py _COMPACT_CODECS — None is an explicit choice)"
+            if not peer_major:
+                continue
+            assert shape[0] == cfg.n_peers, (f, shape)
+            bpp = int(np.prod(shape[1:], dtype=np.int64) if len(shape) > 1
+                      else 1) * np.dtype(dtype).itemsize
+            assert f in ceil, f"{f}: peer-major field missing from " \
+                "per_peer_byte_ceilings"
+            assert bpp <= ceil[f], \
+                f"{f}: {bpp} B/peer breaches the {ceil[f]} B/peer ceiling " \
+                f"under {precision!r}"
+
+    def test_compact_strictly_beats_f32_on_coded_planes(self):
+        cfg_f = scenarios.frontier_cfg(1024)
+        cfg_c = scenarios.frontier_cfg(1024, state_precision="compact")
+        cf, cc = per_peer_byte_ceilings(cfg_f), per_peer_byte_ceilings(cfg_c)
+        for f, codec in _COMPACT_CODECS.items():
+            if codec is not None and f in cf:
+                assert cc[f] < cf[f], (f, codec, cc[f], cf[f])
+
+    def test_f32_spec_is_unchanged_by_the_precision_field(self):
+        """The default layout stays bit-for-bit the seed layout: the spec
+        under f32 must not mention any compact dtype."""
+        cfg = scenarios.frontier_cfg(1024)
+        for f, (shape, dtype, _) in state_spec(cfg).items():
+            assert np.dtype(dtype) not in (np.dtype(np.uint16),
+                                           np.dtype(np.int16),
+                                           np.dtype(np.int8)), (f, dtype)
+
+
+class TestRefusals:
+    def test_k_slots_over_127_refuses_compact_by_name(self):
+        cfg = SimConfig(n_peers=256, k_slots=128, state_precision="compact")
+        with pytest.raises(ValueError, match="k_slots"):
+            state_spec(cfg)
+
+    def test_unknown_precision_refuses_by_name(self):
+        cfg = SimConfig(n_peers=256, k_slots=16, state_precision="f16")
+        with pytest.raises(ValueError, match="state_precision"):
+            state_spec(cfg)
+
+    def test_checkpoint_cross_precision_restore_refuses_by_name(self, tmp_path):
+        cfg_f, cfg_c, tp, st_f, st_c = _pair(n=128)
+        p = str(tmp_path / "ck.npz")
+        checkpoint.save(p, st_c, cfg=cfg_c)
+        with pytest.raises(ValueError, match="state_precision mismatch"):
+            checkpoint.restore(p, st_f, cfg=cfg_f)
+        # the matching restore still round-trips bit-exact
+        back = checkpoint.restore(p, st_c, cfg=cfg_c)
+        for f in SimState._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(st_c, f)), np.asarray(getattr(back, f)),
+                err_msg=f)

@@ -1,0 +1,129 @@
+"""Sharded topology construction (ISSUE 13, ROADMAP item 4): the
+seeded-hash circulant builder ``topology.sparse_hash``.
+
+Every row of the underlay is a pure function of ``(n, degree, seed,
+row)``, so a multi-process launch materializes ONLY its ``[N/P, K]``
+rows and the concat across processes equals the single-host build bit
+for bit BY CONSTRUCTION. This file pins:
+
+- graph shape: 2·degree-regular, symmetric, slots sorted-neighbor
+  ordered, the "+" offset direction one-sidedly outbound;
+- the reverse_slot involution (``reverse_slot[j, reverse_slot[i, s]]``
+  points back at slot s) computed strictly locally;
+- shard parity at P ∈ {2, 4} and chunk-size independence;
+- the memory contract: a per-process shard build at 1M peers stays
+  under a peak-RSS ceiling a full-table build cannot meet (subprocess,
+  numpy only — no jax import inflating the measurement).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import topology
+
+
+def _full(n, k, degree, seed=314159):
+    return topology.sparse_hash(n, k, degree=degree, seed=seed)
+
+
+class TestGraphShape:
+    @pytest.mark.parametrize("n, k, degree", [(96, 16, 4), (256, 16, 6),
+                                              (1000, 32, 8)])
+    def test_regular_symmetric_sorted(self, n, k, degree):
+        topo = _full(n, k, degree)
+        nbr, out, rs = topo.neighbors, topo.outbound, topo.reverse_slot
+        valid = nbr >= 0
+        # 2*degree-regular: exactly 2*degree live slots per row
+        assert np.all(valid.sum(1) == 2 * degree)
+        # sorted-neighbor slot order on the live prefix
+        live = np.where(valid, nbr, np.iinfo(np.int32).max)
+        assert np.all(np.diff(live, axis=1) >= 0) or np.all(
+            live[:, :-1] <= live[:, 1:])
+        # symmetry via the involution: j = nbr[i, s], r = rs[i, s] ->
+        # nbr[j, r] == i and rs[j, r] == s
+        i = np.repeat(np.arange(n), k).reshape(n, k)
+        j, r = nbr[valid], rs[valid]
+        assert np.all((r >= 0) & (r < k))
+        assert np.array_equal(nbr[j, r], i[valid])
+        s = np.broadcast_to(np.arange(k), (n, k))[valid]
+        assert np.array_equal(rs[j, r], s)
+        # outbound is one-sided: each symmetric edge dialed exactly once
+        assert np.array_equal(out[j, r], ~out[valid] & True)
+        # no live slot outside the prefix contract the engine assumes
+        assert np.all(nbr[~valid] == -1) and np.all(rs[~valid] == -1)
+
+    def test_offsets_are_distinct_and_complement_free(self):
+        n = 1024
+        offs = topology.hash_offsets(n, 8, seed=7)
+        assert len(set(offs.tolist())) == 8
+        assert 0 not in offs and not np.any(2 * offs == n)
+        assert not (set(offs.tolist()) & {n - o for o in offs.tolist()})
+
+    def test_degree_over_capacity_refuses_by_name(self):
+        with pytest.raises(ValueError, match="2\\*degree"):
+            topology.sparse_hash(256, 8, degree=8)
+        with pytest.raises(ValueError, match="degree"):
+            topology.hash_offsets(16, 9)
+
+
+class TestShardParity:
+    @pytest.mark.parametrize("p", [2, 4])
+    def test_concat_of_shards_equals_full_build(self, p):
+        n, k, degree = 512, 16, 6
+        full = _full(n, k, degree)
+        nl = n // p
+        parts = [topology.sparse_hash(n, k, degree=degree,
+                                      rows=(r * nl, nl)) for r in range(p)]
+        for field in ("neighbors", "outbound", "reverse_slot"):
+            cat = np.concatenate([getattr(t, field) for t in parts])
+            np.testing.assert_array_equal(
+                cat, getattr(full, field), err_msg=(field, p))
+
+    def test_chunk_size_does_not_change_the_build(self):
+        n, k, degree = 300, 16, 5
+        a = topology.sparse_hash(n, k, degree=degree, chunk_rows=7)
+        b = topology.sparse_hash(n, k, degree=degree, chunk_rows=10_000)
+        for field in ("neighbors", "outbound", "reverse_slot"):
+            np.testing.assert_array_equal(getattr(a, field),
+                                          getattr(b, field), err_msg=field)
+
+    def test_rows_out_of_bounds_refuses_by_name(self):
+        with pytest.raises(ValueError, match="rows"):
+            topology.sparse_hash(256, 16, degree=6, rows=(200, 100))
+
+
+def test_shard_build_rss_stays_under_ceiling_at_1m():
+    """The memory contract: an 8-way shard build at 1M×32 materializes
+    only [N/8, K] rows — three [131072, 32] planes ≈ 37 MB — so the
+    builder subprocess's peak RSS stays under a ceiling the full-table
+    build (~300 MB of planes plus working set) cannot meet. numpy-only
+    subprocess: a jax import would dwarf the thing being measured."""
+    code = """
+import resource
+import numpy as np
+import sys
+sys.path.insert(0, %r)
+from go_libp2p_pubsub_tpu.sim.topology import sparse_hash
+
+n, k = 1_048_576, 32
+topo = sparse_hash(n, k, degree=8, rows=(n // 8 * 3, n // 8))
+assert topo.neighbors.shape == (n // 8, k), topo.neighbors.shape
+assert np.all((topo.neighbors >= 0).sum(1) == 16)
+peak = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+shard_bytes = sum(a.nbytes for a in
+                  (topo.neighbors, topo.outbound, topo.reverse_slot))
+full_bytes = shard_bytes * 8
+print("RSS_OK", peak, shard_bytes, full_bytes)
+# ceiling: numpy import (~80 MB) + the shard planes + chunked working
+# set — far under the ~300 MB the full-table planes ALONE would add
+assert peak < 250 * 2**20, f"shard build peaked at {peak/2**20:.0f} MiB"
+assert peak < full_bytes, "shard build costs as much as the full table"
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    res = subprocess.run([sys.executable, "-c", code % repo],
+                         capture_output=True, text=True, timeout=300)
+    assert "RSS_OK" in res.stdout, (res.stdout, res.stderr[-2000:])
