@@ -97,13 +97,22 @@ def _replace_path(tmp: str, final: str) -> None:
     os.replace(tmp, final)
 
 
-def save(path: str, state: SimState, cfg=None) -> None:
+def save(path: str, state: SimState, cfg=None, processes=None) -> None:
     """Write a checkpoint directory (orbax) or .npz file (fallback); with
     ``cfg``, stamp its fingerprint in a sidecar for restore to verify.
 
     Crash-atomic (module docstring): payload and sidecar each land via
     temp-path + rename, payload before sidecar, so an interrupted save
-    can never leave a torn checkpoint at ``path``."""
+    can never leave a torn checkpoint at ``path``.
+
+    ``processes`` stamps the process count the (gathered, host-complete)
+    state was taken at as a clear ``processes=P`` sidecar line — default
+    ``jax.process_count()``. Deliberately NOT part of the digest: a
+    multihost checkpoint is host-complete, so restoring it at a DIFFERENT
+    process count is the supported elastic-resume path (each rank slices
+    its own rows with the CURRENT count — parallel/multihost.py
+    local_rows_state); the line is provenance for dashboards and the
+    supervisor's ``resume_elastic`` marker, not a refusal key."""
     path = os.path.abspath(path)
     tmp = f"{path}.tmp{os.getpid()}"
     # sweep stale temps from killed saves — ANY pid's, not just ours: a
@@ -156,9 +165,29 @@ def save(path: str, state: SimState, cfg=None) -> None:
             precision = getattr(cfg, "state_precision", None)
             if precision is not None:
                 f.write(f"state_precision={precision}\n")
+            p = jax.process_count() if processes is None else int(processes)
+            f.write(f"processes={p}\n")
             f.flush()
             os.fsync(f.fileno())
         _replace_path(side_tmp, _sidecar(path))
+
+
+def sidecar_meta(path: str) -> dict:
+    """Parse a checkpoint's fingerprint sidecar into
+    ``{"fingerprint": <digest>, <key>: <value>, ...}`` (the clear
+    ``fleet=`` / ``state_precision=`` / ``processes=`` lines); ``{}`` when
+    no sidecar exists. Read-only provenance — restore() does its own
+    verification."""
+    side = _sidecar(os.path.abspath(path))
+    if not os.path.exists(side):
+        return {}
+    with open(side) as f:
+        lines = f.read().split()
+    out: dict = {}
+    if lines:
+        out["fingerprint"] = lines[0]
+    out.update(ln.split("=", 1) for ln in lines[1:] if "=" in ln)
+    return out
 
 
 def _dtype_of(x):
@@ -185,7 +214,13 @@ def restore(path: str, like: SimState, cfg=None) -> SimState:
     """Load a checkpoint; ``like`` supplies the shapes/dtypes (and, for
     sharded states, the target shardings via its arrays). Every restored
     array is validated against ``like`` (module docstring); with ``cfg``,
-    the saved config fingerprint is verified too."""
+    the saved config fingerprint is verified too.
+
+    The sidecar's ``processes=`` line is informational, never a refusal:
+    a gathered (host-complete) multihost checkpoint restores at ANY
+    process count — each rank then re-slices its rows with the CURRENT
+    count (the elastic-resume path; see ``save`` and
+    ``parallel/multihost.local_rows_state``)."""
     path = os.path.abspath(path)
     if cfg is not None and os.path.exists(_sidecar(path)):
         with open(_sidecar(path)) as f:

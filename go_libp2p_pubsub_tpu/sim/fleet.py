@@ -328,7 +328,7 @@ def _try_resume_fleet(sup, ckpt_dir, group_cfg, full, starts, n_ticks,
 def _write_fleet_crash_dump(sup, group_cfg, full, keys_win, gi, active,
                             names, idxs, done, this_win, err,
                             report) -> str:
-    from .invariants import decode_flags
+    from .invariants import FLAGS_VERSION, decode_flags
 
     base = sup.crash_dir or os.environ.get("GRAFT_CRASH_DIR") \
         or os.path.join(os.getcwd(), "graft_crash")
@@ -353,6 +353,9 @@ def _write_fleet_crash_dump(sup, group_cfg, full, keys_win, gi, active,
         "config_fingerprint": checkpoint.config_fingerprint(
             group_cfg, fleet=len(names)),
         "fault_flags": flags,
+        # bit-layout version of the words above: replay refuses by name
+        # to decode another version's bits (sim/invariants.py)
+        "flags_version": FLAGS_VERSION,
         "fault_flag_names": [decode_flags(f) for f in flags],
         # [C, B_active] per-tick keys of the failing window, replay-ready
         "window_key_data": _key_data(keys_win).tolist(),

@@ -44,7 +44,13 @@ U32 = jnp.uint32
 # new layout: their old bits 8-9 (nonfinite/negative-counter violations)
 # land on FAULT_CENSOR/FAULT_WAVE and their higher violation bits read as
 # unknown — do not interpret pre-move journals/checkpoints' numeric flags
-# with post-move code (named constants keep all CODE correct) ---
+# with post-move code (named constants keep all CODE correct).
+# FLAGS_VERSION makes that refusal mechanical: writers (health-journal
+# headers, crash-dump meta) stamp it, and decode_flags(flags,
+# flags_version=...) refuses any other version BY NAME instead of
+# silently misreading. v1 = the pre-move layout (violations at bits 8+);
+# v2 = this layout ---
+FLAGS_VERSION = 2
 FAULT_LINK_DROP = 1 << 0     # >=1 link dropped a data plane this run
 FAULT_LINK_DUP = 1 << 1      # >=1 link duplicated traffic
 FAULT_PARTITION = 1 << 2     # a partition window was active
@@ -91,8 +97,23 @@ _NAMES = {
 }
 
 
-def decode_flags(flags: int) -> list[str]:
-    """Human-readable names of the set bits (bench lines, trace exports)."""
+def decode_flags(flags: int, flags_version: int | None = None) -> list[str]:
+    """Human-readable names of the set bits (bench lines, trace exports).
+
+    ``flags_version`` is the layout version the word was RECORDED under
+    (journal header / crash-dump ``flags_version`` field). Any version
+    other than the current :data:`FLAGS_VERSION` is refused by name —
+    a version-1 word's violation bits 8-9 would otherwise silently
+    misread as FAULT_CENSOR/FAULT_WAVE. ``None`` (a pre-versioning
+    artifact) decodes under the current layout, as before."""
+    if flags_version is not None and int(flags_version) != FLAGS_VERSION:
+        raise ValueError(
+            f"fault_flags word was recorded under flags_version="
+            f"{int(flags_version)} but this build decodes "
+            f"flags_version={FLAGS_VERSION} — the bit layouts differ "
+            "(version 1 kept violations at bits 8+, where this layout "
+            "puts FAULT_CENSOR/FAULT_WAVE); decode it with the build "
+            "that wrote it instead of misreading the bits")
     out = [name for bit, name in sorted(_NAMES.items()) if flags & bit]
     unknown = flags & ~sum(_NAMES)
     if unknown:

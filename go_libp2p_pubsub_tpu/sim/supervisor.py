@@ -220,6 +220,21 @@ class SupervisorConfig:
     # here (sim/adversary.py contracts_to_json) so the dashboard can
     # evaluate the SCENARIO's contracts, not just the schedule defaults
     health_meta: dict | None = None
+    # --- distributed resilience plane (parallel/resilience.py) ---
+    # RankLiveness (or any object with beat/check): the chunk loop stamps
+    # progress beats and polls check() at the pre-dispatch safe point —
+    # BEFORE the next chunk's collectives — so a dead peer aborts this
+    # rank's window cleanly at a chunk boundary (through the multi-process
+    # fail-fast crash path) instead of blocking forever in a gather
+    liveness: object | None = None
+    # rungs of the degrade ladder applied BEFORE the first chunk. The
+    # relaunch supervisor (scripts/mh_supervisor.py) records the agreed
+    # rung in its run journal and hands it to every rank via
+    # GRAFT_MH_RUNG, so after a relaunch all ranks compile the SAME
+    # program — the rank-symmetric form of the ladder that rank-local
+    # retry can't provide. Applied to the run's exec_cfg only: checkpoints
+    # keep stamping the BASE cfg, so resume across rungs never refuses.
+    initial_degrade: int = 0
 
     @staticmethod
     def from_env(**overrides) -> "SupervisorConfig":
@@ -239,6 +254,8 @@ class SupervisorConfig:
                 not in ("0", "false", "no", "off")
         if os.environ.get("GRAFT_WRITER_QUEUE"):
             kw["writer_queue"] = int(os.environ["GRAFT_WRITER_QUEUE"])
+        if os.environ.get("GRAFT_MH_RUNG"):
+            kw["initial_degrade"] = int(os.environ["GRAFT_MH_RUNG"])
         kw.update(overrides)
         return SupervisorConfig(**kw)
 
@@ -330,7 +347,14 @@ def _try_resume(sup: SupervisorConfig, cfg: SimConfig, like: SimState,
             # multihost: the checkpoint restores host-complete; every
             # process re-slices its rows and re-assembles the global
             # sharded state (collective — all ranks walk the same
-            # shared-filesystem checkpoint list, so they agree)
+            # shared-filesystem checkpoint list, so they agree). The
+            # slice uses the CURRENT process count, so a checkpoint
+            # gathered at P processes resumes at P' — elastic resume
+            # (checkpoint.py sidecar stamps the count it was taken at)
+            saved_p = checkpoint.sidecar_meta(path).get("processes")
+            if saved_p is not None and int(saved_p) != jax.process_count():
+                report.log("resume_elastic", saved_processes=int(saved_p),
+                           processes=jax.process_count())
             st = sup.state_from_host(st)
         done = int(_fetch_scalar(st.tick)) - start_tick
         if done != tick - start_tick:   # name/state tick disagreement
@@ -379,7 +403,7 @@ def _write_crash_dump(sup: SupervisorConfig, cfg: SimConfig,
                       done: int, this_chunk: int, n_ticks: int,
                       err: BaseException,
                       report: SupervisorReport) -> str:
-    from .invariants import decode_flags
+    from .invariants import FLAGS_VERSION, decode_flags
 
     base = sup.crash_dir or os.environ.get("GRAFT_CRASH_DIR") \
         or os.path.join(os.getcwd(), "graft_crash")
@@ -398,6 +422,10 @@ def _write_crash_dump(sup: SupervisorConfig, cfg: SimConfig,
         "config_fingerprint": checkpoint.config_fingerprint(cfg),
         "invariant_mode": cfg.invariant_mode,
         "fault_flags": flags,
+        # bit-layout version of the fault_flags word (sim/invariants.py):
+        # decoders REFUSE by name rather than misread a pre-move word's
+        # violation bits 8–9 as FAULT_CENSOR/FAULT_WAVE
+        "flags_version": FLAGS_VERSION,
         "fault_flag_names": decode_flags(flags),
         # the failing window's exact per-tick keys: replay_crash.py feeds
         # these straight back into engine.run_checked_keys (under
@@ -740,6 +768,18 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         state, done = _try_resume(sup, cfg, state, start_tick, n_ticks,
                                   report)
 
+    def beat(tick: int, chunk: int) -> None:
+        # liveness progress stamp (parallel/resilience.RankLiveness): a
+        # shared-fs hiccup must never fail the run itself — the beater
+        # thread keeps the wall stamp fresh regardless
+        if sup.liveness is not None:
+            try:
+                sup.liveness.beat(tick=tick, chunk=chunk)
+            except Exception:
+                pass
+
+    beat(start_tick + done, 0)
+
     # streaming-telemetry journal (sim/telemetry.py): rank-0-only under
     # multihost (write_files); rank>0 still EXECUTES the telemetry lane —
     # the reduction is part of the compiled program all ranks share
@@ -761,6 +801,12 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
 
     exec_cfg = cfg
     chunk_ticks = max(1, int(sup.chunk_ticks))
+    # rank-symmetric relaunch rung (SupervisorConfig.initial_degrade):
+    # walk the same ladder a failing single-process run would, before the
+    # first dispatch — every rank handed the same GRAFT_MH_RUNG compiles
+    # the same program
+    for _ in range(max(0, int(sup.initial_degrade))):
+        exec_cfg, chunk_ticks = _degrade(exec_cfg, chunk_ticks, sup, report)
     every = sup.checkpoint_every_ticks or chunk_ticks
     next_ckpt = done + every
     failures = 0            # consecutive; reset on every successful chunk
@@ -880,6 +926,7 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         carry, carry_done = p.out, done
         report.chunks_run += 1
         report.ticks_run += p.ticks
+        beat(start_tick + done, report.chunks_run)
         report.log("chunk_ok", **p.info)
         if events_out is not None:
             events_out.extend(p.events)
@@ -962,6 +1009,22 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         while done < n_ticks and not window_end_hit:
             # ---- refill: nothing in flight → dispatch the next chunk
             if pend is None:
+                if sup.liveness is not None:
+                    # dead-peer poll at the PRE-DISPATCH safe point: the
+                    # last place this rank can abort without abandoning a
+                    # peer inside a collective it already entered. Routed
+                    # through handle_failure, where the multi-process
+                    # fail-fast branch writes the crash dump + journal
+                    # marker and raises SupervisorCrash — the relaunch
+                    # supervisor observes the exit and restarts the group
+                    try:
+                        sup.liveness.check()
+                    except Exception as e:
+                        info = {"chunk_start": start_tick + done,
+                                "chunk_ticks": 0, "attempt": failures,
+                                "liveness": True}
+                        handle_failure(e, info, done, 0, carry, done)
+                        continue
                 if _is_deleted(carry):
                     # a donating dispatch consumed the carry before its
                     # chunk failed: fall back to the undonated anchor
@@ -1065,9 +1128,14 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
             # terminal marker: a bounded-window stop (max_chunks) is a
             # PAUSE the caller resumes — the dashboard keeps tailing a
             # "window_end" journal; only true completion is "run_end"
+            # retries/degrade_level ride the terminal marker so post-hoc
+            # analysis (dashboard, banked-window reports) can see what a
+            # number cost without parsing the whole event trail
             writer.submit(lambda: journal.note(
                 "window_end" if done < n_ticks else "run_end",
-                tick=start_tick + done, chunks=report.chunks_run))
+                tick=start_tick + done, chunks=report.chunks_run,
+                retries=report.retries,
+                degrade_level=report.degrade_level))
         # drain barrier at window end: every checkpoint is durable and the
         # journal fsync'd before the caller sees the final state (a
         # deferred writer error — failed checkpoint save — raises here,

@@ -387,6 +387,12 @@ class HealthJournal:
     def header(self, cfg: SimConfig, **meta) -> None:
         from . import checkpoint
         from .faults import attack_schedule
+        from .invariants import FLAGS_VERSION
+
+        # every journal records which fault_flags bit layout wrote it:
+        # readers (dashboard, replay) refuse BY NAME to decode another
+        # version's words instead of misreading moved bits
+        meta.setdefault("flags_version", FLAGS_VERSION)
         sched = attack_schedule(getattr(cfg, "fault_plan", None))
         if sched:
             # attack scenarios stamp their schedule into the run header

@@ -17,6 +17,9 @@ item 5 asks for:
 - checkpoint ticks and crash markers (post-mortem starts here: the crash
   line names the dump directory ``scripts/replay_crash.py`` replays)
 - fleet journals: per-member summary (worst delivery / tripped flags)
+- multihost journals: per-rank heartbeat age, relaunch count, degrade
+  rung, and a DEAD-RANK banner with the mh_supervisor resume command
+  (parallel/resilience.py heartbeats in the run's shared --run-dir)
 
 Usage:
     python scripts/dashboard.py HEALTH_JSONL            # live (2s refresh)
@@ -34,6 +37,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -46,12 +50,17 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _SPARK = " ▁▂▃▄▅▆▇█"
 
 
-def _decode_flags(flags):
+def _decode_flags(flags, version=None):
     if not flags:
         return []
     try:
         from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
-        return decode_flags(int(flags))
+        return decode_flags(int(flags), flags_version=version)
+    except ValueError as e:
+        # the journal header stamps which fault_flags bit layout wrote it
+        # (flags_version); a word from another layout is REFUSED by name —
+        # rendering it through the current table would misread moved bits
+        return [f"UNDECODABLE({e})"[:140]]
     except Exception:
         return [f"0x{int(flags):x}"]
 
@@ -200,6 +209,7 @@ def _snapshot_of(j: dict, path: str) -> dict:
         # schedule (supervisor max_chunks) — live-tail keeps tailing
         "paused": any(n.get("kind") == "window_end" for n in current),
     }
+    _attach_liveness(snap, run)
     if not rows:
         return snap
     members = sorted({r.get("member", -1) for r in rows})
@@ -231,7 +241,8 @@ def _snapshot_of(j: dict, path: str) -> dict:
         # an untracked run must never read as verified-clean (the same
         # not-tracked ≠ clean rule run_traced's None flags encode)
         snap["fault_flags"] = None
-    snap["fault_flag_names"] = _decode_flags(snap["fault_flags"])
+    snap["fault_flag_names"] = _decode_flags(snap["fault_flags"],
+                                             version=run.get("flags_version"))
     _attach_attacks(snap, run, rows)
     # recent trend for the sparkline: mean delivery per tick
     trend: dict = {}
@@ -251,6 +262,65 @@ def _snapshot_of(j: dict, path: str) -> dict:
             "delivery_frac": sum(wf) / len(wf) if wf else None,
             "fault_flags": worst.get("fault_flags")}
     return snap
+
+
+def _attach_liveness(snap: dict, run: dict) -> None:
+    """Multihost resilience view (parallel/resilience.py): a run launched
+    with a ``--run-dir`` stamps ``mh_run_dir`` (+ rung/relaunch
+    provenance) into its health header; the dashboard reads the shared
+    directory's heartbeat files and ``mh_journal.jsonl`` live — per-rank
+    heartbeat age, relaunch count, the current degrade rung, and a
+    DEAD-RANK banner carrying the mh_supervisor resume command."""
+    run_dir = run.get("mh_run_dir")
+    if not run_dir or not os.path.isdir(run_dir):
+        return
+    procs = run.get("processes")
+    now = time.time()
+    ranks = []
+    for name in sorted(os.listdir(run_dir)):
+        m = re.match(r"hb_rank(\d+)\.json$", name)
+        if not m:
+            continue
+        r = int(m.group(1))
+        if isinstance(procs, int) and r >= procs:
+            continue    # stale heartbeat from an earlier, larger attempt
+        try:
+            with open(os.path.join(run_dir, name)) as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue    # torn mid-rename read: next refresh gets it
+        ranks.append({"rank": r,
+                      "age_s": round(now - float(d.get("wall", 0.0)), 1),
+                      "tick": d.get("tick"), "chunk": d.get("chunk"),
+                      "done": bool(d.get("done"))})
+    mh: dict = {"ranks": ranks,
+                "relaunches": run.get("mh_relaunches", 0),
+                "rung": run.get("mh_rung", 0)}
+    jpath = os.path.join(run_dir, "mh_journal.jsonl")
+    if os.path.exists(jpath):
+        recs = []
+        try:
+            with open(jpath) as f:
+                for ln in f:
+                    try:
+                        recs.append(json.loads(ln))
+                    except ValueError:
+                        pass        # torn tail line mid-append
+        except OSError:
+            pass
+        attempts = [r for r in recs if r.get("kind") == "mh_attempt"]
+        if attempts:
+            mh["relaunches"] = max(mh["relaunches"], len(attempts) - 1)
+            mh["rung"] = attempts[-1].get("rung", mh["rung"])
+        head = next((r for r in recs if r.get("kind") == "mh_run"), None)
+        if head and head.get("resume_cmd"):
+            mh["resume_cmd"] = head["resume_cmd"]
+    timeout = run.get("mh_peer_timeout_s") or 30.0
+    # a finished run's ranks stopped beating LEGITIMATELY — no banner
+    mh["dead_ranks"] = [] if snap.get("done") else [
+        r["rank"] for r in ranks
+        if not r["done"] and r["age_s"] > float(timeout)]
+    snap["mh"] = mh
 
 
 def _attach_attacks(snap: dict, run: dict, rows: list) -> None:
@@ -294,6 +364,26 @@ def _attach_attacks(snap: dict, run: dict, rows: list) -> None:
                               "detail": f"contract evaluation failed: {e}"}]
 
 
+def _render_mh(snap: dict, out: list) -> None:
+    """The multihost rank-liveness block (``_attach_liveness``) — shared
+    by the normal render path and the no-health-rows-yet early return."""
+    if not snap.get("mh"):
+        return
+    mh = snap["mh"]
+    if mh.get("ranks"):
+        out.append("  ranks " + "  ".join(
+            f"r{r['rank']}:" + ("done" if r["done"]
+                                else f"t{r['tick']} {r['age_s']:.0f}s")
+            for r in mh["ranks"]))
+    out.append(f"  relaunches {mh.get('relaunches', 0)}   "
+               f"degrade rung {mh.get('rung', 0)}")
+    for r in mh.get("dead_ranks", []):
+        out.append(f"  DEAD RANK {r}: heartbeat stale — group "
+                   "relaunch required")
+    if mh.get("dead_ranks") and mh.get("resume_cmd"):
+        out.append(f"    resume: {mh['resume_cmd']}")
+
+
 def render(snap: dict) -> str:
     out = []
     run = snap.get("run", {})
@@ -307,8 +397,11 @@ def render(snap: dict) -> str:
     out.append(f"== graft telemetry :: {title} ({shape}) [{status}] ==")
     if "tick" not in snap:
         # a first-chunk crash journals no health rows — the crash pointer
-        # (the post-mortem entry point) must still render
+        # (the post-mortem entry point) must still render, and so must the
+        # rank-liveness block: a rank that dies during init/compile is
+        # exactly the DEAD-RANK-banner case
         out.append("  (no health rows yet)")
+        _render_mh(snap, out)
         for c in snap.get("crashes", []):
             out.append(f"  CRASH @ tick {c.get('tick')}: {c.get('error')}")
             out.append(f"    replay: python scripts/replay_crash.py "
@@ -378,6 +471,7 @@ def render(snap: dict) -> str:
     if snap.get("checkpoints"):
         out.append("  checkpoints @ " + ", ".join(
             str(t) for t in snap["checkpoints"][-4:]))
+    _render_mh(snap, out)
     for c in snap.get("crashes", []):
         out.append(f"  CRASH @ tick {c.get('tick')}: {c.get('error')}")
         out.append(f"    replay: python scripts/replay_crash.py "

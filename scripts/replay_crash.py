@@ -44,6 +44,23 @@ def load_meta(crash_dir: str) -> dict:
         return json.load(f)
 
 
+def _check_flags_version(meta: dict, crash_dir: str) -> None:
+    """Refuse BY NAME to replay a dump recorded under another fault_flags
+    bit layout (sim/invariants.FLAGS_VERSION): a version-1 word's
+    violation bits 8-9 would silently misread as FAULT_CENSOR/FAULT_WAVE
+    under the current layout. Dumps from before versioning (no
+    ``flags_version`` field) pass, as before."""
+    from go_libp2p_pubsub_tpu.sim.invariants import FLAGS_VERSION
+    ver = meta.get("flags_version")
+    if ver is not None and int(ver) != FLAGS_VERSION:
+        raise SystemExit(
+            f"crash dump {crash_dir!r} was recorded under flags_version="
+            f"{int(ver)} but this build decodes flags_version="
+            f"{FLAGS_VERSION} — the fault_flags bit layouts differ; "
+            "replay it with the build that wrote it instead of "
+            "misreading its bits")
+
+
 def replay(crash_dir: str, like=None, cfg=None, tp=None,
            invariant_mode: str = "raise") -> dict:
     """Re-run the dump's failing window; returns a result record with
@@ -61,6 +78,7 @@ def replay(crash_dir: str, like=None, cfg=None, tp=None,
     from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
 
     meta = load_meta(crash_dir)
+    _check_flags_version(meta, crash_dir)
     if cfg is None or like is None or tp is None:
         from go_libp2p_pubsub_tpu.sim import scenarios
         name = meta.get("scenario")
@@ -133,6 +151,7 @@ def replay_fleet(crash_dir: str, member: int, like=None, cfg=None, tp=None,
     from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
 
     meta = load_meta(crash_dir)
+    _check_flags_version(meta, crash_dir)
     if not is_fleet_dump(meta):
         raise SystemExit(f"{crash_dir!r} is not a fleet dump; run without "
                          "--member")

@@ -82,9 +82,35 @@ def main() -> None:
     ap.add_argument("--dump-state", default=None,
                     help="rank-0 .npz of the final host-complete state "
                          "(parity smoke)")
+    ap.add_argument("--run-dir", default=None,
+                    help="SHARED resilience-plane directory (or "
+                         "$GRAFT_MH_RUN_DIR): every rank beats a "
+                         "heartbeat file here and watches its peers' "
+                         "(parallel/resilience.py) — a dead peer aborts "
+                         "this rank at a chunk boundary instead of "
+                         "hanging a collective. scripts/mh_supervisor.py "
+                         "owns the directory when it drives the group")
     args = ap.parse_args()
 
-    from go_libp2p_pubsub_tpu.parallel import multihost
+    from go_libp2p_pubsub_tpu.parallel import multihost, resilience
+
+    run_dir = args.run_dir or os.environ.get("GRAFT_MH_RUN_DIR") or None
+    liveness = None
+    if run_dir:
+        # liveness starts BEFORE jax.distributed: rank/nproc come from the
+        # args/env the launcher already requires, and the first beat lands
+        # even if this rank later wedges in the coordinator handshake (the
+        # relaunch supervisor's stall detector needs exactly that signal)
+        rank_hint = args.process_id if args.process_id is not None \
+            else int(os.environ.get(multihost.ENV_PROCESS_ID, "0"))
+        nproc_hint = args.num_processes if args.num_processes is not None \
+            else int(os.environ.get(multihost.ENV_NUM_PROCESSES, "1"))
+        liveness = resilience.RankLiveness.from_env(
+            run_dir, rank_hint, nproc_hint).start()
+    chaos = resilience.ChaosPlan.from_env(
+        args.process_id if args.process_id is not None
+        else int(os.environ.get(multihost.ENV_PROCESS_ID, "0")), run_dir)
+
     # MUST precede any backend touch (device discovery happens at init)
     multihost.initialize(args.coordinator, args.num_processes,
                          args.process_id)
@@ -167,12 +193,29 @@ def main() -> None:
         loc = multihost.local_rows_state(host_state, cfg, rank, n_proc)
         return multihost.global_state(loc, mesh, cfg)
 
+    # relaunch provenance from the group supervisor (mh_supervisor.py):
+    # the agreed degrade rung (GRAFT_MH_RUNG → SupervisorConfig
+    # initial_degrade via from_env) and how many relaunches this attempt
+    # rides on — stamped into the health header so dashboards and
+    # post-hoc analysis see what a banked number cost
+    relaunches = int(os.environ.get("GRAFT_MH_RELAUNCHES", "0"))
+    health_meta = {"processes": n_proc}
+    if run_dir:
+        health_meta.update(
+            mh_run_dir=os.path.abspath(run_dir),
+            mh_rung=int(os.environ.get("GRAFT_MH_RUNG", "0")),
+            mh_relaunches=relaunches,
+            mh_peer_timeout_s=(liveness.peer_timeout_s
+                               if liveness is not None else None))
+
     sup = SupervisorConfig.from_env(
         scenario=args.scenario,
         run_fn=run_fn,
         state_to_host=multihost.gather_state,
         state_from_host=state_from_host,
         write_files=coord,
+        liveness=liveness,
+        health_meta=health_meta,
         **({"health_path": health} if health else {}),
         **({"chunk_ticks": args.chunk_ticks} if args.chunk_ticks else {}),
         **({"max_chunks": args.max_chunks} if args.max_chunks else {}),
@@ -180,15 +223,25 @@ def main() -> None:
            if args.checkpoint_dir else {}),
     )
 
-    t0 = time.perf_counter()
-    state, report = supervised_run(state, cfg, tp,
-                                   jax.random.PRNGKey(args.seed),
-                                   args.ticks, sup)
-    wall = time.perf_counter() - t0
+    try:
+        t0 = time.perf_counter()
+        state, report = supervised_run(state, cfg, tp,
+                                       jax.random.PRNGKey(args.seed),
+                                       args.ticks, sup,
+                                       _chunk_hook=chaos.fire
+                                       if chaos is not None else None)
+        wall = time.perf_counter() - t0
 
-    # final host-complete copy: collective gather on every rank, writes on
-    # rank 0 only (the same discipline the checkpoint boundaries use)
-    host = multihost.gather_state(state)
+        # final host-complete copy: collective gather on every rank,
+        # writes on rank 0 only (the checkpoint-boundary discipline)
+        host = multihost.gather_state(state)
+        if liveness is not None:
+            # mark this rank's heartbeat done BEFORE the skewed teardown
+            # window: a peer must never read a finished rank as dead
+            liveness.finish()
+    finally:
+        if liveness is not None:
+            liveness.stop()
     if coord:
         from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction
         from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
@@ -205,6 +258,9 @@ def main() -> None:
             "fault_flags": flags, "fault_flag_names": decode_flags(flags),
             "state_nbytes_per_shard": budget["per_shard"],
         }
+        if run_dir:
+            line["mh_rung"] = int(os.environ.get("GRAFT_MH_RUNG", "0"))
+            line["mh_relaunches"] = relaunches
         print(json.dumps(line), flush=True)
         if args.journal:
             with open(args.journal, "a") as f:

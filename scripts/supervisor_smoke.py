@@ -131,6 +131,30 @@ def run_smoke(base_dir: str | None = None, emit=print) -> int:
               and rep_result["tripped"] is False
               and rep_result.get("fault_flags") == 0,
               dump=dump, replay=rep_result)
+
+        # --- stage 4: GRAFT_CHAOS-style stall -> deadline trip -> retry,
+        # once-only marker semantics, parity. A stall (not a kill: this
+        # smoke runs IN-PROCESS under pytest) armed for chunk_start>=4
+        # sleeps past the deadline exactly once — the durable marker file
+        # in the run dir keeps the retry from refiring, which is the same
+        # mechanism that lets mh_supervisor.py relaunch a chaos-killed
+        # group without the chaos killing it again.
+        from go_libp2p_pubsub_tpu.parallel.resilience import ChaosPlan
+        chaos_dir = os.path.join(base_dir, "chaos")
+        os.makedirs(chaos_dir, exist_ok=True)
+        plan = ChaosPlan(ChaosPlan.parse(f"stall@0:4:{deadline + 1.0}"),
+                         rank=0, run_dir=chaos_dir)
+        sup4 = SupervisorConfig(
+            chunk_ticks=4, deadline_s=deadline, backoff_base_s=0.01,
+            scenario="1k_single_topic", scenario_kwargs=kwargs)
+        out4, rep4 = supervised_run(st, cfg, tp, key, n_ticks, sup4,
+                                    _chunk_hook=plan.fire)
+        markers = [m for m in os.listdir(chaos_dir)
+                   if m.startswith("chaos_") and m.endswith(".fired")]
+        stage("chaos_stall_recovery",
+              _states_equal(out4, ref) and rep4.retries >= 1
+              and len(markers) == 1,
+              retries=rep4.retries, markers=markers)
     finally:
         if own_tmp is not None:
             own_tmp.cleanup()

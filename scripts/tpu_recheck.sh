@@ -5,10 +5,16 @@
 # slot-width/selection), then the diagnostics (ablations, microbenches,
 # Pallas lowering smoke). Each step logs independently so a tunnel wedge
 # mid-way loses only the remaining steps.
+# mh_resilience exercises the GRAFT_CHAOS kill -> relaunch -> elastic
+# resume path (scripts/mh_supervisor.py) on CPU deliberately: the remote
+# TPU admits one client at a time, and what the step proves is the
+# recovery protocol, not the backend. --fresh wipes prior chaos markers
+# so a re-run refires the kill.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p /tmp/tpu_recheck
 for step in "supervisor_smoke:python scripts/supervisor_smoke.py" \
+            "mh_resilience:env JAX_PLATFORMS=cpu GRAFT_CHAOS=kill@1:4 python scripts/mh_supervisor.py --procs 2,1 --scenario frontier_250k --n 128 --ticks 6 --chunk-ticks 2 --seed 7 --run-dir /tmp/tpu_recheck/mh_resilience --fresh --max-relaunches 2 --backoff-base-s 0.2" \
             "bench:python bench.py" \
             "bench_fleet:env BENCH_SCENARIOS=fleet_256x1k,1k_single_topic python bench.py" \
             "bench_frontier:env BENCH_SCENARIOS=frontier_250k,frontier_500k,frontier_1m GRAFT_DEADLINE_S=900 python bench.py" \

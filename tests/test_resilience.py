@@ -1,0 +1,604 @@
+"""Distributed resilience plane (ISSUE 14): rank liveness & coordinated
+abort, the GRAFT_CHAOS fault-injection knob, the mh_supervisor relaunch
+driver, elastic cross-process-count checkpoint resume, and the
+fault_flags layout versioning — capped by THE acceptance test: a real
+2-process CPU run whose rank 1 is SIGKILLed mid-window, automatically
+relaunched by scripts/mh_supervisor.py at a DIFFERENT process count from
+the last drained checkpoint, finishing bit-exact vs the uninterrupted
+single-process run.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from go_libp2p_pubsub_tpu.parallel.resilience import (  # noqa: E402
+    EXIT_PEER_DEAD, ChaosPlan, PeerDeadError, RankLiveness, heartbeat_path)
+
+
+# ---------------------------------------------------------------------------
+# ChaosPlan: the GRAFT_CHAOS knob
+
+
+class TestChaosPlan:
+    def test_parse_kill_and_stall(self):
+        specs = ChaosPlan.parse("kill@1:4, stall@0:2:1.5")
+        assert specs == [
+            {"action": "kill", "rank": 1, "tick": 4, "seconds": 0.0},
+            {"action": "stall", "rank": 0, "tick": 2, "seconds": 1.5}]
+
+    @pytest.mark.parametrize("bad", ["boom@1:2", "kill@1", "kill@x:2",
+                                     "stall@0:2", "kill@1:2:3"])
+    def test_parse_refuses_by_name(self, bad):
+        with pytest.raises(ValueError, match="GRAFT_CHAOS"):
+            ChaosPlan.parse(bad)
+
+    def test_from_env_unset_is_none(self, monkeypatch):
+        monkeypatch.delenv("GRAFT_CHAOS", raising=False)
+        assert ChaosPlan.from_env(0) is None
+        monkeypatch.setenv("GRAFT_CHAOS", "kill@0:3")
+        assert ChaosPlan.from_env(0) is not None
+
+    def test_rank_filter(self, tmp_path):
+        fired = []
+        plan = ChaosPlan(ChaosPlan.parse("kill@1:0"), rank=0,
+                         run_dir=str(tmp_path),
+                         kill=lambda: fired.append("kill"))
+        plan.fire({"chunk_start": 5})
+        assert fired == []          # the spec names rank 1, we are rank 0
+
+    def test_fires_once_and_marker_persists_across_instances(self, tmp_path):
+        fired = []
+        mk = lambda: ChaosPlan(ChaosPlan.parse("kill@0:2"), rank=0,
+                               run_dir=str(tmp_path),
+                               kill=lambda: fired.append("kill"))
+        plan = mk()
+        plan.fire({"chunk_start": 0})       # below the armed tick
+        assert fired == []
+        plan.fire({"chunk_start": 2})
+        plan.fire({"chunk_start": 4})       # same spec, already fired
+        assert fired == ["kill"]
+        # a RELAUNCHED rank (fresh process, same run dir) must not refire:
+        # the durable marker is what lets mh_supervisor relaunch a
+        # chaos-killed group without the chaos killing it again
+        mk().fire({"chunk_start": 2})
+        assert fired == ["kill"]
+        markers = [n for n in os.listdir(tmp_path) if n.endswith(".fired")]
+        assert markers == ["chaos_kill_r0_t2.fired"]
+
+    def test_stall_sleeps(self, tmp_path):
+        slept = []
+        plan = ChaosPlan(ChaosPlan.parse("stall@0:1:7.5"), rank=0,
+                         run_dir=str(tmp_path), sleep=slept.append)
+        plan.fire({"chunk_start": 3})
+        assert slept == [7.5]
+
+
+# ---------------------------------------------------------------------------
+# RankLiveness: heartbeats, dead-peer detection, the watchdog
+
+
+def _mk_liveness(run_dir, rank, nproc, **kw):
+    kw.setdefault("peer_timeout_s", 0.3)
+    kw.setdefault("beat_interval_s", 0.05)
+    kw.setdefault("startup_grace_s", 0.15)
+    kw.setdefault("abort_grace_s", 0.1)
+    kw.setdefault("hard_exit", lambda code: None)
+    return RankLiveness(str(run_dir), rank, nproc, **kw)
+
+
+class TestRankLiveness:
+    def test_beat_writes_progress(self, tmp_path):
+        lv = _mk_liveness(tmp_path, 0, 1)
+        lv.beat(tick=7, chunk=3)
+        with open(heartbeat_path(str(tmp_path), 0)) as f:
+            d = json.load(f)
+        assert (d["rank"], d["tick"], d["chunk"], d["done"]) == (0, 7, 3,
+                                                                 False)
+
+    def test_missing_peer_after_grace(self, tmp_path):
+        lv = _mk_liveness(tmp_path, 0, 2)
+        lv.beat()
+        assert lv.dead_peers() == []        # still inside startup grace
+        time.sleep(0.2)
+        with pytest.raises(PeerDeadError, match="rank 1"):
+            lv.check()
+
+    def test_stale_peer_then_refresh(self, tmp_path):
+        lv = _mk_liveness(tmp_path, 0, 2)
+        peer = _mk_liveness(tmp_path, 1, 2)
+        peer.beat(tick=1)
+        lv.check()                          # fresh peer: healthy
+        time.sleep(0.4)                     # > peer_timeout_s
+        with pytest.raises(PeerDeadError, match="rank 1.*stale"):
+            lv.check()
+        peer.beat(tick=2)                   # peer came back
+        lv.check()
+
+    def test_finished_peer_is_never_dead(self, tmp_path):
+        lv = _mk_liveness(tmp_path, 0, 2)
+        peer = _mk_liveness(tmp_path, 1, 2)
+        peer.finish()
+        time.sleep(0.4)                     # stale by age, but done=True
+        lv.check()
+
+    def test_error_names_the_relaunch_supervisor(self, tmp_path):
+        lv = _mk_liveness(tmp_path, 0, 2, startup_grace_s=0.0)
+        with pytest.raises(PeerDeadError, match="mh_supervisor"):
+            lv.check()
+
+    def test_watchdog_hard_exits_when_blocked(self, tmp_path):
+        # the backstop for a rank BLOCKED inside a collective: the beater
+        # thread sights the dead peer and, after abort_grace_s, calls
+        # hard_exit(EXIT_PEER_DEAD) — injected here so the test survives
+        exits = []
+        lv = _mk_liveness(tmp_path, 0, 2, startup_grace_s=0.0,
+                          hard_exit=exits.append)
+        lv.start()
+        try:
+            deadline = time.time() + 3.0
+            while not exits and time.time() < deadline:
+                time.sleep(0.05)
+        finally:
+            lv.stop()
+        assert exits and exits[0] == EXIT_PEER_DEAD
+
+    def test_watchdog_keeps_own_heartbeat_fresh(self, tmp_path):
+        lv = _mk_liveness(tmp_path, 0, 1)
+        lv.start()
+        try:
+            time.sleep(0.2)
+            with open(heartbeat_path(str(tmp_path), 0)) as f:
+                age = time.time() - json.load(f)["wall"]
+            assert age < 0.2                # refreshed by the beater
+        finally:
+            lv.stop()
+
+    def test_from_env_reads_knobs(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("GRAFT_MH_PEER_TIMEOUT_S", "11")
+        monkeypatch.setenv("GRAFT_MH_ABORT_GRACE_S", "4")
+        lv = RankLiveness.from_env(str(tmp_path), 1, 3)
+        assert (lv.peer_timeout_s, lv.abort_grace_s,
+                lv.rank, lv.num_processes) == (11.0, 4.0, 1, 3)
+
+
+# ---------------------------------------------------------------------------
+# fault_flags layout versioning (the PR 10 decode hazard, closed by name)
+
+
+class TestFlagsVersion:
+    def test_current_version_decodes(self):
+        from go_libp2p_pubsub_tpu.sim.invariants import (
+            FAULT_ECLIPSE, FLAGS_VERSION, decode_flags)
+        assert decode_flags(FAULT_ECLIPSE,
+                            flags_version=FLAGS_VERSION) == ["eclipse"]
+        # None (a pre-versioning artifact) still decodes, as before
+        assert decode_flags(FAULT_ECLIPSE) == ["eclipse"]
+
+    def test_old_version_refused_by_name(self):
+        from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+        # a v1 word's bits 8-9 were violations; decoding them as
+        # FAULT_CENSOR/FAULT_WAVE would be silent misreading
+        with pytest.raises(ValueError, match="flags_version"):
+            decode_flags(1 << 8, flags_version=1)
+
+    def test_journal_header_stamps_version(self, tmp_path):
+        from go_libp2p_pubsub_tpu.sim.invariants import FLAGS_VERSION
+        from go_libp2p_pubsub_tpu.sim.scenarios import single_topic_1k
+        from go_libp2p_pubsub_tpu.sim.telemetry import (
+            HealthJournal, read_journal)
+        cfg, _tp, _st = single_topic_1k(n_peers=64, k_slots=8, degree=4)
+        path = str(tmp_path / "health.jsonl")
+        j = HealthJournal(path)
+        j.header(cfg, scenario="x")
+        j.close()
+        run = read_journal(path)["runs"][-1]
+        assert run["flags_version"] == FLAGS_VERSION
+
+    def test_crash_dump_stamps_version(self, tmp_path):
+        import jax
+
+        from go_libp2p_pubsub_tpu.sim.invariants import FLAGS_VERSION
+        from go_libp2p_pubsub_tpu.sim.scenarios import single_topic_1k
+        from go_libp2p_pubsub_tpu.sim.supervisor import (
+            SupervisorConfig, SupervisorReport, _write_crash_dump)
+        cfg, tp, st = single_topic_1k(n_peers=64, k_slots=8, degree=4)
+        sup = SupervisorConfig(crash_dir=str(tmp_path / "crash"))
+        dump = _write_crash_dump(
+            sup, cfg, st, jax.random.split(jax.random.PRNGKey(0), 2),
+            0, 0, 2, 4, RuntimeError("boom"), SupervisorReport())
+        with open(os.path.join(dump, "crash.json")) as f:
+            assert json.load(f)["flags_version"] == FLAGS_VERSION
+
+    def test_replay_refuses_old_dump_by_name(self, tmp_path):
+        from scripts.replay_crash import replay
+        dump = tmp_path / "crash_old"
+        dump.mkdir()
+        (dump / "crash.json").write_text(json.dumps(
+            {"flags_version": 1, "scenario": "1k_single_topic",
+             "fault_flags": 1 << 8}))
+        with pytest.raises(SystemExit, match="flags_version"):
+            replay(str(dump))
+
+
+# ---------------------------------------------------------------------------
+# Elastic checkpoint: save at P, restore/re-slice at P'
+
+
+def _frontier_state():
+    from go_libp2p_pubsub_tpu.parallel import multihost
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    cfg, tp, topo, subscribed = scenarios.frontier_spec(128)
+    full = multihost.init_state_local(cfg, topo, 0, 1,
+                                      subscribed=subscribed)
+    return cfg, tp, full
+
+
+class TestElasticCheckpoint:
+    def test_sidecar_stamps_processes_and_meta_reads_it(self, tmp_path):
+        from go_libp2p_pubsub_tpu.sim import checkpoint
+        cfg, _tp, full = _frontier_state()
+        path = str(tmp_path / "ck.npz")
+        checkpoint.save(path, full, cfg=cfg, processes=2)
+        meta = checkpoint.sidecar_meta(path)
+        assert meta["processes"] == "2"
+        assert meta["fingerprint"] == checkpoint.config_fingerprint(cfg)
+        assert checkpoint.sidecar_meta(str(tmp_path / "nope.npz")) == {}
+
+    def test_cross_process_count_restore_bit_exact(self, tmp_path):
+        # save "at P=2" (the gathered state is host-complete either way),
+        # restore at P'=1: bit-exact; then re-slice the restored state at
+        # P'=4 and reassemble: the elastic path end to end
+        from go_libp2p_pubsub_tpu.parallel import multihost
+        from go_libp2p_pubsub_tpu.sim import checkpoint
+        from go_libp2p_pubsub_tpu.sim.state import SimState, state_spec
+        cfg, _tp, full = _frontier_state()
+        path = str(tmp_path / "ck.npz")
+        checkpoint.save(path, full, cfg=cfg, processes=2)
+        got = checkpoint.restore(path, full, cfg=cfg)   # P'=1: no refusal
+        for f in SimState._fields:
+            assert np.array_equal(np.asarray(getattr(got, f)),
+                                  np.asarray(getattr(full, f))), f
+        spec = state_spec(cfg)
+        slices = [multihost.local_rows_state(got, cfg, r, 4)
+                  for r in range(4)]
+        for f in SimState._fields:
+            want = np.asarray(getattr(full, f))
+            if spec[f][2]:      # peer-major: the rank slices concat back
+                assert np.array_equal(np.concatenate(
+                    [np.asarray(getattr(s, f)) for s in slices]), want), f
+            else:               # replicated: every rank holds the whole
+                for s in slices:
+                    assert np.array_equal(np.asarray(getattr(s, f)),
+                                          want), f
+
+    def test_non_dividing_process_count_refused_by_name(self, tmp_path):
+        from go_libp2p_pubsub_tpu.parallel import multihost
+        cfg, _tp, full = _frontier_state()
+        with pytest.raises(ValueError, match="divide evenly"):
+            multihost.local_rows_state(full, cfg, 0, 3)     # 128 % 3 != 0
+
+    def test_drifted_layout_still_refused_by_name(self, tmp_path):
+        from go_libp2p_pubsub_tpu.parallel import multihost
+        from go_libp2p_pubsub_tpu.sim import checkpoint, scenarios
+        cfg, _tp, full = _frontier_state()
+        path = str(tmp_path / "ck.npz")
+        checkpoint.save(path, full)                 # no cfg: shape check
+        cfg2, _tp2, topo2, sub2 = scenarios.frontier_spec(256)
+        like2 = multihost.init_state_local(cfg2, topo2, 0, 1,
+                                           subscribed=sub2)
+        with pytest.raises(ValueError, match="checkpoint field"):
+            checkpoint.restore(path, like2)
+
+    def test_cross_precision_still_refused_by_name(self, tmp_path):
+        from go_libp2p_pubsub_tpu.sim import checkpoint
+        cfg, _tp, full = _frontier_state()
+        path = str(tmp_path / "ck.npz")
+        checkpoint.save(path, full, cfg=cfg, processes=2)
+        compact = dataclasses.replace(cfg, state_precision="compact")
+        with pytest.raises(ValueError, match="state_precision"):
+            checkpoint.restore(path, full, cfg=compact)
+
+    def test_knob_drift_still_refused(self, tmp_path):
+        from go_libp2p_pubsub_tpu.sim import checkpoint
+        cfg, _tp, full = _frontier_state()
+        path = str(tmp_path / "ck.npz")
+        checkpoint.save(path, full, cfg=cfg, processes=2)
+        drifted = dataclasses.replace(cfg, dhi=cfg.dhi + 1)
+        with pytest.raises(ValueError, match="different config"):
+            checkpoint.restore(path, full, cfg=drifted)
+
+
+# ---------------------------------------------------------------------------
+# Supervisor integration: initial_degrade (the rank-symmetric rung) and
+# the liveness hook
+
+
+def _tiny_run(n_ticks=6):
+    import jax
+
+    from go_libp2p_pubsub_tpu.sim.scenarios import single_topic_1k
+    cfg, tp, st = single_topic_1k(n_peers=64, k_slots=8, degree=4)
+    return cfg, tp, st, jax.random.PRNGKey(3), n_ticks
+
+
+class TestSupervisorResilience:
+    def test_initial_degrade_is_trajectory_neutral(self):
+        from go_libp2p_pubsub_tpu.sim.engine import run
+        from go_libp2p_pubsub_tpu.sim.supervisor import (
+            SupervisorConfig, supervised_run)
+        cfg, tp, st, key, n_ticks = _tiny_run()
+        ref = run(st, cfg, tp, key, n_ticks)
+        out, rep = supervised_run(
+            st, cfg, tp, key, n_ticks,
+            SupervisorConfig(chunk_ticks=2, initial_degrade=2))
+        for a, b in zip(out, ref):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert rep.degrade_level >= 2
+        assert [e for e in rep.events if e["event"] == "degrade"]
+
+    def test_initial_degrade_from_env(self, monkeypatch):
+        from go_libp2p_pubsub_tpu.sim.supervisor import SupervisorConfig
+        monkeypatch.setenv("GRAFT_MH_RUNG", "3")
+        assert SupervisorConfig.from_env().initial_degrade == 3
+
+    def test_dead_peer_aborts_at_chunk_boundary(self, tmp_path):
+        # single-process stand-in for the multi-rank abort: a liveness
+        # that claims 2 processes with no peer file trips check() at the
+        # pre-dispatch safe point; with retries exhausted the run crashes
+        # (dump written) instead of dispatching into dead collectives
+        from go_libp2p_pubsub_tpu.sim.supervisor import (
+            SupervisorConfig, SupervisorCrash, supervised_run)
+        cfg, tp, st, key, n_ticks = _tiny_run()
+        lv = _mk_liveness(tmp_path, 0, 2, startup_grace_s=0.0)
+        sup = SupervisorConfig(
+            chunk_ticks=2, max_retries=0, backoff_base_s=0.0,
+            sleep=lambda s: None, liveness=lv,
+            crash_dir=str(tmp_path / "crash"))
+        with pytest.raises(SupervisorCrash):
+            supervised_run(st, cfg, tp, key, n_ticks, sup)
+
+    def test_healthy_liveness_beats_to_completion(self, tmp_path):
+        from go_libp2p_pubsub_tpu.sim.engine import run
+        from go_libp2p_pubsub_tpu.sim.supervisor import (
+            SupervisorConfig, supervised_run)
+        cfg, tp, st, key, n_ticks = _tiny_run()
+        lv = _mk_liveness(tmp_path, 0, 1)
+        out, _rep = supervised_run(
+            st, cfg, tp, key, n_ticks,
+            SupervisorConfig(chunk_ticks=2, liveness=lv))
+        ref = run(st, cfg, tp, key, n_ticks)
+        for a, b in zip(out, ref):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        with open(heartbeat_path(str(tmp_path), 0)) as f:
+            assert json.load(f)["tick"] == n_ticks
+
+
+# ---------------------------------------------------------------------------
+# mh_supervisor helpers (jax-free parent)
+
+
+class TestMhSupervisorHelpers:
+    def test_parse_procs(self):
+        from scripts.mh_supervisor import parse_procs
+        assert parse_procs("8,8,4") == [8, 8, 4]
+        assert parse_procs("2") == [2]
+        for bad in ("", "2,x", "0", "-1,2"):
+            with pytest.raises(ValueError, match="--procs"):
+                parse_procs(bad)
+
+    def test_newest_ckpt_tick(self, tmp_path):
+        from scripts.mh_supervisor import _newest_ckpt_tick
+        assert _newest_ckpt_tick(str(tmp_path / "nope")) is None
+        d = tmp_path / "ckpt"
+        d.mkdir()
+        assert _newest_ckpt_tick(str(d)) is None
+        (d / "ckpt_t000000002.npz").touch()
+        (d / "ckpt_t000000010.npz").touch()
+        (d / "ckpt_t000000004.fingerprint").touch()
+        (d / "garbage.txt").touch()
+        assert _newest_ckpt_tick(str(d)) == 10
+
+
+# ---------------------------------------------------------------------------
+# Dashboard: rank liveness rendering
+
+
+class TestDashboardLiveness:
+    def _fabricate(self, tmp_path, dead=True):
+        run_dir = tmp_path / "mh"
+        run_dir.mkdir()
+        now = time.time()
+        (run_dir / "hb_rank0.json").write_text(json.dumps(
+            {"rank": 0, "tick": 4, "chunk": 2, "wall": now, "done": False}))
+        (run_dir / "hb_rank1.json").write_text(json.dumps(
+            {"rank": 1, "tick": 2, "chunk": 1,
+             "wall": now - (100 if dead else 0), "done": False}))
+        # a stale file from an earlier 4-rank attempt must be filtered
+        (run_dir / "hb_rank3.json").write_text(json.dumps(
+            {"rank": 3, "tick": 0, "chunk": 0, "wall": 0, "done": False}))
+        with open(run_dir / "mh_journal.jsonl", "w") as f:
+            f.write(json.dumps({"kind": "mh_run", "resume_cmd":
+                                "python scripts/mh_supervisor.py --procs "
+                                "2,1 --run-dir X"}) + "\n")
+            f.write(json.dumps({"kind": "mh_attempt", "attempt": 0,
+                                "procs": 2, "rung": 0}) + "\n")
+            f.write(json.dumps({"kind": "mh_attempt", "attempt": 1,
+                                "procs": 2, "rung": 1}) + "\n")
+        health = tmp_path / "health.jsonl"
+        health.write_text(json.dumps(
+            {"kind": "run", "wall": now, "scenario": "frontier_250k",
+             "n_peers": 128, "processes": 2, "flags_version": 2,
+             "mh_run_dir": str(run_dir), "mh_rung": 0,
+             "mh_relaunches": 0, "mh_peer_timeout_s": 5.0}) + "\n")
+        return str(health)
+
+    def test_snapshot_carries_liveness(self, tmp_path):
+        from scripts.dashboard import snapshot
+        snap = snapshot(self._fabricate(tmp_path))
+        mh = snap["mh"]
+        assert [r["rank"] for r in mh["ranks"]] == [0, 1]   # rank 3 gone
+        assert mh["dead_ranks"] == [1]
+        assert mh["relaunches"] == 1        # two attempts = one relaunch
+        assert mh["rung"] == 1
+        assert "mh_supervisor" in mh["resume_cmd"]
+
+    def test_render_dead_rank_banner_and_resume(self, tmp_path):
+        from scripts.dashboard import render, snapshot
+        text = render(snapshot(self._fabricate(tmp_path)))
+        assert "DEAD RANK 1" in text
+        assert "mh_supervisor" in text
+        assert "relaunches 1" in text and "rung 1" in text
+
+    def test_healthy_ranks_no_banner(self, tmp_path):
+        from scripts.dashboard import render, snapshot
+        snap = snapshot(self._fabricate(tmp_path, dead=False))
+        assert snap["mh"]["dead_ranks"] == []
+        assert "DEAD RANK" not in render(snap)
+
+    def test_decode_refusal_renders_by_name(self):
+        from scripts.dashboard import _decode_flags
+        names = _decode_flags(1 << 8, version=1)
+        assert len(names) == 1 and names[0].startswith("UNDECODABLE(")
+        assert "flags_version" in names[0]
+
+
+def test_mh_supervisor_sigterm_tears_down_group(tmp_path):
+    """The group must never outlive its owner: SIGTERM to mh_supervisor
+    (scheduler preemption, ctrl-C) tears down every rank it launched —
+    orphaned ranks would keep beating (possibly wedged in collectives)
+    forever, poisoning the run dir for the resume."""
+    run_dir = tmp_path / "mh"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               # both ranks stall 120s at the first chunk: plenty of
+               # window to signal the parent while children are alive
+               GRAFT_CHAOS="stall@0:0:120,stall@1:0:120",
+               GRAFT_MH_BEAT_INTERVAL_S="0.5")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "mh_supervisor.py"),
+         "--procs", "2", "--scenario", "frontier_250k", "--n", "128",
+         "--ticks", "6", "--seed", "7", "--chunk-ticks", "2",
+         "--run-dir", str(run_dir), "--max-relaunches", "0"],
+        env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    try:
+        pids = {}
+        deadline = time.time() + 120
+        while len(pids) < 2 and time.time() < deadline:
+            for r in (0, 1):
+                try:
+                    with open(heartbeat_path(str(run_dir), r)) as f:
+                        pids[r] = json.load(f)["pid"]
+                except (OSError, ValueError):
+                    pass
+            time.sleep(0.25)
+        assert len(pids) == 2, "ranks never started beating"
+        proc.send_signal(15)            # SIGTERM the group owner
+        assert proc.wait(timeout=30) == 143
+        deadline = time.time() + 15     # teardown: TERM, 5s grace, KILL
+        live = lambda pid: os.path.exists(f"/proc/{pid}")
+        while any(live(p) for p in pids.values()) \
+                and time.time() < deadline:
+            time.sleep(0.25)
+        assert not any(live(p) for p in pids.values()), \
+            f"orphaned rank processes survived the owner: {pids}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    journal = [json.loads(ln)
+               for ln in (run_dir / "mh_journal.jsonl").read_text()
+               .splitlines()]
+    assert any(r["kind"] == "mh_signal" and r["signum"] == 15
+               for r in journal)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance test: SIGKILL a rank mid-run, supervised relaunch at a
+# different process count, bit-exact final state
+
+
+def _reference_state(ticks: int):
+    import jax
+
+    from go_libp2p_pubsub_tpu.parallel import multihost
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    from go_libp2p_pubsub_tpu.sim.engine import run_keys
+    cfg, tp, topo, subscribed = scenarios.frontier_spec(128)
+    st = multihost.init_state_local(cfg, topo, 0, 1, subscribed=subscribed)
+    keys = jax.random.split(jax.random.PRNGKey(7), ticks)
+    return run_keys(st, cfg, tp, keys)
+
+
+def test_mh_supervisor_sigkill_relaunch_elastic_bit_exact(tmp_path):
+    """ISSUE 14 acceptance: rank 1 of a 2-process CPU run SIGKILLs itself
+    (GRAFT_CHAOS) at the speculation of chunk [4,6) — after the t=2
+    checkpoint drained — the group supervisor observes the death, tears
+    the group down, and relaunches at P'=1 (elastic re-shard of the P=2
+    checkpoint); the final state is bit-exact vs the uninterrupted
+    single-process run."""
+    run_dir = tmp_path / "mh"
+    final = tmp_path / "final.npz"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # conftest's 8-device flag must not leak
+    env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               GRAFT_CHAOS="kill@1:4",
+               GRAFT_MH_PEER_TIMEOUT_S="6", GRAFT_MH_ABORT_GRACE_S="3",
+               GRAFT_MH_BEAT_INTERVAL_S="0.5")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "mh_supervisor.py"),
+         "--procs", "2,1", "--scenario", "frontier_250k", "--n", "128",
+         "--ticks", "6", "--seed", "7", "--chunk-ticks", "2",
+         "--run-dir", str(run_dir), "--max-relaunches", "2",
+         "--backoff-base-s", "0.05", "--dump-state", str(final),
+         # --health changes the compiled program (telemetry lane): the
+         # supervisor must hand it to EVERY rank or the group wedges on
+         # mismatched collectives — regression pin for exactly that
+         "--health", str(run_dir / "health.jsonl")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=560)
+    journal = [json.loads(ln)
+               for ln in (run_dir / "mh_journal.jsonl").read_text()
+               .splitlines()]
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, journal)
+
+    # the relaunch really happened, elastically: attempt 0 at P=2 died to
+    # the chaos kill, the final attempt ran at P=1 from the drained ckpt
+    attempts = [r for r in journal if r["kind"] == "mh_attempt"]
+    assert len(attempts) >= 2
+    assert attempts[0]["procs"] == 2 and attempts[-1]["procs"] == 1
+    assert any(r["kind"] == "mh_failure" and "rank_exit" in r["why"]
+               for r in journal)
+    assert any(r["kind"] == "mh_done" for r in journal)
+
+    # the relaunched rank RESUMED (not re-ran): its metric line names the
+    # checkpoint it restored — the elastic P=2 → P'=1 re-slice
+    last = attempts[-1]["attempt"]
+    rank0_log = (run_dir / f"rank0.attempt{last}.log").read_text()
+    metric = next(json.loads(ln) for ln in rank0_log.splitlines()
+                  if ln.startswith("{") and "\"metric\"" in ln)
+    assert metric["resumed_from"] is not None
+    assert metric["mh_relaunches"] == last
+
+    # the health journal streamed (rank 0 writes; all ranks ran the
+    # telemetry lane) and its run header carries the liveness pointers
+    # the dashboard's rank view reads
+    from go_libp2p_pubsub_tpu.sim.telemetry import read_journal
+    runs = read_journal(str(run_dir / "health.jsonl"))["runs"]
+    assert runs and runs[-1]["mh_run_dir"] == str(run_dir)
+    assert runs[-1]["flags_version"] is not None
+
+    # bit-exact vs the uninterrupted single-process run
+    from go_libp2p_pubsub_tpu.sim.state import SimState
+    ref = _reference_state(6)
+    got = np.load(final)
+    for f in SimState._fields:
+        assert np.array_equal(np.asarray(getattr(ref, f)), got[f]), f

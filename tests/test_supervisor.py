@@ -398,12 +398,13 @@ class TestPartitionFaultsResume:
 def test_full_ladder_smoke(tmp_path):
     """CI twin of the scripts/tpu_recheck.sh `supervisor_smoke` step:
     deadline trip -> backoff -> degraded mode -> checkpoint/resume ->
-    crash dump -> replay on a tiny config, all stages green."""
+    crash dump -> replay -> chaos-stall recovery on a tiny config, all
+    stages green."""
     from scripts.supervisor_smoke import run_smoke
     lines = []
     assert run_smoke(str(tmp_path), emit=lines.append) == 0
     stages = [json.loads(ln) for ln in lines]
     assert [s["stage"] for s in stages] == [
         "deadline_backoff_degrade", "checkpoint_resume",
-        "crash_dump_replay"]
+        "crash_dump_replay", "chaos_stall_recovery"]
     assert all(s["status"] == "ok" for s in stages)
