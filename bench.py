@@ -192,7 +192,8 @@ NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
          "frontier_4m", "frontier_10m",
          "telemetry_1k", "telemetry_10k",
          "supervised_overlap_1k", "supervised_overlap_10k",
-         "eclipse_50k", "flashcrowd_50k", "headline"]
+         "eclipse_50k", "flashcrowd_50k",
+         "powerlaw_100k", "powerlaw_1m", "heavytail_eclipse", "headline"]
 # execution order puts headline FIRST (banked before anything can time
 # out — losing it cost round 5 its record, VERDICT r5 weak #2) and its
 # line is re-emitted LAST so the driver's single-line stdout parse still
@@ -225,7 +226,11 @@ TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60,
                  # attack family (ISSUE 10): windows cover the scenario's
                  # [3, 8) attack schedule so the measured ticks include
                  # cut + heal (the faults_degraded discipline)
-                 "eclipse_50k": 10, "flashcrowd_50k": 10}
+                 "eclipse_50k": 10, "flashcrowd_50k": 10,
+                 # heavy-tail family (ISSUE 15): frontier-style short
+                 # windows; heavytail_eclipse covers its [3, 8) window
+                 "powerlaw_100k": 10, "powerlaw_1m": 3,
+                 "heavytail_eclipse": 10}
 
 
 def _fleet_b() -> int:
@@ -613,6 +618,91 @@ def bench_overlap(name: str, ticks: int, repeats: int) -> str:
     return line
 
 
+def bench_bucketed(name: str, ticks: int, repeats: int) -> str:
+    """Heavy-tailed underlay lines (sim/bucketed.py): the degree-bucketed
+    execution path measured through ``bucketed_run``, with the graph's
+    degree shape (``topology.degree_stats``) and the bucket partition
+    stamped into the record so every banked line states the underlay it
+    ran on. The HBM gate prices the BUCKETED layout before the underlay
+    builds — ``powerlaw_cfg`` is closed-form, no topology needed."""
+    import resource
+
+    import jax
+    import numpy as np
+    from go_libp2p_pubsub_tpu.ops.dispatch import resolved_formulations
+    from go_libp2p_pubsub_tpu.sim import scenarios, topology
+    from go_libp2p_pubsub_tpu.sim.bucketed import (bucketed_run,
+                                                   decode_bucketed)
+    from go_libp2p_pubsub_tpu.sim.engine import (delivery_fraction,
+                                                 delivery_latency_ticks)
+    from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+    from go_libp2p_pubsub_tpu.sim.state import check_hbm_budget
+
+    assert all(POWERLAW_FULL_N[k] == v
+               for k, v in scenarios.POWERLAW_NS.items()), \
+        "bench POWERLAW_FULL_N drifted from scenarios.POWERLAW_NS"
+    n = _cap_peers(POWERLAW_FULL_N[name])
+    check_hbm_budget(scenarios.powerlaw_cfg(n), 1,
+                     what=f"{name} n={n} bucketed state")
+    t_build = time.perf_counter()
+    cfg, tp, bs = scenarios.BUCKETED_SCENARIOS[name](n_peers=n)
+    build_extra = {
+        "build_wall_s": round(time.perf_counter() - t_build, 2),
+        "build_peak_rss_bytes":
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024,
+    }
+    # realized degrees straight off the bucketed planes — cheap row
+    # reductions, no densification
+    deg = np.concatenate([
+        np.asarray((np.asarray(e.neighbors) >= 0).sum(axis=1))
+        for e in bs.e])
+    dstats = topology.degree_stats(deg)
+
+    keys = jax.random.split(jax.random.PRNGKey(0), 1 + repeats)
+    bs = bucketed_run(bs, cfg, tp, keys[0], ticks)
+    np.asarray(bs.g.tick)
+    rtt = _fetch_rtt()
+    rates = []
+    for k in keys[1:]:
+        t0 = time.perf_counter()
+        bs = bucketed_run(bs, cfg, tp, k, ticks)
+        np.asarray(bs.g.tick)
+        raw = time.perf_counter() - t0
+        dt = max(raw - rtt, raw * 0.05)
+        rates.append(ticks / dt)
+    hbps = statistics.median(rates)
+
+    dec = decode_bucketed(bs, cfg)
+    flags = int(np.asarray(dec.g.fault_flags))
+    platform = jax.devices()[0].platform
+    line = json.dumps({
+        "metric": f"network_heartbeats_per_sec@{_label(name)}[{platform}]",
+        "value": round(hbps, 2),
+        "unit": "heartbeats/s",
+        "platform": platform,
+        "vs_baseline": round(hbps / TARGET_HBPS, 4),
+        "min": round(min(rates), 2),
+        "max": round(max(rates), 2),
+        "repeats": repeats,
+        "ticks_per_window": ticks,
+        "fetch_rtt_ms": round(rtt * 1e3, 1),
+        "delivery_fraction": round(float(delivery_fraction(dec.g, cfg)), 4),
+        "mean_delivery_latency_ticks": round(
+            float(delivery_latency_ticks(dec.g, cfg)), 3),
+        "n_peers": cfg.n_peers,
+        "degree_stats": dstats,
+        "degree_buckets": [list(b) for b in cfg.degree_buckets],
+        "bucketed_rng": cfg.bucketed_rng,
+        "fault_flags": flags,
+        "fault_flag_names": decode_flags(flags),
+        "resolved": resolved_formulations(cfg),
+        **_memory_record(cfg),
+        **build_extra,
+    })
+    print(line, flush=True)
+    return line
+
+
 def run_scenario(name: str) -> str | None:
     from go_libp2p_pubsub_tpu.sim import scenarios
 
@@ -629,6 +719,12 @@ def run_scenario(name: str) -> str | None:
         # the supervised-overlap A/B (ISSUE 12) rides its own three-way
         # measurement path; the kernel-mode sweep knobs don't apply
         return bench_overlap(name, ticks, repeats)
+
+    if name in POWERLAW_FULL_N:
+        # the heavy-tail family rides the bucketed execution path
+        # (sim/bucketed.bucketed_run); the kernel-mode sweep knobs don't
+        # apply — per-edge seams resolve per bucket
+        return bench_bucketed(name, ticks, repeats)
 
     if name == "fleet_256x1k":
         # the batched-fleet line rides its own measurement path (aggregate
@@ -704,7 +800,8 @@ def run_scenario(name: str) -> str | None:
     }
     assert set(builders) | {"fleet_256x1k", "telemetry_1k",
                             "telemetry_10k", "supervised_overlap_1k",
-                            "supervised_overlap_10k"} == set(NAMES), \
+                            "supervised_overlap_10k"} \
+        | set(POWERLAW_FULL_N) == set(NAMES), \
         "scenario registry drifted from NAMES"
     assert FRONTIER_FULL_N == scenarios.FRONTIER_NS, \
         "bench FRONTIER_FULL_N drifted from scenarios.FRONTIER_NS"
@@ -814,6 +911,12 @@ FRONTIER_FULL_N = {"frontier_250k": 262_144, "frontier_500k": 524_288,
 # FRONTIER_FULL_N; capped runs are labeled by what ran
 ATTACK_FULL_N = {"eclipse_50k": 50_000, "flashcrowd_50k": 50_000}
 
+# full peer counts of the heavy-tail family (ISSUE 15) — parent-safe
+# duplicate of sim/scenarios.POWERLAW_NS (run_scenario asserts sync for
+# the scenario pair); heavytail_eclipse rides the 100k graph
+POWERLAW_FULL_N = {"powerlaw_100k": 131_072, "powerlaw_1m": 1_048_576,
+                   "heavytail_eclipse": 131_072}
+
 
 def _label(name: str) -> str:
     if name == "headline":
@@ -837,6 +940,11 @@ def _label(name: str) -> str:
     if name in ATTACK_FULL_N:
         # same capped-label discipline for the attack family
         full = ATTACK_FULL_N[name]
+        n = _cap_peers(full)
+        return name if n == full else f"{name}_capped_{n // 1000}k"
+    if name in POWERLAW_FULL_N:
+        # same capped-label discipline for the heavy-tail family
+        full = POWERLAW_FULL_N[name]
         n = _cap_peers(full)
         return name if n == full else f"{name}_capped_{n // 1000}k"
     if name in OVERLAP_FULL_N:

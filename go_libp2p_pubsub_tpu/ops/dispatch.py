@@ -372,6 +372,33 @@ def choose(op: str, backend: str | None = None,
     return ranked or list(OPS[op])
 
 
+def explain_bucketed(op: str, buckets, backend: str | None = None,
+                     table: dict | None = None, **shape) -> dict:
+    """{formulation: ms} for a degree-bucketed edge pass: the bucketed
+    step (sim/bucketed.py) runs ``op`` once per bucket at that bucket's
+    ``(n_rows, k_ceil)``, so a formulation's cost is the SUM of its
+    per-bucket costs — a form that wins at the narrow hub bucket but
+    loses at the wide tail ranks by its aggregate. Shape keys other than
+    ``n``/``k`` (w, itemsize, ...) apply to every bucket."""
+    totals: dict = {}
+    for n_b, k_b in buckets:
+        per = explain(op, backend, table,
+                      **{**shape, "n": n_b, "k": k_b})
+        for form, ms in per.items():
+            totals[form] = totals.get(form, 0.0) + ms
+    return totals
+
+
+def choose_bucketed(op: str, buckets, backend: str | None = None,
+                    table: dict | None = None, **shape) -> list:
+    """Ranked candidates for a bucketed edge pass — choose() with the
+    per-bucket-summed costs of :func:`explain_bucketed`."""
+    costs = explain_bucketed(op, buckets, backend, table, **shape)
+    order = {f: i for i, f in enumerate(OPS[op])}
+    ranked = sorted(costs, key=lambda f: (costs[f], order[f]))
+    return ranked or list(OPS[op])
+
+
 def resolved_formulations(cfg) -> dict:
     """The concrete formulation every engine seam executes under ``cfg``
     — requested ``"auto"`` resolved through the dispatch table. bench.py
@@ -389,7 +416,7 @@ def resolved_formulations(cfg) -> dict:
 
     n, k, t = cfg.n_peers, cfg.k_slots, cfg.n_topics
     w = (cfg.msg_window + 31) // 32
-    return {
+    out = {
         "edge_permute": resolve_mode(cfg.edge_gather_mode, jnp.uint32, n, k,
                                      have_sort_key=True),
         "words": resolve_words_mode(cfg.edge_gather_mode, w, n, k,
@@ -401,3 +428,20 @@ def resolved_formulations(cfg) -> dict:
         "selection": resolve_selection_mode(cfg.selection_mode, k,
                                             max_count=cfg.dhi),
     }
+    if getattr(cfg, "degree_buckets", None):
+        # the bucketed step resolves each per-edge seam PER BUCKET at
+        # that bucket's (n_rows, k_ceil) — stamp every bucket's winners
+        # so banked heavy-tail lines are attributable per degree class
+        out["bucketed"] = {
+            f"b{i}:{n_b}x{k_b}": {
+                "edge_permute": resolve_mode(
+                    cfg.edge_gather_mode, jnp.uint32, n_b, k_b,
+                    have_sort_key=True),
+                "words": resolve_words_mode(
+                    cfg.edge_gather_mode, w, n_b, k_b, have_sort_key=True),
+                "edge_packed": resolve_edge_packed_mode(
+                    cfg.edge_gather_mode, n_b, k_b, 2 * t, extra_w=w),
+                "selection": resolve_selection_mode(
+                    cfg.selection_mode, k_b, max_count=min(cfg.dhi, k_b)),
+            } for i, (n_b, k_b) in enumerate(cfg.degree_buckets)}
+    return out

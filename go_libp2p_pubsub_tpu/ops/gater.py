@@ -52,9 +52,14 @@ def gater_decay(state: SimState, cfg: SimConfig) -> SimState:
         gater_reject=dec(state.gater_reject, cfg.gater_source_decay))
 
 
-def accept_data(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
+def accept_data(state: SimState, cfg: SimConfig, key: jax.Array,
+                noise: jnp.ndarray | None = None) -> jnp.ndarray:
     """[N, K] bool: receiver n admits DATA from the peer in slot k this tick
-    (AcceptFrom, peer_gater.go:320-363). Control always flows."""
+    (AcceptFrom, peer_gater.go:320-363). Control always flows.
+
+    ``noise`` substitutes pre-drawn uniform [0, 1) noise of [N, K] shape
+    for the internal draw (``key`` then unused) — see
+    ops/selection.select_random; same bucketed dense-RNG discipline."""
     n, k = state.gater_deliver.shape
     quiet = (state.tick - state.gater_last_throttle) > cfg.gater_quiet_ticks
     ratio_low = (state.gater_validate != 0.0) & \
@@ -67,5 +72,7 @@ def accept_data(state: SimState, cfg: SimConfig, key: jax.Array) -> jnp.ndarray:
              + cfg.gater_ignore_weight * state.gater_ignore
              + cfg.gater_reject_weight * state.gater_reject)          # [N, K]
     p = (1.0 + state.gater_deliver) / (1.0 + total)
-    draw = jax.random.uniform(key, (n, k)) < p
+    if noise is None:
+        noise = jax.random.uniform(key, (n, k))
+    draw = noise < p
     return gate_off[:, None] | (total == 0.0) | draw

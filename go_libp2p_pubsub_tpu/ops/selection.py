@@ -139,12 +139,18 @@ def _select_by_keys(keys: jnp.ndarray, mask: jnp.ndarray,
 
 def select_random(mask: jnp.ndarray, count: jnp.ndarray, key: jax.Array, *,
                   max_count: int | None = None,
-                  mode: str = "auto") -> jnp.ndarray:
+                  mode: str = "auto",
+                  noise: jnp.ndarray | None = None) -> jnp.ndarray:
     """Uniformly choose up to ``count`` True positions per row of ``mask``.
 
     count broadcasts against mask.shape[:-1]. Ties impossible w.p. 1.
     ``max_count`` is a static upper bound on count enabling the iterative
     formulation; ``mode`` picks it explicitly (SimConfig.selection_mode).
+    ``noise`` substitutes pre-drawn uniform [0, 1) noise of ``mask.shape``
+    for the internal draw (``key`` is then unused) — the bucketed step
+    (sim/bucketed.py, bucketed_rng="dense") draws once at the dense
+    [N, k_slots] shape and feeds each bucket its slice, so the selection
+    consumes the exact dense stream and stays bit-exact per bucket.
 
     PRECONDITION: every element of ``count`` must be <= ``max_count`` when
     one is given — the iterative formulation runs exactly max_count argmax
@@ -152,7 +158,8 @@ def select_random(mask: jnp.ndarray, count: jnp.ndarray, key: jax.Array, *,
     count by clipping against the same degree parameter they pass as the
     bound; enable selection.CHECK_COUNT_BOUND in tests to enforce it.
     """
-    noise = jax.random.uniform(key, mask.shape)
+    if noise is None:
+        noise = jax.random.uniform(key, mask.shape)
     keys = jnp.where(mask, noise, NEG_INF)
     return _select_by_keys(keys, mask, count, max_count=max_count, mode=mode)
 

@@ -1,0 +1,1419 @@
+"""Degree-bucketed edge planes: per-tick cost O(ΣD), not O(N·D_max).
+
+Heavy-tailed underlays (sim/topology.powerlaw) give a few hub peers two
+orders of magnitude more edges than the median peer. The dense engine
+pads EVERY peer's neighbor-slot axis to ``k_slots = D_max``, so both the
+resting HBM of the K-axis planes and every per-edge op pay N·D_max even
+when ΣD ≪ N·D_max — at D_max/D_mean = 16 that is a 16x tax on a graph
+whose edge count never changed.
+
+This module keeps the peers partitioned (host-side, at topology build —
+:func:`sim.topology.powerlaw_buckets`) into O(log D_max) contiguous
+id-ordered degree classes, hubs first. Each class's edge planes are
+padded only to that class's ceiling K_b, so:
+
+- resting bytes of a K-axis plane:  Σ_b N_b · bytes_row(K_b)  ≈ ΣD
+- per-edge compute: every op runs once per bucket at [N_b, ·, K_b]
+
+The ONLY cross-bucket traffic is the reverse-edge exchange: edge planes
+concatenate into one flat ΣD-element space and each bucket gathers its
+reverse values through a precomputed flat index (``BucketedState.rev``)
+— every gather is sized ΣD or N_b·K_b, never N·D_max (the HLO guard in
+tests/test_bucketed.py pins this).
+
+Execution is a COLOCATED FORK of sim/engine.step, op for op and
+key-split for key-split: the dense path is untouched (its HLO and RNG
+stream stay byte-identical with bucketing off), and the fork reuses the
+dense kernels verbatim wherever a per-bucket view suffices (publish,
+scoring, selection, gater admission, take/bring edge transitions, fault
+membership hashes). Under ``SimConfig.bucketed_rng = "dense"`` every
+noise draw happens at the dense [N, k_slots] shape and each bucket
+consumes its slice, so the bucketed trajectory is BIT-EXACT against the
+dense engine on the same graph (the parity tests' contract);
+``"bucket"`` folds the bucket index into the key and draws at bucket
+width, making the RNG cost itself scale with ΣD (the production mode
+for heavy-tailed scenarios — a different but equally valid trajectory).
+
+Not every engine feature is bucketable; :func:`check_bucketable`
+refuses the unsupported ones BY NAME rather than silently diverging.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.bits import U32, pack_bool, unpack_bool
+from .config import SimConfig, TopicParams
+from .state import (NEVER, _COMPACT_CODECS, _TICK16_NEVER, _TICK16_SAT,
+                    SimState, _check_compact)
+
+# SimState fields carrying the K (neighbor-slot) axis — the planes this
+# module stores per bucket at that bucket's ceiling K_b. Everything else
+# stays full-width on the global half (row-major peer planes slice by
+# rows into bucket views; message tables and scalars replicate).
+EDGE_FIELDS = (
+    "neighbors", "connected", "outbound", "reverse_slot",
+    "nbr_subscribed", "disconnect_tick", "direct", "mesh", "fanout",
+    "backoff", "graft_tick", "mesh_active",
+    "first_message_deliveries", "mesh_message_deliveries",
+    "mesh_failure_penalty", "invalid_message_deliveries",
+    "behaviour_penalty",
+    "gater_deliver", "gater_duplicate", "gater_ignore", "gater_reject",
+)
+
+# K-free peer-major planes a bucket VIEW row-slices (and _merge concats
+# back). ip_group / app_score / malicious stay GLOBAL even in views:
+# compute_scores and the forward pass index them by GLOBAL neighbor id.
+ROW_FIELDS = (
+    "subscribed", "fanout_lastpub", "gater_validate", "gater_throttle",
+    "gater_last_throttle", "have", "deliver_tick", "deliver_from",
+    "iwant_pending",
+)
+
+
+class EdgePlanes(NamedTuple):
+    """One degree class's K-axis planes at that class's width K_b."""
+
+    neighbors: jnp.ndarray            # [Nb, Kb] i32 global peer ids
+    connected: jnp.ndarray            # [Nb, Kb] bool
+    outbound: jnp.ndarray             # [Nb, Kb] bool
+    reverse_slot: jnp.ndarray         # [Nb, Kb] i32 slot in the neighbor
+    nbr_subscribed: jnp.ndarray       # [Nb, T, Kb] bool
+    disconnect_tick: jnp.ndarray      # [Nb, Kb] i32
+    direct: jnp.ndarray               # [Nb, Kb] bool
+    mesh: jnp.ndarray                 # [Nb, T, Kb] bool
+    fanout: jnp.ndarray               # [Nb, T, Kb] bool
+    backoff: jnp.ndarray              # [Nb, T, Kb] i32
+    graft_tick: jnp.ndarray           # [Nb, T, Kb] i32
+    mesh_active: jnp.ndarray          # [Nb, T, Kb] bool
+    first_message_deliveries: jnp.ndarray    # [Nb, T, Kb] f32
+    mesh_message_deliveries: jnp.ndarray     # [Nb, T, Kb] f32
+    mesh_failure_penalty: jnp.ndarray        # [Nb, T, Kb] f32
+    invalid_message_deliveries: jnp.ndarray  # [Nb, T, Kb] f32
+    behaviour_penalty: jnp.ndarray    # [Nb, Kb] f32
+    gater_deliver: jnp.ndarray        # [Nb, Kb] f32
+    gater_duplicate: jnp.ndarray      # [Nb, Kb] f32
+    gater_ignore: jnp.ndarray         # [Nb, Kb] f32
+    gater_reject: jnp.ndarray         # [Nb, Kb] f32
+
+
+class BucketedState(NamedTuple):
+    """The degree-bucketed twin of :class:`SimState`.
+
+    ``g`` is a SimState whose EDGE_FIELDS are ZERO-WIDTH placeholders
+    (``v[..., :0]`` — leading N axis intact, so every op that reads
+    ``state.neighbors.shape[0]`` for the peer count still sees N); the
+    real edge planes live in ``e``, one :class:`EdgePlanes` per bucket.
+    ``rev[b]`` is the [Nb, Kb] int32 FLAT reverse-edge index into the
+    concatenated ΣD edge space (invalid slots point at themselves) —
+    pure topology, computed once in :func:`bucketize_state` and carried
+    so a donated scan never rebuilds it."""
+
+    g: SimState
+    e: tuple            # tuple[EdgePlanes], hubs first
+    rev: tuple          # tuple[jnp.ndarray [Nb, Kb] i32]
+
+
+def _buckets(cfg: SimConfig) -> list:
+    """cfg.degree_buckets -> [(row_start, n_rows, k_ceil)] hubs first."""
+    out, start = [], 0
+    for n_rows, kb in cfg.degree_buckets:
+        out.append((start, int(n_rows), int(kb)))
+        start += int(n_rows)
+    return out
+
+
+def check_bucketable(cfg: SimConfig) -> None:
+    """Refuse, BY NAME, every config the bucketed fork does not carry.
+
+    The fork mirrors sim/engine.step op for op; features it does not
+    mirror must fail loudly here instead of silently diverging from the
+    dense trajectory."""
+    if cfg.degree_buckets is None:
+        raise ValueError("bucketed execution needs cfg.degree_buckets "
+                         "(see sim/topology.powerlaw_buckets)")
+    bks = tuple((int(r), int(k)) for r, k in cfg.degree_buckets)
+    if any(r <= 0 or k <= 0 for r, k in bks):
+        raise ValueError(f"degree_buckets={bks}: every (n_rows, k_ceil) "
+                         "entry must be positive")
+    if sum(r for r, _ in bks) != cfg.n_peers:
+        raise ValueError(
+            f"degree_buckets rows sum to {sum(r for r, _ in bks)} but "
+            f"n_peers={cfg.n_peers}; buckets must tile the id space")
+    if any(bks[i][1] < bks[i + 1][1] for i in range(len(bks) - 1)):
+        raise ValueError(f"degree_buckets={bks}: k_ceil must be "
+                         "non-increasing (hubs first)")
+    if bks[0][1] != cfg.k_slots:
+        raise ValueError(
+            f"degree_buckets[0] k_ceil={bks[0][1]} != k_slots="
+            f"{cfg.k_slots}: the widest bucket defines the dense width")
+    if cfg.bucketed_rng not in ("dense", "bucket"):
+        raise ValueError(f"bucketed_rng={cfg.bucketed_rng!r}: expected "
+                         "'dense' (bit-exact vs the dense engine) or "
+                         "'bucket' (ΣD-cost draws)")
+    if cfg.router != "gossipsub":
+        raise ValueError(f"router={cfg.router!r}: the bucketed fork "
+                         "mirrors only the gossipsub step")
+    if cfg.flood_publish:
+        raise ValueError("flood_publish is not bucketed")
+    if getattr(cfg, "record_provenance", False):
+        raise ValueError("record_provenance (deliver_from attribution) "
+                         "is not bucketed")
+    if cfg.validation_queue_cap > 0:
+        raise ValueError("validation_queue_cap > 0 (throttle charging) "
+                         "is not bucketed")
+    if getattr(cfg, "edge_queue_cap", 0) > 0:
+        raise ValueError("edge_queue_cap > 0 is not bucketed")
+    if cfg.sub_leave_prob > 0.0 or cfg.sub_join_prob > 0.0:
+        raise ValueError("subscription churn (sub_leave_prob/"
+                         "sub_join_prob) is not bucketed")
+    if cfg.max_iwant_per_tick < cfg.msg_window:
+        raise ValueError(
+            f"max_iwant_per_tick={cfg.max_iwant_per_tick} < msg_window="
+            f"{cfg.msg_window}: the budgeted-IWANT scan is not bucketed")
+    if cfg.hop_mode in ("pallas", "pallas-mxu"):
+        raise ValueError(f"hop_mode={cfg.hop_mode!r}: the fused VMEM hop "
+                         "kernels are dense-only")
+    if 2 * cfg.n_topics > 32:
+        raise ValueError(
+            f"n_topics={cfg.n_topics}: the bucketed reverse-edge "
+            "exchange packs 2*n_topics mask bits into one u32 payload; "
+            "2*n_topics > 32 is refused")
+
+
+# ---------------------------------------------------------------------------
+# bucketize / densify
+
+
+def _rev_tables(cfg: SimConfig):
+    bks = _buckets(cfg)
+    starts = np.array([s for s, _, _ in bks], np.int32)
+    kbs = np.array([kb for _, _, kb in bks], np.int32)
+    bases = np.cumsum([0] + [c * kb for _, c, kb in bks])[:-1].astype(np.int64)
+    return bks, starts, kbs, bases
+
+
+def _flat_rev(cfg: SimConfig, e: tuple) -> tuple:
+    """Per-bucket [Nb, Kb] flat reverse-edge index into the ΣD space.
+
+    For a valid edge (row i of bucket b, slot s) with neighbor j owned by
+    bucket c: ``bases[c] + (j - starts[c]) * K_c + reverse_slot``.
+    Invalid slots index THEMSELVES, so an exchange returns the slot's own
+    payload there — callers mask with the valid-slot predicate exactly as
+    the dense edge_gather_packed does."""
+    bks, starts, kbs, bases = _rev_tables(cfg)
+    n = cfg.n_peers
+    j_starts = jnp.asarray(starts)
+    j_kbs = jnp.asarray(kbs)
+    j_bases = jnp.asarray(bases.astype(np.int32))
+    out = []
+    for b, (s, c, kb) in enumerate(bks):
+        nbr = e[b].neighbors
+        rsl = e[b].reverse_slot
+        valid = (nbr >= 0) & (rsl >= 0)
+        nc = jnp.clip(nbr, 0, n - 1)
+        cb = jnp.searchsorted(j_starts, nc, side="right") - 1
+        flat = j_bases[cb] + (nc - j_starts[cb]) * j_kbs[cb] \
+            + jnp.clip(rsl, 0, None)
+        own = int(bases[b]) \
+            + jnp.arange(c, dtype=jnp.int32)[:, None] * kb \
+            + jnp.arange(kb, dtype=jnp.int32)[None, :]
+        out.append(jnp.where(valid, flat, own).astype(jnp.int32))
+    return tuple(out)
+
+
+def bucketize_state(state: SimState, cfg: SimConfig) -> BucketedState:
+    """Split a DECODED (compute-layout) dense SimState into bucket planes.
+
+    Slots at or beyond a bucket's ceiling are DROPPED — the topology
+    builder guarantees they are empty (checked here when the arrays are
+    concrete; a live edge there would silently vanish otherwise)."""
+    check_bucketable(cfg)
+    bks = _buckets(cfg)
+    e = []
+    for s, c, kb in bks:
+        if not isinstance(state.neighbors, jax.core.Tracer):
+            tail = np.asarray(state.neighbors[s:s + c, kb:])
+            if tail.size and not np.all(tail < 0):
+                raise ValueError(
+                    f"bucketize_state: bucket rows [{s}, {s + c}) carry "
+                    f"live edges beyond their k_ceil={kb} — the "
+                    "degree_buckets partition does not cover this graph")
+        planes = {}
+        for f in EDGE_FIELDS:
+            v = getattr(state, f)
+            planes[f] = v[s:s + c, ..., :kb]
+        e.append(EdgePlanes(**planes))
+    e = tuple(e)
+    g = state._replace(**{f: getattr(state, f)[..., :0]
+                          for f in EDGE_FIELDS})
+    return BucketedState(g=g, e=e, rev=_flat_rev(cfg, e))
+
+
+_PAD_FILLS = dict(
+    neighbors=-1, reverse_slot=-1,
+    disconnect_tick=int(NEVER), graft_tick=int(NEVER), backoff=0,
+)
+
+
+def densify_state(bs: BucketedState, cfg: SimConfig) -> SimState:
+    """Pad every bucket back to k_slots and concat: the dense compute-
+    layout SimState (inverse of bucketize_state; pad fills are the dense
+    engine's resting values at never-used slots, so a bucketize/densify
+    round trip of a dense trajectory state is exact)."""
+    k = cfg.k_slots
+    cols = {f: [] for f in EDGE_FIELDS}
+    for b, (s, c, kb) in enumerate(_buckets(cfg)):
+        for f in EDGE_FIELDS:
+            v = getattr(bs.e[b], f)
+            pad = k - v.shape[-1]
+            if pad:
+                fill = _PAD_FILLS.get(f, False if v.dtype == jnp.bool_
+                                      else 0)
+                widths = [(0, 0)] * (v.ndim - 1) + [(0, pad)]
+                v = jnp.pad(v, widths, constant_values=fill)
+            cols[f].append(v)
+    return bs.g._replace(
+        **{f: jnp.concatenate(vs, axis=0) for f, vs in cols.items()})
+
+
+# ---------------------------------------------------------------------------
+# storage codecs (the bucketed twin of state.encode_state/decode_state)
+
+
+def _enc(codec, v, tick):
+    if codec == "bf16":
+        return jax.lax.bitcast_convert_type(v.astype(jnp.bfloat16),
+                                            jnp.uint16)
+    if codec == "tick16":
+        rel = jnp.clip(v - tick, -_TICK16_SAT, _TICK16_SAT)
+        return jnp.where(v == NEVER, _TICK16_NEVER, rel).astype(jnp.int16)
+    if codec == "packK":
+        return pack_bool(v)
+    if codec == "slot8":
+        return v.astype(jnp.int8)
+    return v
+
+
+def _dec(codec, v, tick, kb):
+    if codec == "bf16":
+        return jax.lax.bitcast_convert_type(
+            v, jnp.bfloat16).astype(jnp.float32)
+    if codec == "tick16":
+        e = v.astype(jnp.int32)
+        return jnp.where(e == _TICK16_NEVER, jnp.int32(int(NEVER)),
+                         tick + e)
+    if codec == "packK":
+        return unpack_bool(v, kb)
+    if codec == "slot8":
+        return v.astype(jnp.int32)
+    return v
+
+
+def encode_bucketed(bs: BucketedState, cfg: SimConfig) -> BucketedState:
+    """STORED layout of a bucketed state: the dense codec table applied
+    per plane — bucket planes pack their bools at K_b width, so the
+    stored bytes scale with ΣD. The zero-width edge placeholders on
+    ``g`` stay compute-typed in BOTH layouts (type-stable scan carry;
+    they hold no bytes either way)."""
+    if cfg.state_precision == "f32":
+        return bs
+    _check_compact(cfg)
+    tick = bs.g.tick
+    gout = {}
+    for f, codec in _COMPACT_CODECS.items():
+        if codec is None or f in EDGE_FIELDS:
+            continue
+        gout[f] = _enc(codec, getattr(bs.g, f), tick)
+    e = tuple(
+        ep._replace(**{f: _enc(_COMPACT_CODECS[f], getattr(ep, f), tick)
+                       for f in EDGE_FIELDS
+                       if _COMPACT_CODECS[f] is not None})
+        for ep in bs.e)
+    return BucketedState(g=bs.g._replace(**gout), e=e, rev=bs.rev)
+
+
+def decode_bucketed(bs: BucketedState, cfg: SimConfig) -> BucketedState:
+    """Inverse of :func:`encode_bucketed` (identity under "f32")."""
+    if cfg.state_precision == "f32":
+        return bs
+    _check_compact(cfg)
+    if bs.g.deliver_from.dtype != jnp.int8:
+        raise TypeError(
+            "decode_bucketed: state is already in the compute layout")
+    tick = bs.g.tick
+    gout = {}
+    for f, codec in _COMPACT_CODECS.items():
+        if codec is None or f in EDGE_FIELDS:
+            continue
+        gout[f] = _dec(codec, getattr(bs.g, f), tick,
+                       cfg.k_slots)
+    bks = _buckets(cfg)
+    e = tuple(
+        ep._replace(**{f: _dec(_COMPACT_CODECS[f], getattr(ep, f), tick,
+                               bks[b][2])
+                       for f in EDGE_FIELDS
+                       if _COMPACT_CODECS[f] is not None})
+        for b, ep in enumerate(bs.e))
+    return BucketedState(g=bs.g._replace(**gout), e=e, rev=bs.rev)
+
+
+# ---------------------------------------------------------------------------
+# bucket views: the per-bucket SimState the dense kernels run on
+
+
+def _view(bs: BucketedState, b: int, cfg: SimConfig) -> SimState:
+    """Bucket ``b`` as a SimState: its edge planes at [Nb, ·, Kb], the
+    ROW_FIELDS row-sliced to its rows, everything else global. Ops read
+    the LOCAL peer count from array shapes; global-id consumers
+    (compute_scores P5/P6, the fault membership hashes) take the global
+    planes / explicit row_start, so a view is a faithful row window."""
+    s, c, _ = _buckets(cfg)[b]
+    out = {f: getattr(bs.e[b], f) for f in EDGE_FIELDS}
+    for f in ROW_FIELDS:
+        out[f] = jax.lax.slice_in_dim(getattr(bs.g, f), s, s + c, axis=0)
+    return bs.g._replace(**out)
+
+
+def _merge(bs: BucketedState, views: list) -> BucketedState:
+    """Concat per-bucket views back: edge planes to ``e``, ROW_FIELDS
+    rows in bucket (= id) order, scalars/message tables from the LAST
+    view (every view carries identical global planes; forks that write
+    them — publish, record_flags — run on ``g`` directly instead)."""
+    e = tuple(EdgePlanes(**{f: getattr(v, f) for f in EDGE_FIELDS})
+              for v in views)
+    rows = {f: jnp.concatenate([getattr(v, f) for v in views], axis=0)
+            for f in ROW_FIELDS}
+    return BucketedState(g=bs.g._replace(**rows), e=e, rev=bs.rev)
+
+
+# ---------------------------------------------------------------------------
+# the cross-bucket primitive: flat reverse-edge exchange
+
+
+def _exchange_flat(bs: BucketedState, payloads: list) -> list:
+    """payloads[b] is [Nb, Kb]; returns each edge's REVERSE edge's
+    payload, per bucket. One ΣD-element concat + per-bucket [Nb, Kb]
+    gathers — nothing here is sized N·K_max."""
+    flat = jnp.concatenate([p.reshape(-1) for p in payloads])
+    return [flat[r] for r in bs.rev]
+
+
+def _split_planes(p):
+    if p.ndim == 2:
+        return [p]
+    return [p[:, ti, :] for ti in range(p.shape[1])]
+
+
+def _exchange_masks(bs: BucketedState, planes_per_bucket: list) -> list:
+    """Exchange a list of bool mask planes (each [Nb, Kb] or
+    [Nb, T, Kb]) across the reverse edges — the bucketed twin of
+    ops/heartbeat.edge_gather_packed's single-u32-payload formulation.
+    Returns, per bucket, the gathered planes in the same shapes, ANDed
+    with the valid-slot predicate exactly as the dense path masks."""
+    flat_lists = [[q for p in planes for q in _split_planes(p)]
+                  for planes in planes_per_bucket]
+    nb = len(flat_lists[0])
+    if nb > 32:
+        raise ValueError(f"_exchange_masks: {nb} bit planes exceed one "
+                         "u32 payload")
+    sh = (U32(1) << jnp.arange(nb, dtype=U32))[None, :, None]
+    payloads = [jnp.sum(jnp.stack(planes, axis=1).astype(U32) * sh,
+                        axis=1, dtype=U32)
+                for planes in flat_lists]
+    got = _exchange_flat(bs, payloads)
+    out = []
+    for b, gword in enumerate(got):
+        ep = bs.e[b]
+        valid = (ep.neighbors >= 0) & (ep.reverse_slot >= 0)
+        bits = ((gword[:, None, :]
+                 >> jnp.arange(nb, dtype=U32)[None, :, None])
+                & U32(1)).astype(bool) & valid[:, None, :]
+        res, i = [], 0
+        for p in planes_per_bucket[b]:
+            if p.ndim == 2:
+                res.append(bits[:, i, :])
+                i += 1
+            else:
+                t = p.shape[1]
+                res.append(jnp.stack([bits[:, i + ti, :]
+                                      for ti in range(t)], axis=1))
+                i += t
+        out.append(res)
+    return out
+
+
+def _gw_b(table: jnp.ndarray, nbr_b: jnp.ndarray) -> jnp.ndarray:
+    """[W, N] global packed word table gathered along a bucket's
+    neighbors -> [W, Kb, Nb] (the dense gather_words_rows layout, at
+    bucket width). Neighbors clip to [0, N-1] exactly as the dense
+    forward pass clips before its gather, so invalid slots read the
+    same row-0 words there — every consumer masks them."""
+    n = table.shape[1]
+    return jnp.transpose(table[:, jnp.clip(nbr_b, 0, n - 1)], (0, 2, 1))
+
+
+# ---------------------------------------------------------------------------
+# RNG discipline
+
+
+def _mk_noise(cfg: SimConfig):
+    """``noise(key, b, kind)``: the uniform noise a bucket's selection /
+    admission / churn draw consumes. kind is "ntk" ([·, T, K]) or "nk"
+    ([·, K]).
+
+    "dense": draw at the FULL dense shape from the dense call site's key
+    and hand bucket b its row/slot slice — every bucket consumes the
+    exact dense stream (bit-exact parity; XLA CSEs the per-bucket
+    duplicate draws of the same key+shape). "bucket": fold the bucket
+    index into the key and draw at bucket width — O(ΣD) RNG, a
+    different (equally seeded) trajectory."""
+    bks = _buckets(cfg)
+    n, t, kmax = cfg.n_peers, cfg.n_topics, cfg.k_slots
+
+    if cfg.bucketed_rng == "dense":
+        def noise(key, b, kind):
+            s, c, kb = bks[b]
+            if kind == "ntk":
+                return jax.random.uniform(key, (n, t, kmax))[
+                    s:s + c, :, :kb]
+            return jax.random.uniform(key, (n, kmax))[s:s + c, :kb]
+    else:
+        def noise(key, b, kind):
+            s, c, kb = bks[b]
+            kk = jax.random.fold_in(key, b)
+            return jax.random.uniform(
+                kk, (c, t, kb) if kind == "ntk" else (c, kb))
+    return noise
+
+
+# ---------------------------------------------------------------------------
+# heartbeat fork (ops/heartbeat.heartbeat, op for op at bucket width)
+
+
+def _heartbeat_b(bs: BucketedState, cfg: SimConfig, tp: TopicParams,
+                 key: jax.Array, noise):
+    """Per-bucket mirror of ops/heartbeat.heartbeat. Every local decision
+    runs once per bucket at [Nb, T, Kb]; the three cross-peer exchanges
+    ride the flat reverse-edge involution (_exchange_masks). The
+    lax.cond regime gates keep the dense predicates — ANY over ALL
+    buckets — so a gated block runs for every bucket or none, exactly as
+    the dense heartbeat's all-rows cond does. Returns (merged state,
+    scores, scores_all, inc_gossip, fwd_send), the last four per-bucket
+    lists."""
+    from functools import reduce
+
+    from ..ops.score_ops import (advance_active_latch, apply_prune_penalty,
+                                 compute_scores, decayed)
+    from ..ops.bits import prefix_count
+    from ..ops.selection import masked_median, select_random, select_top
+
+    bks = _buckets(cfg)
+    B = len(bks)
+    t = cfg.n_topics
+    tick = bs.g.tick
+    ks = jax.random.split(key, 8)
+    smode = cfg.selection_mode
+
+    views = [advance_active_latch(_view(bs, b, cfg), tp) for b in range(B)]
+    scores_all = [compute_scores(v, cfg, tp, mask_disconnected=False,
+                                 apply_decay=True) for v in views]
+    scores = [jnp.where(v.connected, sa, 0.0)
+              for v, sa in zip(views, scores_all)]
+
+    sL, sb, joined, conn, out3, direct3 = [], [], [], [], [], []
+    nbr_sub, backoff_ok, backoff_active = [], [], []
+    mesh1, candidate, prune_neg, need = [], [], [], []
+    for b, v in enumerate(views):
+        _, c, kb = bks[b]
+        s = scores[b][:, None, :]
+        sL.append(s)
+        sb.append(jnp.broadcast_to(s, (c, t, kb)))
+        joined.append(v.subscribed[:, :, None])
+        conn.append(v.connected[:, None, :])
+        out3.append(v.outbound[:, None, :])
+        direct3.append(v.direct[:, None, :])
+        nbr_sub.append(v.nbr_subscribed & conn[b])
+        bok = tick >= v.backoff
+        backoff_ok.append(bok)
+        backoff_active.append(~bok)
+        mesh = v.mesh & joined[b]
+        cand = conn[b] & nbr_sub[b] & ~mesh & bok & (s >= 0) \
+            & ~direct3[b] & joined[b]
+        pn = mesh & (s < 0)
+        prune_neg.append(pn)
+        mesh1.append(mesh & ~pn)
+        candidate.append(cand & ~pn)
+        n_mesh = jnp.sum(mesh1[b], axis=-1)
+        need.append(jnp.where(n_mesh < cfg.dlo, cfg.d - n_mesh, 0))
+
+    def _any(preds):
+        return reduce(jnp.logical_or, preds)
+
+    # 2. undersubscribed graft (dense predicate: ANY row, ALL buckets)
+    pred1 = _any([jnp.any((need[b] > 0) & jnp.any(candidate[b], -1))
+                  for b in range(B)])
+    mesh2, graft1 = [], []
+    for b in range(B):
+        g1 = jax.lax.cond(
+            pred1,
+            lambda b=b: select_random(
+                candidate[b], need[b], ks[0], max_count=cfg.d, mode=smode,
+                noise=noise(ks[0], b, "ntk")),
+            lambda b=b: jnp.zeros_like(candidate[b]))
+        graft1.append(g1)
+        mesh2.append(mesh1[b] | g1)
+
+    # 3. oversubscribed trim
+    over = [(jnp.sum(mesh2[b], axis=-1) > cfg.dhi)[..., None]
+            for b in range(B)]
+    pred_over = _any([jnp.any(o) for o in over])
+    mesh3, prune_over = [], []
+    for b in range(B):
+        _, c, kb = bks[b]
+
+        def _over_block(b=b, c=c):
+            protected = select_top(sb[b], mesh2[b],
+                                   jnp.full((c, t), cfg.dscore),
+                                   max_count=cfg.dscore, mode=smode)
+            rest = mesh2[b] & ~protected
+            keep_rand = select_random(
+                rest, jnp.full((c, t), cfg.d - cfg.dscore), ks[1],
+                max_count=cfg.d - cfg.dscore, mode=smode,
+                noise=noise(ks[1], b, "ntk"))
+            kept = protected | keep_rand
+            n_out_kept = jnp.sum(kept & out3[b], axis=-1)
+            deficit_out = jnp.clip(cfg.dout - n_out_kept, 0)
+            add_out = select_random(
+                mesh2[b] & ~kept & out3[b], deficit_out, ks[2],
+                max_count=cfg.dout, mode=smode,
+                noise=noise(ks[2], b, "ntk"))
+            remove_nonout = select_random(
+                keep_rand & ~out3[b], jnp.sum(add_out, axis=-1), ks[3],
+                max_count=cfg.dout, mode=smode,
+                noise=noise(ks[3], b, "ntk"))
+            return (kept | add_out) & ~remove_nonout
+
+        kept = jax.lax.cond(pred_over, _over_block,
+                            lambda b=b: mesh2[b])
+        m3 = jnp.where(over[b], kept, mesh2[b])
+        mesh3.append(m3)
+        prune_over.append(mesh2[b] & ~m3)
+
+    # 4. outbound quota top-up
+    need_out, out_cand = [], []
+    for b in range(B):
+        n3 = jnp.sum(mesh3[b], axis=-1)
+        n_out = jnp.sum(mesh3[b] & out3[b], axis=-1)
+        need_out.append(jnp.where(
+            (n3 >= cfg.dlo) & ~over[b][..., 0] & (n_out < cfg.dout),
+            cfg.dout - n_out, 0))
+        out_cand.append(candidate[b] & out3[b] & ~mesh3[b])
+    pred_out = _any([jnp.any((need_out[b] > 0) & jnp.any(out_cand[b], -1))
+                     for b in range(B)])
+    mesh4, graft_out = [], []
+    for b in range(B):
+        go = jax.lax.cond(
+            pred_out,
+            lambda b=b: select_random(
+                out_cand[b], need_out[b], ks[4], max_count=cfg.dout,
+                mode=smode, noise=noise(ks[4], b, "ntk")),
+            lambda b=b: jnp.zeros_like(mesh3[b]))
+        graft_out.append(go)
+        mesh4.append(mesh3[b] | go)
+
+    # 5. opportunistic grafting (scalar tick gate, same for every bucket)
+    og_tick = (tick % cfg.opportunistic_graft_ticks) == 0
+    mesh5, og_sel = [], []
+    for b in range(B):
+        def _og_block(b=b):
+            med = masked_median(sb[b], mesh4[b])
+            og_cond = (jnp.sum(mesh4[b], -1) > 1) & \
+                (med < cfg.opportunistic_graft_threshold)
+            og_need = jnp.where(og_cond, cfg.opportunistic_graft_peers, 0)
+            return select_random(
+                candidate[b] & (sb[b] > med[..., None]) & ~mesh4[b],
+                og_need, ks[5], max_count=cfg.opportunistic_graft_peers,
+                mode=smode, noise=noise(ks[5], b, "ntk"))
+
+        og = jax.lax.cond(og_tick, _og_block,
+                          lambda b=b: jnp.zeros_like(mesh4[b]))
+        og_sel.append(og)
+        mesh5.append(mesh4[b] | og)
+
+    grafts = [graft1[b] | graft_out[b] | og_sel[b] for b in range(B)]
+    prunes = [prune_neg[b] | prune_over[b] for b in range(B)]
+
+    # --- exchange 1: GRAFT/PRUNE receiver views ---
+    ex1 = _exchange_masks(bs, [[grafts[b], prunes[b]] for b in range(B)])
+
+    refuse, accept, inc_graft, inc_prune, bp_new = [], [], [], [], []
+    for b in range(B):
+        ig, ip = ex1[b]
+        inc_graft.append(ig)
+        inc_prune.append(ip)
+        already = ig & mesh5[b]
+        hard_refuse = ig & ~already & \
+            (~joined[b] | backoff_active[b] | (sL[b] < 0) | direct3[b])
+        cand_graft = ig & ~already & ~hard_refuse
+        n_mine = jnp.sum(mesh5[b], axis=-1, keepdims=True)
+        acc_out = cand_graft & out3[b]
+        nonout = cand_graft & ~out3[b]
+        c_out_excl = prefix_count(acc_out, exclusive=True)
+        rank = prefix_count(nonout)
+        acc = already | acc_out | \
+            (nonout & (n_mine + c_out_excl + rank <= cfg.dhi))
+        accept.append(acc)
+        refuse.append(ig & ~acc)
+        prune_tick = views[b].backoff - cfg.prune_backoff_ticks
+        flood = backoff_active[b] & (tick < prune_tick + cfg.graft_flood_ticks)
+        bp_add = jnp.sum(ig & backoff_active[b], axis=1).astype(jnp.float32) \
+            + jnp.sum(ig & flood, axis=1).astype(jnp.float32)
+        bp_new.append(decayed(views[b].behaviour_penalty,
+                              cfg.behaviour_penalty_decay,
+                              cfg.decay_to_zero) + bp_add)
+
+    # --- exchange 2: refusal PRUNEs back to the grafting side ---
+    ex2 = _exchange_masks(bs, [[refuse[b]] for b in range(B)])
+
+    sts, new_mesh_l, new_fanout_l = [], [], []
+    fanout_alive = [
+        (views[b].fanout_lastpub < NEVER)
+        & (tick <= views[b].fanout_lastpub + cfg.fanout_ttl_ticks)
+        & ~views[b].subscribed
+        for b in range(B)]
+    pred_fan = _any([jnp.any(fa) for fa in fanout_alive])
+    for b in range(B):
+        v = views[b]
+        refused_back, = ex2[b]
+        nm = ((mesh5[b] | accept[b]) & ~inc_prune[b] & ~refused_back) \
+            & joined[b]
+        pruned_any = prunes[b] | inc_prune[b] | refused_back \
+            | (refuse[b] & joined[b])
+        new_backoff = jnp.where(pruned_any, tick + cfg.prune_backoff_ticks,
+                                v.backoff)
+        newly = nm & ~v.mesh
+        removed = v.mesh & ~nm
+        fa3 = fanout_alive[b][..., None]
+
+        def _fanout_block(b=b, fa3=fa3):
+            v = views[b]
+            keep_f = v.fanout & conn[b] & nbr_sub[b] & \
+                (sL[b] >= cfg.publish_threshold) & fa3
+            need_f = jnp.where(fanout_alive[b],
+                               jnp.maximum(cfg.d - jnp.sum(keep_f, -1), 0),
+                               0)
+            add_f = select_random(
+                conn[b] & nbr_sub[b] & ~keep_f & ~direct3[b]
+                & (sL[b] >= cfg.publish_threshold) & fa3,
+                need_f, ks[7], max_count=cfg.d, mode=smode,
+                noise=noise(ks[7], b, "ntk"))
+            return keep_f | add_f
+
+        nf = jax.lax.cond(pred_fan, _fanout_block,
+                          lambda b=b: jnp.zeros_like(views[b].fanout))
+        fanout_lastpub = jnp.where(fanout_alive[b], v.fanout_lastpub, NEVER)
+        st = v._replace(mesh=nm, backoff=new_backoff,
+                        behaviour_penalty=bp_new[b], fanout=nf,
+                        fanout_lastpub=fanout_lastpub)
+        st = apply_prune_penalty(st, removed, tp,
+                                 decay_to_zero=cfg.decay_to_zero,
+                                 apply_decay=True)
+        st = st._replace(
+            graft_tick=jnp.where(newly, tick, st.graft_tick),
+            mesh_active=jnp.where(newly, False, st.mesh_active))
+        sts.append(st)
+        new_mesh_l.append(nm)
+        new_fanout_l.append(nf)
+
+    gossip_sel, send = [], []
+    for b in range(B):
+        _, c, kb = bks[b]
+        gossip_cand = conn[b] & nbr_sub[b] & ~new_mesh_l[b] \
+            & ~new_fanout_l[b] & ~direct3[b] \
+            & (sL[b] >= cfg.gossip_threshold) \
+            & (joined[b] | fanout_alive[b][..., None])
+        n_cand = jnp.sum(gossip_cand, axis=-1)
+        target = jnp.maximum(cfg.dlazy, jnp.floor(
+            jnp.float32(cfg.gossip_factor) * n_cand.astype(jnp.float32)
+        ).astype(jnp.int32))
+        # the static bound derives from the BUCKET width: n_cand <= Kb, so
+        # target <= max(Dlazy, floor(f32(factor) * f32(Kb))) in the same
+        # f32 arithmetic as the dense bound derivation — never below the
+        # traced target, and mode divergence is bit-identical
+        # (ops/selection._select_by_keys: all formulations agree)
+        gossip_bound = max(cfg.dlazy, int(np.floor(
+            np.float32(cfg.gossip_factor) * np.float32(kb))))
+        gossip_sel.append(select_random(
+            gossip_cand, target, ks[6], max_count=gossip_bound, mode=smode,
+            noise=noise(ks[6], b, "ntk")))
+        send.append(new_mesh_l[b]
+                    | (new_fanout_l[b] & ~views[b].subscribed[:, :, None]))
+
+    # --- exchange 3: emitGossip + eager-forward receiver views ---
+    ex3 = _exchange_masks(
+        bs, [[gossip_sel[b], send[b]] for b in range(B)])
+    inc_gossip = [ex3[b][0] for b in range(B)]
+    fwd_send = [ex3[b][1] for b in range(B)]
+
+    return (_merge(bs, sts), scores, scores_all, inc_gossip, fwd_send)
+
+
+# ---------------------------------------------------------------------------
+# forward fork (ops/propagate.forward_tick, op for op at bucket width)
+
+
+def _forward_b(bs: BucketedState, cfg: SimConfig, tp: TopicParams,
+               inc_gossip_l: list, scores_l: list, key: jax.Array,
+               fwd_send_l: list, noise,
+               link_ok_l=None, dup_edges_l=None, censor_bits=None):
+    """Per-bucket mirror of ops/propagate.forward_tick (the non-fused XLA
+    formulation; check_bucketable refuses the Pallas hop modes and every
+    cap/flood/provenance branch, so those paths are statically dead here).
+
+    Message-window tables stay GLOBAL [W, N] packed words — they are
+    peer-count sized, not degree sized. Only the [W, K, N] edge event
+    planes split per bucket: each gather/expand/count runs at [W, Kb, Nb],
+    so the hop cost is Σ_b W·Kb·Nb = W·ΣD instead of W·K_max·N. The hop
+    loop carries the global frontier/have/deliver words plus per-bucket
+    count tuples; per-bucket new-arrival words concatenate back along the
+    peer axis each hop (buckets are contiguous id ranges)."""
+    from ..ops import gater
+    from ..ops.bits import (exclusive_prefix_or, n_words, pack_words,
+                            popcount_sum, reduce_or, unpack_words)
+    from ..ops.propagate import _bits_to_slot, _edge_topic_bits, _slot_bitplanes
+    from ..ops.score_ops import decayed
+
+    g = bs.g
+    t = cfg.n_topics
+    m = cfg.msg_window
+    w = n_words(m)
+    bks = _buckets(cfg)
+    B = len(bks)
+    k_fwd, k_gate = jax.random.split(key)
+    del k_fwd     # gossipsub with pre-gathered fwd_send never consumes it
+    mal = g.malicious
+    views = [_view(bs, b, cfg) for b in range(B)]
+    nbrs = [bs.e[b].neighbors for b in range(B)]
+
+    # --- per-tick packed masks (global: message-window sized) ---
+    age_pub = g.tick - g.msg_publish_tick
+    alive = (age_pub >= 0) & (age_pub < cfg.history_length)
+    t_m = jnp.clip(g.msg_topic, 0, t - 1)
+    live_topic = (g.msg_topic >= 0) & alive
+    topic_bits = pack_bool((t_m[None, :] == jnp.arange(t)[:, None])
+                           & live_topic[None, :])
+    alive_bits = pack_bool(alive[None, :])[0]
+    invalid_bits = pack_bool((g.msg_invalid & alive)[None, :])[0]
+    ignored_bits = pack_bool((g.msg_ignored & alive)[None, :])[0]
+    valid_msg_bits = alive_bits & ~invalid_bits & ~ignored_bits
+    vm = jnp.where(mal[None, :], alive_bits[:, None],
+                   valid_msg_bits[:, None])                          # [W,N]
+    inv_n = jnp.where(mal[None, :], U32(0), invalid_bits[:, None])
+    ign_n = jnp.where(mal[None, :], U32(0), ignored_bits[:, None])
+
+    have_bits = g.have.T                                             # [W,N]
+    dlv_bits = pack_words(g.deliver_tick < NEVER)
+    dlv_start = dlv_bits
+    n_have_start = popcount_sum(have_bits, axis=(0, 1))
+
+    data_ok_l = []
+    for b, (s, c, kb) in enumerate(bks):
+        if cfg.scoring_enabled:
+            accept_ok = scores_l[b] >= cfg.graylist_threshold
+        else:
+            accept_ok = jnp.ones((c, kb), bool)
+        if cfg.gater_enabled:
+            d = accept_ok & (gater.accept_data(
+                views[b], cfg, k_gate, noise=noise(k_gate, b, "nk"))
+                | mal[s:s + c, None])
+        else:
+            d = accept_ok
+        if link_ok_l is not None:
+            d = d & link_ok_l[b]
+        data_ok_l.append(d)
+
+    if cfg.count_dtype not in ("uint8", "int32"):
+        raise ValueError(
+            f"count_dtype={cfg.count_dtype!r}: only 'uint8' and 'int32' "
+            "are supported (numpy shorthands like 'u8' parse as OTHER "
+            "widths and would silently defeat the knob)")
+    cdt = jnp.dtype(cfg.count_dtype)
+    if m > jnp.iinfo(cdt).max:
+        raise ValueError(
+            f"msg_window={m} > {jnp.iinfo(cdt).max} would wrap the "
+            f"{cfg.count_dtype} hop-count accumulators; shrink the window "
+            "or widen count_dtype")
+
+    def topic_counts(events_wkn):
+        return jnp.stack([
+            popcount_sum(events_wkn & topic_bits[ti][:, None, None],
+                         axis=0, dtype=cdt)
+            for ti in range(t)]).astype(cdt)
+
+    # -- step 1: resolve pending IWANTs from last tick --
+    answer_bits = jnp.where(mal[None, :], U32(0), dlv_bits)
+    if censor_bits is not None:
+        answer_bits = answer_bits & ~censor_bits
+    got_any_l, got_valid_any_l = [], []
+    seed_nv, seed_ni, seed_ig = [], [], []
+    for b, (s, c, kb) in enumerate(bks):
+        sl = slice(s, s + c)
+        asked_k = _slot_bitplanes(views[b].iwant_pending, kb) \
+            & alive_bits[:, None, None]
+        answers_k = _gw_b(answer_bits, nbrs[b])                  # [W,Kb,Nb]
+        adm_kn = jnp.where(data_ok_l[b].T[None, :, :],
+                           U32(0xFFFFFFFF), U32(0))
+        hb_c = have_bits[:, sl]
+        got_k = asked_k & answers_k & ~hb_c[:, None, :] & adm_kn
+        broken_k = asked_k & ~answers_k
+        if link_ok_l is not None:
+            link_kn = jnp.where(link_ok_l[b].T[None, :, :],
+                                U32(0xFFFFFFFF), U32(0))
+            broken_k = asked_k & ~(answers_k & link_kn)
+        views[b] = views[b]._replace(
+            behaviour_penalty=views[b].behaviour_penalty
+            + popcount_sum(broken_k, axis=0).T)
+        got_any_l.append(reduce_or(got_k, axis=1))
+        got_valid = got_k & vm[:, None, sl]
+        got_valid_any_l.append(reduce_or(got_valid, axis=1))
+        seed_nv.append(topic_counts(got_valid))
+        seed_ni.append(topic_counts(got_k & inv_n[:, None, sl]))
+        if cfg.gater_enabled:
+            seed_ig.append(popcount_sum(got_k & ign_n[:, None, sl],
+                                        axis=0, dtype=cdt).astype(cdt))
+    got_any = jnp.concatenate(got_any_l, axis=1)                     # [W,N]
+    got_valid_any = jnp.concatenate(got_valid_any_l, axis=1)
+    have_bits = have_bits | got_any
+    dlv_bits = dlv_bits | got_valid_any
+    validated = popcount_sum(got_any, axis=0,
+                             dtype=jnp.int32).astype(jnp.float32)    # [N]
+
+    # -- step 2: eager forwarding, prop_substeps hops --
+    allowed_l = [_edge_topic_bits(fwd_send_l[b] & data_ok_l[b][:, None, :],
+                                  topic_bits, w) for b in range(B)]
+    mesh_eb_l = [_edge_topic_bits(views[b].mesh, topic_bits, w)
+                 for b in range(B)]
+    if dup_edges_l is not None:
+        age_d = g.tick - g.deliver_tick
+        dup_window = pack_words((age_d >= 0)
+                                & (age_d < cfg.history_gossip)) \
+            & alive_bits[:, None]
+        if censor_bits is not None:
+            dup_window = dup_window & ~censor_bits
+        dup_offer_l = [
+            _gw_b(dup_window, nbrs[b]) & mesh_eb_l[b]
+            & jnp.where((dup_edges_l[b] & data_ok_l[b]).T[None, :, :],
+                        U32(0xFFFFFFFF), U32(0))
+            for b in range(B)]
+    else:
+        dup_offer_l = None
+
+    age_dlv = g.tick - g.deliver_tick
+    window_old = pack_words(
+        (age_dlv >= 0)
+        & (age_dlv <= cfg.mesh_message_deliveries_window_ticks))
+
+    frontier = pack_words(g.deliver_tick == g.tick) | got_valid_any
+    carry0 = {
+        "i": jnp.int32(0),
+        "frontier": frontier,
+        "have": have_bits,
+        "dlv": dlv_bits,
+        "dlv_new": got_valid_any,
+        "nv": tuple(seed_nv),
+        "ni": tuple(seed_ni),
+        "dup": tuple(jnp.zeros((t, kb, c), cdt) for (s, c, kb) in bks),
+        "validated": validated,
+    }
+    if cfg.gater_enabled:
+        carry0["ig"] = tuple(seed_ig)
+        carry0["gdup"] = tuple(jnp.zeros((kb, c), cdt)
+                               for (s, c, kb) in bks)
+
+    def hop(cr):
+        i = cr["i"]
+        frontier, have_w, dlv_new = cr["frontier"], cr["have"], cr["dlv_new"]
+        validated = cr["validated"]
+        is_first = i == 0
+        src = frontier if censor_bits is None else frontier & ~censor_bits
+        new_any_l, new_valid_l = [], []
+        nv_o, ni_o, dup_o = list(cr["nv"]), list(cr["ni"]), list(cr["dup"])
+        if cfg.gater_enabled:
+            ig_o, gdup_o = list(cr["ig"]), list(cr["gdup"])
+        for b, (s, c, kb) in enumerate(bks):
+            sl = slice(s, s + c)
+            offered = _gw_b(src, nbrs[b]) & allowed_l[b]
+            if dup_offer_l is not None:
+                offered = offered | jnp.where(is_first, dup_offer_l[b],
+                                              U32(0))
+            excl = exclusive_prefix_or(offered, axis=1)
+            hb_c = have_w[:, sl]
+            new_from_k = offered & ~excl & ~hb_c[:, None, :]
+            new_any = (excl[:, -1] | offered[:, -1]) & ~hb_c         # [W,Nb]
+            new_valid = new_any & vm[:, sl]
+            nv_ev = new_from_k & vm[:, None, sl]
+            nv_o[b] = nv_o[b] + topic_counts(nv_ev)
+            ni_o[b] = ni_o[b] + topic_counts(new_from_k
+                                             & inv_n[:, None, sl])
+            elig = (window_old[:, sl] | dlv_new[:, sl] | new_valid) \
+                & valid_msg_bits[:, None]
+            dup_o[b] = dup_o[b] + topic_counts(offered & mesh_eb_l[b]
+                                               & elig[:, None, :])
+            if cfg.gater_enabled:
+                ig_o[b] = ig_o[b] + popcount_sum(
+                    new_from_k & ign_n[:, None, sl], axis=0,
+                    dtype=cdt).astype(cdt)
+                gdup_o[b] = gdup_o[b] + popcount_sum(
+                    offered & ~new_from_k & (hb_c | new_any)[:, None, :],
+                    axis=0, dtype=cdt).astype(cdt)
+            new_any_l.append(new_any)
+            new_valid_l.append(new_valid)
+        new_any = jnp.concatenate(new_any_l, axis=1)                 # [W,N]
+        new_valid = jnp.concatenate(new_valid_l, axis=1)
+        if cfg.gater_enabled:
+            # column-independent popcount: per-bucket pieces concat into
+            # exactly the dense per-receiver sum
+            validated = validated + jnp.concatenate(
+                [popcount_sum(a, axis=0) for a in new_any_l], axis=0)
+        out = dict(cr)
+        out.update(i=i + 1, frontier=new_valid, have=have_w | new_any,
+                   dlv=cr["dlv"] | new_valid, dlv_new=dlv_new | new_valid,
+                   nv=tuple(nv_o), ni=tuple(ni_o), dup=tuple(dup_o),
+                   validated=validated)
+        if cfg.gater_enabled:
+            out["ig"], out["gdup"] = tuple(ig_o), tuple(gdup_o)
+        return out
+
+    carry = jax.lax.while_loop(
+        lambda cr: (cr["i"] < cfg.prop_substeps)
+        & jnp.any(cr["frontier"] != 0),
+        hop, carry0)
+    have_bits, dlv_bits = carry["have"], carry["dlv"]
+    validated = carry["validated"]
+
+    def t2(x):
+        return x[None, :, None]
+    z = cfg.decay_to_zero
+    caps = tp.first_message_deliveries_cap[None, :, None], \
+        tp.mesh_message_deliveries_cap[None, :, None]
+    for b in range(B):
+        v = views[b]
+        fmd_add = jnp.transpose(carry["nv"][b],
+                                (2, 0, 1)).astype(jnp.float32)
+        imd_add = jnp.transpose(carry["ni"][b],
+                                (2, 0, 1)).astype(jnp.float32)
+        mmd_add = jnp.transpose(carry["dup"][b],
+                                (2, 0, 1)).astype(jnp.float32)
+        v = v._replace(
+            first_message_deliveries=jnp.minimum(
+                decayed(v.first_message_deliveries,
+                        t2(tp.first_message_deliveries_decay), z)
+                + fmd_add, caps[0]),
+            mesh_message_deliveries=jnp.minimum(
+                decayed(v.mesh_message_deliveries,
+                        t2(tp.mesh_message_deliveries_decay), z)
+                + mmd_add, caps[1]),
+            invalid_message_deliveries=decayed(
+                v.invalid_message_deliveries,
+                t2(tp.invalid_message_deliveries_decay), z) + imd_add)
+        if cfg.gater_enabled:
+            # throttle stays untouched: the validation cap is refused, so
+            # the dense throttle add is +0 and last_throttle's where() is
+            # the identity — skipping both is bit-identical
+            def sum_t(x):
+                return jnp.sum(x.astype(jnp.float32), axis=0).T
+            v = v._replace(
+                gater_deliver=v.gater_deliver + sum_t(carry["nv"][b]),
+                gater_duplicate=v.gater_duplicate
+                + carry["gdup"][b].astype(jnp.float32).T,
+                gater_ignore=v.gater_ignore
+                + carry["ig"][b].astype(jnp.float32).T,
+                gater_reject=v.gater_reject + sum_t(carry["ni"][b]))
+        views[b] = v
+
+    newly_dlv = dlv_bits & ~dlv_start
+    new_dlv_mask = unpack_words(newly_dlv, m)
+    deliver_tick = jnp.where(new_dlv_mask, g.tick, g.deliver_tick)
+    delivered = popcount_sum(have_bits, axis=(0, 1)) - n_have_start
+
+    # -- step 3: IHAVE/IWANT for next tick (uses the UPDATED deliveries) --
+    age = g.tick - deliver_tick
+    window_bits = pack_words((age >= 0) & (age < cfg.history_gossip)) \
+        & alive_bits[:, None]
+    window_bits = jnp.where(mal[None, :], alive_bits[:, None], window_bits)
+    pend_l = []
+    for b, (s, c, kb) in enumerate(bks):
+        if cfg.scoring_enabled:
+            gossip_ok = scores_l[b] >= cfg.gossip_threshold
+        else:
+            gossip_ok = jnp.ones((c, kb), bool)
+        valid_slots = ((nbrs[b] >= 0)
+                       & (bs.e[b].reverse_slot >= 0))[:, None, :]
+        inc_g = inc_gossip_l[b] & valid_slots & gossip_ok[:, None, :]
+        offer = _gw_b(window_bits, nbrs[b]) \
+            & _edge_topic_bits(inc_g, topic_bits, w)
+        # max_iwant_per_tick >= msg_window is a check_bucketable
+        # precondition, so the budgeted scan is statically dead
+        excl = exclusive_prefix_or(offer, axis=1)
+        chosen_k = offer & ~excl & ~have_bits[:, None, s:s + c]
+        pend_l.append(_bits_to_slot(chosen_k, m))
+    iwant_pending = jnp.concatenate(pend_l, axis=0)
+
+    out = _merge(bs, views)
+    g2 = out.g._replace(
+        have=have_bits.T, deliver_tick=deliver_tick,
+        delivered_total=out.g.delivered_total + delivered,
+        iwant_pending=iwant_pending)
+    if cfg.gater_enabled:
+        g2 = g2._replace(gater_validate=g2.gater_validate + validated)
+    return out._replace(g=g2)
+
+
+# ---------------------------------------------------------------------------
+# churn fork (ops/churn.churn_edges, symmetric draws over the flat exchange)
+
+
+def _churn_b(bs: BucketedState, cfg: SimConfig, tp: TopicParams,
+             key: jax.Array, scores_all_l: list, noise,
+             forbid_up_l=None) -> BucketedState:
+    """Per-bucket mirror of ops/churn.churn_edges. The lower-GLOBAL-id
+    endpoint's down/up/direct bits decide each edge (the dense rule uses
+    row ids, which ARE global ids here since buckets are contiguous id
+    ranges); the three decision planes ride one packed exchange.
+    take_edges_down / bring_edges_up run verbatim on the views."""
+    from ..ops.churn import bring_edges_up, take_edges_down
+
+    bks = _buckets(cfg)
+    B = len(bks)
+    tick = bs.g.tick
+    kd, ku = jax.random.split(key)
+    views = [_view(bs, b, cfg) for b in range(B)]
+
+    d_down_l, d_up_l = [], []
+    for b, (s, c, kb) in enumerate(bks):
+        v = views[b]
+        d_down_l.append(noise(kd, b, "nk") < cfg.churn_disconnect_prob)
+        if cfg.px_enabled:
+            down_age = tick - v.disconnect_tick
+            px_score = jnp.where(down_age > cfg.retain_score_ticks,
+                                 0.0, scores_all_l[b])
+            p_up = jnp.where(px_score >= cfg.accept_px_threshold,
+                             cfg.churn_reconnect_prob,
+                             cfg.churn_reconnect_prob
+                             * cfg.px_low_score_factor)
+        else:
+            p_up = cfg.churn_reconnect_prob
+        d_up_l.append(noise(ku, b, "nk") < p_up)
+
+    ex = _exchange_masks(
+        bs, [[d_down_l[b], d_up_l[b], views[b].direct] for b in range(B)])
+
+    sts = []
+    redial = (tick % cfg.direct_connect_ticks) == 0
+    for b, (s, c, kb) in enumerate(bks):
+        v = views[b]
+        nbr = v.neighbors
+        gd, gu, gdir = ex[b]
+        mine_wins = (s + jnp.arange(c))[:, None] < nbr
+        d_down = jnp.where(mine_wins, d_down_l[b], gd)
+        d_up = jnp.where(mine_wins, d_up_l[b], gu)
+        direct_low = jnp.where(mine_wins, v.direct, gdir)
+        known = nbr >= 0
+        down = known & ~v.connected
+        live = known & v.connected
+        go_down = live & d_down
+        come_up = (down & d_up) | (down & direct_low & redial)
+        if forbid_up_l is not None:
+            come_up = come_up & ~forbid_up_l[b]
+        v = take_edges_down(v, cfg, tp, go_down)
+        v = bring_edges_up(v, cfg, come_up)
+        sts.append(v)
+    return _merge(bs, sts)
+
+
+# ---------------------------------------------------------------------------
+# fault fork (sim/faults.apply_faults, per-bucket cut masks + draws)
+
+
+class BucketedFaultTick(NamedTuple):
+    """Per-bucket twin of sim/faults.FaultTick: the edge-plane members are
+    tuples (one [Nb, Kb] plane per bucket); corrupt/injected stay global."""
+
+    want_down: tuple
+    link_ok: tuple | None
+    dup_edges: tuple | None
+    corrupt: jnp.ndarray | None
+    injected: jnp.ndarray
+
+
+def _apply_faults_b(bs: BucketedState, cfg: SimConfig, tp: TopicParams,
+                    key: jax.Array, noise
+                    ) -> tuple[BucketedState, BucketedFaultTick]:
+    """Per-bucket mirror of sim/faults.apply_faults. Cut masks come from
+    edge_cut_mask's row-window hooks (global-id membership predicates, so
+    per-bucket masks concat into the dense mask); schedule-fact injected
+    bits are identical in every bucket (OR == the dense word) and
+    data-dependent bits OR across buckets (any() over slices == global
+    any())."""
+    from ..ops.churn import bring_edges_up, take_edges_down
+    from .faults import _family_salt, _slow_edge_hash_jax, _thr32, \
+        edge_cut_mask
+    from .invariants import (FAULT_CENSOR, FAULT_LINK_DROP, FAULT_LINK_DUP,
+                             FAULT_SLOWLINK, FAULT_STORM)
+
+    plan = cfg.fault_plan
+    bks = _buckets(cfg)
+    B = len(bks)
+    n = cfg.n_peers
+    tick = bs.g.tick
+    mal = bs.g.malicious
+    if plan.slowlinks:
+        kd, kdup, kc, kslow = jax.random.split(key, 4)
+    else:
+        kd, kdup, kc = jax.random.split(key, 3)
+        kslow = None
+
+    want_down_l = []
+    inj = U32(0)
+    if plan.partitions or plan.outages or plan.eclipses or plan.waves:
+        sts = []
+        for b, (s, c, kb) in enumerate(bks):
+            v = _view(bs, b, cfg)
+            wd, heal, inj_b = edge_cut_mask(
+                plan, tick, v.neighbors, v.reverse_slot,
+                disconnect_tick=v.disconnect_tick, malicious=mal,
+                row_start=s, n_global=n)
+            v = take_edges_down(v, cfg, tp, v.connected & wd)
+            come_up = heal & ~v.connected & ~wd
+            v = bring_edges_up(v, cfg, come_up)
+            want_down_l.append(wd)
+            inj = inj | inj_b
+            sts.append(v)
+        bs = _merge(bs, sts)
+    else:
+        for b, (s, c, kb) in enumerate(bks):
+            wd, _, inj_b = edge_cut_mask(
+                plan, tick, bs.e[b].neighbors, bs.e[b].reverse_slot,
+                malicious=mal, row_start=s, n_global=n)
+            want_down_l.append(wd)
+            inj = inj | inj_b
+
+    for w in plan.storms:
+        inj = inj | jnp.where((tick >= w.start) & (tick < w.end),
+                              U32(FAULT_STORM), U32(0))
+    for w in plan.censorships:
+        inj = inj | jnp.where((tick >= w.start) & (tick < w.end),
+                              U32(FAULT_CENSOR), U32(0))
+
+    conn_l = [bs.e[b].connected for b in range(B)]
+    link_ok_l = dup_edges_l = corrupt = None
+    if plan.link_drop_prob > 0.0:
+        link_ok_l = [noise(kd, b, "nk") >= plan.link_drop_prob
+                     for b in range(B)]
+        drop_any = jnp.zeros((), bool)
+        for b in range(B):
+            drop_any = drop_any | jnp.any(~link_ok_l[b] & conn_l[b])
+        inj = inj | jnp.where(drop_any, U32(FAULT_LINK_DROP), U32(0))
+    if plan.slowlinks:
+        kss = jax.random.split(kslow, len(plan.slowlinks))
+        lk_l = [jnp.ones_like(conn_l[b]) for b in range(B)]
+        stalled = jnp.zeros((), bool)
+        for ci, cl in enumerate(plan.slowlinks):
+            salt = _family_salt(plan.seed, "slowlink", ci)
+            for b, (s, c, kb) in enumerate(bks):
+                nbr_b = bs.e[b].neighbors
+                h = _slow_edge_hash_jax(nbr_b, salt, row_start=s,
+                                        n_global=n)
+                member = (h < U32(_thr32(cl.fraction))) & (nbr_b >= 0)
+                phase = (h % U32(cl.period)).astype(jnp.int32)
+                open_now = ((tick + phase) % cl.period) == 0
+                ok = open_now
+                if cl.drop > 0.0:
+                    ok = ok & (noise(kss[ci], b, "nk") >= cl.drop)
+                lk_l[b] = lk_l[b] & (~member | ok)
+                stalled = stalled | jnp.any(member & ~open_now & conn_l[b])
+        link_ok_l = lk_l if link_ok_l is None \
+            else [a & o for a, o in zip(link_ok_l, lk_l)]
+        inj = inj | jnp.where(stalled, U32(FAULT_SLOWLINK), U32(0))
+    if plan.link_dup_prob > 0.0:
+        dup_edges_l = [(noise(kdup, b, "nk") < plan.link_dup_prob)
+                       & conn_l[b] for b in range(B)]
+        dup_any = jnp.zeros((), bool)
+        for b in range(B):
+            dup_any = dup_any | jnp.any(dup_edges_l[b])
+        inj = inj | jnp.where(dup_any, U32(FAULT_LINK_DUP), U32(0))
+    if plan.corrupt_prob > 0.0:
+        # a [P]-sized global draw, identical to the dense site
+        corrupt = jax.random.uniform(
+            kc, (cfg.publishers_per_tick,)) < plan.corrupt_prob
+    return bs, BucketedFaultTick(want_down=tuple(want_down_l),
+                                 link_ok=None if link_ok_l is None
+                                 else tuple(link_ok_l),
+                                 dup_edges=None if dup_edges_l is None
+                                 else tuple(dup_edges_l),
+                                 corrupt=corrupt, injected=inj)
+
+
+# ---------------------------------------------------------------------------
+# gater decay + invariant sentinel forks
+
+
+def _gater_decay_b(bs: BucketedState, cfg: SimConfig) -> BucketedState:
+    """ops/gater.gater_decay split across the layout: the global
+    validate/throttle planes decay on ``g``, the four per-source planes
+    decay per bucket."""
+    z = cfg.decay_to_zero
+
+    def dec(v, factor):
+        v = v * factor
+        return jnp.where(v < z, 0.0, v)
+
+    g = bs.g._replace(
+        gater_validate=dec(bs.g.gater_validate, cfg.gater_global_decay),
+        gater_throttle=dec(bs.g.gater_throttle, cfg.gater_global_decay))
+    e = tuple(ep._replace(
+        gater_deliver=dec(ep.gater_deliver, cfg.gater_source_decay),
+        gater_duplicate=dec(ep.gater_duplicate, cfg.gater_source_decay),
+        gater_ignore=dec(ep.gater_ignore, cfg.gater_source_decay),
+        gater_reject=dec(ep.gater_reject, cfg.gater_source_decay))
+        for ep in bs.e)
+    return bs._replace(g=g, e=e)
+
+
+def _record_flags_b(bs: BucketedState, cfg: SimConfig,
+                    injected=None) -> BucketedState:
+    """sim/invariants.record_flags over the buckets: every check is an
+    any() reduction, so the OR of per-bucket words is exactly the dense
+    word (global planes are rechecked per bucket — an OR-idempotent
+    repeat, not a double count)."""
+    from .invariants import VIOLATION_MASK, violation_flags
+
+    if cfg.invariant_mode not in ("record", "raise"):
+        raise ValueError(
+            f"invariant_mode={cfg.invariant_mode!r}: expected 'off', "
+            "'record', or 'raise'")
+    flags = U32(0)
+    for b in range(len(bs.e)):
+        flags = flags | violation_flags(_view(bs, b, cfg), cfg,
+                                        n_global=cfg.n_peers)
+    if injected is not None:
+        flags = flags | injected
+    if cfg.invariant_mode == "raise":
+        from jax.experimental import checkify
+        viol = flags & U32(VIOLATION_MASK)
+        checkify.check(viol == 0,
+                       "invariant violation: fault_flags={flags}",
+                       flags=viol)
+    return bs._replace(g=bs.g._replace(
+        fault_flags=bs.g.fault_flags | flags))
+
+
+# ---------------------------------------------------------------------------
+# the bucketed tick + run wrappers
+
+
+def bucketed_step(bs: BucketedState, cfg: SimConfig, tp: TopicParams,
+                  key: jax.Array) -> BucketedState:
+    """One tick on the degree-bucketed layout — sim/engine.step with every
+    edge-plane op at bucket width. Key-split order, op order, and every
+    RNG consumption site mirror engine.step exactly; under
+    ``bucketed_rng="dense"`` the whole tick is bit-exact against a dense
+    step on the same graph (tests/test_bucketed.py)."""
+    from ..parallel.kernel_context import current_kernel_mesh
+    from .engine import choose_publishers
+    from ..ops.propagate import publish
+
+    if current_kernel_mesh() is not None:
+        raise RuntimeError(
+            "bucketed_step does not compose with the sharded kernel mesh "
+            "(halo routing assumes the dense [N, K] planes); shard by "
+            "ROWS at topology build instead (topology.powerlaw rows=...) "
+            "and run one bucketed step per shard")
+    check_bucketable(cfg)
+    noise = _mk_noise(cfg)
+    bs = decode_bucketed(bs, cfg)
+    if cfg.fault_plan is not None:
+        key, k_fault = jax.random.split(key)
+        bs, fault = _apply_faults_b(bs, cfg, tp, k_fault, noise)
+    else:
+        fault = None
+    k_pub, k_hb, k_fwd, k_churn, k_ign, k_sub = jax.random.split(key, 6)
+    del k_sub      # subscription churn is a check_bucketable refusal
+    peers, topics = choose_publishers(bs.g, cfg, k_pub)
+    if fault is not None and fault.corrupt is not None:
+        from .invariants import FAULT_CORRUPT
+        corrupt_eff = fault.corrupt & ~bs.g.malicious[peers]
+        fault = fault._replace(
+            corrupt=corrupt_eff,
+            injected=fault.injected | jnp.where(
+                jnp.any(corrupt_eff), U32(FAULT_CORRUPT), U32(0)))
+    bs = bs._replace(g=publish(
+        bs.g, cfg, peers, topics, k_ign,
+        corrupt=fault.corrupt if fault is not None else None))
+    if cfg.fault_plan is not None:
+        from .faults import censor_word_mask
+        censor_bits = censor_word_mask(bs.g, cfg)
+    else:
+        censor_bits = None
+    if cfg.gater_enabled:
+        bs = _gater_decay_b(bs, cfg)
+    bs, scores, scores_all, inc_gossip, fwd_send = _heartbeat_b(
+        bs, cfg, tp, k_hb, noise)
+    bs = _forward_b(bs, cfg, tp, inc_gossip, scores, k_fwd, fwd_send,
+                    noise,
+                    link_ok_l=fault.link_ok if fault is not None else None,
+                    dup_edges_l=fault.dup_edges
+                    if fault is not None else None,
+                    censor_bits=censor_bits)
+    if cfg.churn_disconnect_prob > 0.0:
+        bs = _churn_b(bs, cfg, tp, k_churn, scores_all, noise,
+                      forbid_up_l=fault.want_down
+                      if fault is not None else None)
+    if cfg.invariant_mode != "off":
+        bs = _record_flags_b(bs, cfg,
+                             injected=fault.injected
+                             if fault is not None else None)
+    bs = bs._replace(g=bs.g._replace(tick=bs.g.tick + 1))
+    return encode_bucketed(bs, cfg)
+
+
+def _bucketed_run_impl(bs: BucketedState, cfg: SimConfig, tp: TopicParams,
+                       key: jax.Array, n_ticks: int) -> BucketedState:
+    """sim/engine._run_impl on the bucketed layout: both key schedules,
+    same per-tick key sequences, one scanned tick program."""
+    if cfg.key_schedule == "fold_in":
+        def body(carry, _):
+            k = jax.random.fold_in(key, carry.g.tick)
+            return bucketed_step(carry, cfg, tp, k), None
+
+        bs, _ = jax.lax.scan(body, bs, None, length=n_ticks)
+        return bs
+    if cfg.key_schedule != "host":
+        raise ValueError(f"unknown key_schedule {cfg.key_schedule!r}; "
+                         "expected 'host' or 'fold_in'")
+
+    def body(carry, k):
+        return bucketed_step(carry, cfg, tp, k), None
+
+    bs, _ = jax.lax.scan(body, bs, jax.random.split(key, n_ticks))
+    return bs
+
+
+bucketed_run = jax.jit(_bucketed_run_impl,
+                       static_argnames=("cfg", "n_ticks"))
+
+
+def init_bucketed_state(cfg: SimConfig, topo, **kwargs) -> BucketedState:
+    """state.init_state -> bucketize: the stored-layout BucketedState a
+    bucketed run starts from. Accepts init_state's keyword planes
+    (subscribed/ip_group/app_score/malicious) unchanged."""
+    from .state import decode_state, init_state
+
+    check_bucketable(cfg)
+    dense = decode_state(init_state(cfg, topo, **kwargs), cfg)
+    return encode_bucketed(bucketize_state(dense, cfg), cfg)

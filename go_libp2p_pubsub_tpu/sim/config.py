@@ -233,6 +233,29 @@ class SimConfig:
     # answer for a heavy-tailed underlay) overrides the factor rule so
     # clustered topologies neither overflow nor over-allocate
     halo_bucket_capacity: int = 0
+    # degree-bucketed edge planes (sim/bucketed.py, ISSUE 15): peers are
+    # partitioned host-side at topology build into contiguous id-ordered
+    # degree classes, ``((n_rows, k_ceil), ...)`` with Σ n_rows ==
+    # n_peers and k_slots == the first (hub) bucket's ceiling; every
+    # [N, K]-adjacent edge plane is stored per bucket padded only to
+    # that bucket's ceiling, and the per-edge ops run once per bucket at
+    # the bucket's width — per-tick cost and resting HBM scale with the
+    # true edge count ΣD = Σ_b n_rows_b·k_ceil_b instead of N·D_max.
+    # None (default) is the dense-uniform fast path: byte-identical
+    # state layout, HLO, and RNG stream to every pre-bucketing build.
+    # topology.powerlaw_buckets derives the partition a powerlaw graph
+    # induces.
+    degree_buckets: tuple | None = None
+    # RNG discipline for the bucketed step's K-shaped draws (selection
+    # noise, churn, gater, link faults): "dense" draws them at the full
+    # [N, ..., k_slots] shape and slices per bucket — the bucketed
+    # trajectory is then BIT-EXACT vs the dense-padded reference on the
+    # same graph (what tests/test_bucketed.py pins), at dense-RNG cost;
+    # "bucket" folds the bucket index into the key and draws at bucket
+    # width — ΣD-scaling cost (the perf configuration), statistically
+    # equivalent but a different stream, so trajectories diverge from
+    # the dense reference. Ignored when degree_buckets is None.
+    bucketed_rng: str = "dense"
 
     @staticmethod
     def from_params(n_peers: int, k_slots: int, n_topics: int = 1,

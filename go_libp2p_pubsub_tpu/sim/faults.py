@@ -524,12 +524,20 @@ def _slow_edge_hash_host(i: int, j: int, salt: int) -> int:
     return _mix32_host(((a * 0x9E3779B1) ^ b ^ salt) & 0xFFFFFFFF)
 
 
-def _slow_edge_hash_jax(neighbors: jnp.ndarray, salt: int) -> jnp.ndarray:
+def _slow_edge_hash_jax(neighbors: jnp.ndarray, salt: int,
+                        row_start: int = 0,
+                        n_global: int | None = None) -> jnp.ndarray:
     """[N, K] symmetric per-edge hash (both directions of an edge hash
     identically — min/max endpoint ordering), matching
-    :func:`_slow_edge_hash_host` bit for bit."""
-    n = neighbors.shape[0]
-    i = jnp.broadcast_to(jnp.arange(n, dtype=U32)[:, None], neighbors.shape)
+    :func:`_slow_edge_hash_host` bit for bit. ``row_start``/``n_global``
+    locate a ROW SLICE of a larger graph (degree-bucket views,
+    sim/bucketed.py): row r holds global peer id row_start + r and
+    neighbor ids stay global, so the hash word per edge is identical to
+    the full-graph call's."""
+    n = n_global if n_global is not None else neighbors.shape[0]
+    i = jnp.broadcast_to(
+        (row_start + jnp.arange(neighbors.shape[0])).astype(U32)[:, None],
+        neighbors.shape)
     j = jnp.clip(neighbors, 0, n - 1).astype(U32)
     a = jnp.minimum(i, j)
     b = jnp.maximum(i, j)
@@ -610,7 +618,8 @@ class FaultTick(NamedTuple):
 def edge_cut_mask(plan: FaultPlan, tick: jnp.ndarray,
                   neighbors: jnp.ndarray, reverse_slot: jnp.ndarray,
                   disconnect_tick: jnp.ndarray | None = None,
-                  malicious: jnp.ndarray | None = None
+                  malicious: jnp.ndarray | None = None,
+                  row_start: int = 0, n_global: int | None = None
                   ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(want_down [N,K], heal_mask [N,K], injected uint32) for this tick's
     partition/outage schedule. ``heal_mask`` covers exactly the edges the
@@ -629,25 +638,41 @@ def edge_cut_mask(plan: FaultPlan, tick: jnp.ndarray,
     and the disconnect stamp are all edge-symmetric), so RemovePeer
     semantics stay edge-symmetric. ``malicious`` gates the eclipse cut
     (sybil edges are the ones an eclipse deliberately leaves standing);
-    eclipse windows in a plan require it."""
+    eclipse windows in a plan require it.
+
+    ``row_start``/``n_global`` locate a ROW SLICE of a larger graph
+    (degree-bucket views, sim/bucketed.py): peer-membership predicates
+    are evaluated on GLOBAL ids (row r is peer row_start + r; neighbor
+    ids are global; ``malicious`` must be the GLOBAL [n_global] mask),
+    so per-bucket masks concat into exactly the full-graph call's."""
     import math
 
     from .invariants import (FAULT_ECLIPSE, FAULT_OUTAGE, FAULT_PARTITION,
                              FAULT_WAVE)
 
-    n, k = neighbors.shape
+    nrows, k = neighbors.shape
+    n = n_global if n_global is not None else nrows
+    # row-window restriction of a global [N] peer predicate. The dense
+    # call (the default) keeps the identity — NOT an identity slice op —
+    # so pre-bucketing programs stay byte-identical in HLO
+    if row_start == 0 and nrows == n:
+        def rsl(a):
+            return a
+    else:
+        def rsl(a):
+            return jax.lax.slice_in_dim(a, row_start, row_start + nrows)
     known = (neighbors >= 0) & (reverse_slot >= 0)
     nbr = jnp.clip(neighbors, 0, n - 1)
 
     wins = []                   # (start, end, cut set, injected bit)
     for w in plan.partitions:
         comp = jnp.arange(n, dtype=jnp.int32) % w.components
-        cross = (comp[:, None] != comp[nbr]) & known
+        cross = (rsl(comp)[:, None] != comp[nbr]) & known
         wins.append((w.start, w.end, cross, FAULT_PARTITION))
     for i, w in enumerate(plan.outages):
         dark = _outage_peers_jax(n, i, plan)
         wins.append((w.start, w.end,
-                     (dark[:, None] | dark[nbr]) & known, FAULT_OUTAGE))
+                     (rsl(dark)[:, None] | dark[nbr]) & known, FAULT_OUTAGE))
     if plan.eclipses and malicious is None:
         raise ValueError("edge_cut_mask: a plan with eclipse windows "
                          "needs the malicious mask (sybil edges are the "
@@ -655,24 +680,24 @@ def edge_cut_mask(plan: FaultPlan, tick: jnp.ndarray,
     for w in plan.eclipses:
         lim = max(1, int(math.ceil(w.fraction * n)))
         tgt = (jnp.arange(n) < lim) & ~malicious
-        honest2 = ~malicious[:, None] & ~malicious[nbr]
-        cross = (tgt[:, None] ^ tgt[nbr]) & honest2 & known
+        honest2 = rsl(~malicious)[:, None] & ~malicious[nbr]
+        cross = (rsl(tgt)[:, None] ^ tgt[nbr]) & honest2 & known
         wins.append((w.start, w.end, cross, FAULT_ECLIPSE))
     for i, w in enumerate(plan.waves):
         dark = _wave_peers_jax(n, i, plan)
-        cut = (dark[:, None] | dark[nbr]) & known
+        cut = (rsl(dark)[:, None] | dark[nbr]) & known
         for s, e in wave_windows(w):
             wins.append((s, e, cut, FAULT_WAVE))
 
-    cut = jnp.zeros((n, k), bool)
-    heal = jnp.zeros((n, k), bool)
+    cut = jnp.zeros((nrows, k), bool)
+    heal = jnp.zeros((nrows, k), bool)
     inj = U32(0)
     # plan-downed: the edge's disconnect stamp falls inside SOME window
     # that cuts it (true everywhere when no stamps are supplied)
     if disconnect_tick is None:
-        plan_downed = jnp.ones((n, k), bool)
+        plan_downed = jnp.ones((nrows, k), bool)
     else:
-        plan_downed = jnp.zeros((n, k), bool)
+        plan_downed = jnp.zeros((nrows, k), bool)
         for s, e, cs, _ in wins:
             plan_downed = plan_downed | \
                 (cs & (disconnect_tick >= s) & (disconnect_tick < e))

@@ -125,7 +125,8 @@ def _bit(cond, bit) -> jnp.ndarray:
     return jnp.where(cond, U32(bit), U32(0))
 
 
-def violation_flags(state: SimState, cfg: SimConfig) -> jnp.ndarray:
+def violation_flags(state: SimState, cfg: SimConfig,
+                    n_global: int | None = None) -> jnp.ndarray:
     """uint32 scalar of violation bits for the END-OF-TICK state (called by
     engine.step after churn closes the tick, before the tick increments).
 
@@ -133,8 +134,16 @@ def violation_flags(state: SimState, cfg: SimConfig) -> jnp.ndarray:
     [N,T,K] f32 counters dominate (~4 reads of what the tick's attribution
     pass already wrote). NaN is caught by comparison semantics: NaN >= 0
     is False, so the ``>= 0`` check covers NaN and the ``< inf`` check
-    covers +Inf — no separate isnan pass."""
+    covers +Inf — no separate isnan pass.
+
+    ``n_global`` overrides the peer-id range bound for the msg_publisher
+    check: a degree-bucket VIEW (sim/bucketed.py) carries a row-sliced
+    state whose local row count is NOT the id space — publisher ids are
+    global. Every check here is an any() reduction, so per-bucket words
+    OR together into exactly the dense word."""
     n, t, k = state.mesh.shape
+    if n_global is not None:
+        n = n_global
     tick = state.tick
     f = U32(0)
 
@@ -194,7 +203,7 @@ def violation_flags(state: SimState, cfg: SimConfig) -> jnp.ndarray:
 
 
 def record_flags(state: SimState, cfg: SimConfig,
-                 injected=None) -> SimState:
+                 injected=None, n_global: int | None = None) -> SimState:
     """OR this tick's (injected | violation) bits into the state, and in
     ``"raise"`` mode escalate violations through checkify (callers must be
     checkify-transformed — sim/engine.run_checked)."""
@@ -202,7 +211,7 @@ def record_flags(state: SimState, cfg: SimConfig,
         raise ValueError(
             f"invariant_mode={cfg.invariant_mode!r}: expected 'off', "
             "'record', or 'raise'")
-    flags = violation_flags(state, cfg)
+    flags = violation_flags(state, cfg, n_global=n_global)
     if injected is not None:
         flags = flags | injected
     if cfg.invariant_mode == "raise":

@@ -467,6 +467,110 @@ def outage_small(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
                       start=start, heal=heal, **kw)
 
 
+# --- heavy-tailed underlay family (sim/bucketed.py, ISSUE 15) -----------
+# Truncated power-law degree sequences realized by the shard-constructible
+# topology.powerlaw builder, run on the degree-bucketed edge layout so
+# per-tick cost and resting HBM scale with sum-of-degrees instead of
+# N * D_max. These builders return (cfg, tp, BucketedState) — the state
+# is for sim.bucketed.bucketed_run, NOT engine.run, so they live in
+# BUCKETED_SCENARIOS rather than SCENARIOS (whose generic consumers feed
+# engine.run).
+
+POWERLAW_NS = {"powerlaw_100k": 131_072, "powerlaw_1m": 1_048_576}
+
+
+def powerlaw_cfg(n_peers: int, d_min: int = 8, d_max: int = 64,
+                 alpha: float = 2.0, n_topics: int = 2,
+                 msg_window: int = 64, state_precision: str = "compact",
+                 bucketed_rng: str = "bucket") -> SimConfig:
+    """The heavy-tail SimConfig alone — no topology build. The bucket
+    partition is closed-form (topology.powerlaw_buckets), so HBM budget
+    gates price the REAL bucketed layout before any underlay
+    construction (the frontier_cfg discipline)."""
+    buckets = topology.powerlaw_buckets(n_peers, d_min=d_min, d_max=d_max,
+                                        alpha=alpha)
+    return SimConfig(
+        n_peers=n_peers, k_slots=buckets[0][1], n_topics=n_topics,
+        msg_window=msg_window, publishers_per_tick=16, prop_substeps=8,
+        scoring_enabled=True, behaviour_penalty_weight=-10.0,
+        behaviour_penalty_decay=0.999, gossip_threshold=-100.0,
+        publish_threshold=-200.0, graylist_threshold=-300.0,
+        churn_disconnect_prob=0.002, churn_reconnect_prob=0.2,
+        retain_score_ticks=30, state_precision=state_precision,
+        degree_buckets=buckets, bucketed_rng=bucketed_rng)
+
+
+def powerlaw_spec(n_peers: int, d_min: int = 8, d_max: int = 64,
+                  alpha: float = 2.0, subnet_fraction: float = 0.3,
+                  rows: tuple[int, int] | None = None, **cfg_kw,
+                  ) -> tuple[SimConfig, TopicParams, "topology.Topology",
+                             np.ndarray]:
+    """The heavy-tail scenario WITHOUT device state: ``(cfg, tp, topo,
+    subscribed)``. ``rows=(start, count)`` builds only that shard of the
+    underlay (topology.powerlaw is a pure function of row id — concat
+    across shards equals the full build bit for bit)."""
+    cfg = powerlaw_cfg(n_peers, d_min=d_min, d_max=d_max, alpha=alpha,
+                       **cfg_kw)
+    rng = np.random.default_rng(SEED)
+    subscribed = np.zeros((n_peers, cfg.n_topics), dtype=bool)
+    subscribed[:, 0] = True
+    for t in range(1, cfg.n_topics):
+        subscribed[:, t] = rng.random(n_peers) < subnet_fraction
+    topo = topology.powerlaw(n_peers, cfg.k_slots, d_min=d_min,
+                             d_max=d_max, alpha=alpha, seed=SEED, rows=rows)
+    return cfg, default_topic_params(cfg.n_topics), topo, subscribed
+
+
+def powerlaw_bucketed(n_peers: int, **kw):
+    """Single-process heavy-tail constructor: (cfg, tp, BucketedState)."""
+    from . import bucketed
+    cfg, tp, topo, subscribed = powerlaw_spec(n_peers, **kw)
+    return cfg, tp, bucketed.init_bucketed_state(cfg, topo,
+                                                 subscribed=subscribed)
+
+
+def powerlaw_100k(n_peers: int = POWERLAW_NS["powerlaw_100k"], **kw):
+    return powerlaw_bucketed(n_peers, **kw)
+
+
+def powerlaw_1m(n_peers: int = POWERLAW_NS["powerlaw_1m"], **kw):
+    return powerlaw_bucketed(n_peers, **kw)
+
+
+def heavytail_eclipse(n_peers: int = POWERLAW_NS["powerlaw_100k"],
+                      start: int = 3, end: int = 8,
+                      sybil_fraction: float = 0.1, **kw):
+    """Hub-targeted eclipse on the heavy-tailed underlay: powerlaw puts
+    the hubs at the LOW ids — exactly the contiguous region
+    EclipseWindow targets — so the window fraction is sized to cover the
+    hub bucket and the sybils are drawn from the tail. The attack the
+    uniform-degree eclipse scenarios cannot express: cutting the hub
+    bucket severs the underlay's high-degree core."""
+    import dataclasses
+
+    from . import bucketed
+    from .faults import EclipseWindow, FaultPlan
+    cfg, tp, topo, subscribed = powerlaw_spec(n_peers, **kw)
+    n_hub = cfg.degree_buckets[0][0]
+    rng = np.random.default_rng(SEED)
+    malicious = np.zeros(n_peers, dtype=bool)
+    tail = np.arange(n_hub, n_peers)
+    malicious[rng.choice(tail, size=min(len(tail),
+                                        int(sybil_fraction * n_peers)),
+                         replace=False)] = True
+    cfg = dataclasses.replace(cfg, fault_plan=FaultPlan(eclipses=(
+        EclipseWindow(start, end, fraction=n_hub / n_peers),)))
+    return cfg, tp, bucketed.init_bucketed_state(
+        cfg, topo, subscribed=subscribed, malicious=malicious)
+
+
+BUCKETED_SCENARIOS = {
+    "powerlaw_100k": powerlaw_100k,
+    "powerlaw_1m": powerlaw_1m,
+    "heavytail_eclipse": heavytail_eclipse,
+}
+
+
 SCENARIOS = {
     "1k_single_topic": single_topic_1k,
     "10k_beacon": beacon_10k,

@@ -15,6 +15,7 @@ Array roles (reference state being modeled):
 
 from __future__ import annotations
 
+import dataclasses
 import os
 from functools import partial
 from typing import NamedTuple
@@ -410,6 +411,30 @@ def per_peer_byte_ceilings(cfg: SimConfig) -> dict:
     )
 
 
+def bucketed_edge_nbytes(cfg: SimConfig) -> dict:
+    """field -> bytes of each K-axis edge plane under the degree-bucketed
+    layout (sim/bucketed.py): the sum over buckets of the SAME field
+    priced at the bucket's ``(n_rows, k_ceil)`` — so the codec
+    (f32/compact) prices each bucket exactly as state_spec prices a
+    dense graph of that shape — plus ``bucket_rev``, the flat int32
+    reverse-index planes the packed exchanges gather through."""
+    from .bucketed import EDGE_FIELDS, _buckets, check_bucketable
+    check_bucketable(cfg)
+    out = {f: 0 for f in EDGE_FIELDS}
+    rev = 0
+    for _, n_b, k_b in _buckets(cfg):
+        sub = dataclasses.replace(cfg, n_peers=n_b, k_slots=k_b,
+                                  degree_buckets=None)
+        sub_spec = state_spec(sub)
+        for f in EDGE_FIELDS:
+            shape, dtype, _ = sub_spec[f]
+            out[f] += int(np.prod(shape, dtype=np.int64)) \
+                * np.dtype(dtype).itemsize
+        rev += n_b * k_b * 4
+    out["bucket_rev"] = rev
+    return out
+
+
 def state_nbytes(cfg: SimConfig, n_dev: int | dict = 1) -> dict:
     """Host-side accounting of the SimState HBM footprint: per-field bytes,
     the global total, and the per-shard bytes on an ``n_dev``-way peer
@@ -436,6 +461,17 @@ def state_nbytes(cfg: SimConfig, n_dev: int | dict = 1) -> dict:
         fields[f] = nbytes
         total += nbytes
         per_shard += nbytes // n_dev if peer_major else nbytes
+    if cfg.degree_buckets is not None:
+        # reprice the K-axis planes at the bucketed layout: each edge
+        # plane is padded to its bucket's ceiling instead of k_slots, so
+        # resting bytes scale with sum-of-degrees, not N * D_max. All
+        # edge planes are peer-major; bucket row counts need not divide
+        # n_dev evenly, so per-shard prices the ceiling split.
+        for f, nbytes in bucketed_edge_nbytes(cfg).items():
+            old = fields.get(f, 0)
+            fields[f] = nbytes
+            total += nbytes - old
+            per_shard += -(-nbytes // n_dev) - old // n_dev
     out = {"total": total, "per_shard": per_shard, "n_dev": n_dev,
            "fields": fields}
     if mesh is not None:
@@ -479,7 +515,10 @@ def check_hbm_budget(cfg: SimConfig, n_dev: int | dict = 1,
     if budget is None or acct["per_shard"] <= budget:
         return acct
     spec = state_spec(cfg)
-    shard_fields = {f: (b // acct["n_dev"] if spec[f][2] else b)
+    # fields absent from the spec (the bucketed layout's synthetic
+    # bucket_rev plane) are peer-major by construction
+    shard_fields = {f: (b // acct["n_dev"]
+                        if f not in spec or spec[f][2] else b)
                     for f, b in acct["fields"].items()}
     worst = sorted(shard_fields.items(), key=lambda kv: -kv[1])[:4]
     names = ", ".join(f"{f}={b / 2 ** 20:.1f}MiB" for f, b in worst)

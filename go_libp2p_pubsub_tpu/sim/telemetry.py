@@ -399,6 +399,12 @@ class HealthJournal:
             # so the dashboard can render active windows and evaluate the
             # default behavior contracts without the (jit-static) config
             meta.setdefault("attack_windows", sched)
+        if getattr(cfg, "degree_buckets", None):
+            # heavy-tailed underlays stamp their bucket partition (and
+            # callers pass degree_stats=... for the realized degrees) so
+            # the dashboard header states the graph shape the run is on
+            meta.setdefault("degree_buckets",
+                            [list(b) for b in cfg.degree_buckets])
         self.note("run",
                   fingerprint=checkpoint.config_fingerprint(cfg),
                   n_peers=cfg.n_peers, n_topics=cfg.n_topics,

@@ -240,6 +240,239 @@ def sparse_hash(n: int, k: int, degree: int = 8, seed: int = 314159,
     return Topology(neighbors, outbound, reverse_slot, degree_arr)
 
 
+def powerlaw_levels(n: int, d_min: int = 8, d_max: int = 64,
+                    alpha: float = 2.0) -> list[tuple[int, int]]:
+    """The prefix-nested ring schedule realizing a truncated power-law
+    degree sequence: ``[(m_l, c_l)]`` where level ``l`` is a circulant on
+    the id-prefix ``[0, m_l)`` with ``c_l`` seed-drawn offsets (2 edges
+    per offset per member). Peer ``i``'s degree is ``2 * sum(c_l for
+    m_l > i)`` — non-increasing with id, so the hubs are the LOW ids
+    (the contiguous region eclipse windows target, sim/faults.py).
+
+    Level ``l`` covers the prefix ``m_l ~ n * 2^(-l*(alpha-1))`` and
+    doubles the prefix's degree, which realizes the complementary-CDF
+    ``P(D >= x) ~ (x/d_min)^-(alpha-1)`` of a truncated power law with
+    tail exponent ``alpha`` (alpha=2 halves the prefix per doubling).
+    The last level is trimmed so the hub degree lands on ``d_max``
+    exactly when the prefix has room for its offset classes; levels
+    whose prefix gets too small for distinct offset classes are dropped
+    (the realized hub degree is then below ``d_max`` — callers read the
+    realized ceiling off ``powerlaw_buckets``/``degree_stats``)."""
+    if n < 4:
+        raise ValueError(f"powerlaw needs n >= 4, got {n}")
+    if d_min < 2 or d_min % 2:
+        raise ValueError(f"powerlaw: d_min must be even >= 2, got {d_min}")
+    if d_max < d_min:
+        raise ValueError(f"powerlaw: d_max={d_max} < d_min={d_min}")
+    if alpha <= 1.0:
+        raise ValueError(f"powerlaw: alpha={alpha} needs alpha > 1")
+    levels = [(n, d_min // 2)]
+    deg = 2 * (d_min // 2)
+    lev = 1
+    while deg < d_max:
+        m = int(np.ceil(n * 2.0 ** (-lev * (alpha - 1.0))))
+        c = min((d_max - deg) // 2, deg // 2)       # doubling, d_max-trimmed
+        if c < 1 or m < 4 * c + 4:
+            break                # prefix too small for c distinct classes
+        levels.append((m, c))
+        deg += 2 * c
+        lev += 1
+    return levels
+
+
+def _powerlaw_offsets(levels: list[tuple[int, int]],
+                      seed: int) -> list[np.ndarray]:
+    """Per-level circulant offsets with GLOBALLY disjoint difference
+    classes: an accepted offset ``o`` of level ``l`` reserves the integer
+    class ``{o, m_l - o}``, and every candidate colliding with any
+    reserved value (its own level's or another's) is rejected. Disjoint
+    classes mean two levels can never produce the same (i, j) pair — the
+    construction is duplicate-free WITHOUT a dedup pass, so every row's
+    slot count is exactly its formulaic degree and ``reverse_slot``
+    ranks against a formulaic (never materialized) neighbor set."""
+    rng = np.random.default_rng(seed)
+    taken: set[int] = set()
+    out: list[np.ndarray] = []
+    for m, c in levels:
+        offs: list[int] = []
+        tries = 0
+        while len(offs) < c:
+            tries += 1
+            if tries > 1000 * c:
+                raise ValueError(
+                    f"powerlaw: could not draw {c} disjoint offset "
+                    f"classes in a ring of {m} (degree schedule too "
+                    "dense for this n — lower d_max or raise n)")
+            o = int(rng.integers(1, m))
+            if o in taken or (m - o) in taken or 2 * o == m:
+                continue
+            taken.add(o)
+            taken.add(m - o)
+            offs.append(o)
+        out.append(np.array(sorted(offs), np.int64))
+    return out
+
+
+def powerlaw(n: int, k: int, d_min: int = 8, d_max: int = 64,
+             alpha: float = 2.0, seed: int = 314159,
+             rows: tuple[int, int] | None = None,
+             chunk_elems: int = 1 << 22) -> Topology:
+    """Shard-constructible heavy-tailed underlay: a truncated power-law
+    degree sequence (tail exponent ``alpha``, degrees in
+    ``[d_min, ~d_max]``, non-increasing with peer id) realized as
+    prefix-nested seeded circulants — the configuration-model analogue
+    of :func:`sparse_hash`, where every row is a pure function of
+    ``(n, d_min, d_max, alpha, seed, row)`` and a ``rows=(start,
+    count)`` build materializes only that shard of every plane (concat
+    across shards equals the full build bit for bit;
+    tests/test_topology_powerlaw.py pins ragged splits).
+
+    Graph shape: symmetric, duplicate-free (disjoint difference classes
+    across levels — :func:`_powerlaw_offsets`), slots in sorted-neighbor
+    order like ``_finalize``, the "+" offset direction dialed
+    (outbound). Hubs are the LOW ids: the contiguous region
+    :class:`sim.faults.EclipseWindow` targets, which is what makes the
+    ``heavytail_eclipse`` scenario expressible. ``reverse_slot`` ranks
+    ``i`` inside each neighbor's formulaic candidate set — strictly
+    local, chunk cost ``[R, D_row, D_max]`` with ``R`` auto-shrunk near
+    the hubs (``chunk_elems`` bounds the temporary)."""
+    levels = powerlaw_levels(n, d_min=d_min, d_max=d_max, alpha=alpha)
+    offs = _powerlaw_offsets(levels, seed)
+    dmax_real = 2 * sum(c for _, c in levels)
+    if k < dmax_real:
+        raise ValueError(
+            f"powerlaw: hub degree {dmax_real} needs k >= {dmax_real}, "
+            f"got k={k}")
+    r0, cnt = (0, n) if rows is None else rows
+    if r0 < 0 or cnt < 0 or r0 + cnt > n:
+        raise ValueError(f"powerlaw: rows=({r0}, {cnt}) outside [0, {n})")
+
+    # flattened per-level candidate schedule: for each level l and offset
+    # o, two signed columns (+o then -o) in canonical (level, offset,
+    # sign) order — first occurrence IS the only occurrence (disjoint
+    # classes), so direction needs no tie-break
+    col_m = np.concatenate([np.full(2 * len(o), m, np.int64)
+                            for (m, _), o in zip(levels, offs)])
+    # interleave so sign order within (level, offset) is [+, -]
+    col_off = np.concatenate([np.stack([o, -o], 1).reshape(-1)
+                              for o in offs])
+    col_out = np.tile(np.array([True, False]),
+                      col_m.size // 2)                  # '+' side dialed
+    return _powerlaw_fill(n, k, cnt, r0, levels, offs, col_m, col_off,
+                          col_out, chunk_elems)
+
+
+def _ring_rank_below(j: np.ndarray, i: np.ndarray, offs_sorted: np.ndarray,
+                     m: int) -> np.ndarray:
+    """#{x in {(j±o) mod m : o in offs_sorted} : x < i} in closed form —
+    each of the four (sign, wrap) branches is a contiguous offset
+    interval, counted by searchsorted on the SORTED offsets. This is
+    what keeps ``reverse_slot`` construction at ``ΣD·levels·log c``
+    instead of materializing every neighbor's candidate set
+    (``ΣD·D_max``, minutes at 1M)."""
+    O = offs_sorted
+
+    def upto(v):                              # #{o in O : o <= v}
+        return np.searchsorted(O, v, side="right")
+
+    # '+' no wrap: o <= m-1-j, x = j+o < i          -> o <= min(i-j-1, m-1-j)
+    ca = upto(np.minimum(i - j - 1, m - 1 - j))
+    # '+' wrap:    o >= m-j,   x = j+o-m < i        -> o <= m-j+i-1
+    cb = upto(np.minimum(m - j + i - 1, m - 1)) - upto(m - j - 1)
+    # '-' no wrap: o <= j,     x = j-o < i          -> o >= j-i+1
+    cc = upto(np.minimum(j, m - 1)) - upto(np.maximum(j - i + 1, 1) - 1)
+    # '-' wrap:    o >= j+1,   x = j-o+m < i        -> o >= m+j-i+1
+    cd = upto(m - 1) - upto(np.maximum(j + 1, m + j - i + 1) - 1)
+    return ca + cb + cc + cd
+
+
+def _powerlaw_fill(n, k, cnt, r0, levels, offs, col_m, col_off, col_out,
+                   chunk_elems) -> Topology:
+    neighbors = np.full((cnt, k), -1, np.int32)
+    outbound = np.zeros((cnt, k), bool)
+    reverse_slot = np.full((cnt, k), -1, np.int32)
+    c0 = 0
+    while c0 < cnt:
+        # the chunk's first row is its widest (degrees non-increasing);
+        # drop columns of levels no chunk row belongs to
+        act = col_m > r0 + c0
+        am, ao, aout = col_m[act], col_off[act], col_out[act]
+        width = int(act.sum())
+        rchunk = max(64, int(chunk_elems // max(width, 1)))
+        c1 = min(c0 + rchunk, cnt)
+        i = np.arange(r0 + c0, r0 + c1, dtype=np.int64)[:, None]   # [R, 1]
+        member = i < am[None, :]                                   # [R, W]
+        cand = np.where(member, (i + ao[None, :]) % am[None, :],
+                        np.int64(n))
+        order = np.argsort(cand, axis=1, kind="stable")
+        nb_s = np.take_along_axis(cand, order, 1)                  # [R, W]
+        out_s = np.take_along_axis(
+            np.broadcast_to(aout, cand.shape), order, 1)
+        valid = nb_s < n
+        j = np.where(valid, nb_s, 0)
+        # my slot in neighbor j's table = rank of i among j's formulaic
+        # candidates, summed over the levels j belongs to (duplicate-free
+        # across levels, so rank == sorted-slot index)
+        rev = np.zeros_like(j)
+        for (m, _), o in zip(levels, offs):
+            lvl = j < m                                            # [R, W]
+            cnt_l = _ring_rank_below(np.where(lvl, j, 0), i, o, m)
+            rev += np.where(lvl, cnt_l, 0)
+        take = min(width, k)
+        neighbors[c0:c1, :take] = np.where(valid, nb_s, -1)[:, :take]
+        outbound[c0:c1, :take] = (valid & out_s)[:, :take]
+        reverse_slot[c0:c1, :take] = np.where(valid, rev, -1)[:, :take]
+        c0 = c1
+    degree_arr = (neighbors >= 0).sum(axis=1).astype(np.int32)
+    return Topology(neighbors, outbound, reverse_slot, degree_arr)
+
+
+def powerlaw_buckets(n: int, d_min: int = 8, d_max: int = 64,
+                     alpha: float = 2.0, round_to: int = 8,
+                     ) -> tuple[tuple[int, int], ...]:
+    """The degree-bucket partition a :func:`powerlaw` graph induces:
+    ``((n_rows, k_ceil), ...)`` in id order — one bucket per maximal
+    contiguous equal-degree id range (the level-prefix boundaries), each
+    ceiling rounded up to ``round_to`` slots (lane friendliness). This
+    is the value ``SimConfig.degree_buckets`` takes; ``k_slots`` must
+    equal the first (hub) bucket's ceiling — ``sim.bucketed`` validates.
+    """
+    levels = powerlaw_levels(n, d_min=d_min, d_max=d_max, alpha=alpha)
+    bounds = sorted({m for m, _ in levels})             # ascending prefixes
+    out = []
+    prev = 0
+    for m in bounds:
+        deg = 2 * sum(c for (ml, c) in levels if ml >= m)
+        ceil = -(-max(deg, 1) // round_to) * round_to
+        out.append((m - prev, ceil))
+        prev = m
+    return tuple(out)
+
+
+def degree_stats(topo: "Topology | np.ndarray") -> dict:
+    """Shape summary of an underlay's degree sequence — stamped into
+    bench records and the dashboard header so every banked line states
+    the graph it ran on: min/mean/p99/max degree and the Gini
+    coefficient of the degree distribution (0 = uniform-degree, ~0.5+
+    = heavy-tailed)."""
+    deg = np.asarray(topo.degree if isinstance(topo, Topology) else topo,
+                     np.int64)
+    if deg.size == 0:
+        raise ValueError("degree_stats: empty degree sequence")
+    srt = np.sort(deg)
+    total = int(srt.sum())
+    if total > 0:
+        cum = np.cumsum(srt, dtype=np.int64)
+        gini = float((deg.size + 1 - 2 * (cum.sum() / total)) / deg.size)
+    else:
+        gini = 0.0
+    return {"n": int(deg.size), "sum": total,
+            "min": int(srt[0]), "max": int(srt[-1]),
+            "mean": round(float(srt.mean()), 3),
+            "p99": int(np.percentile(srt, 99, method="lower")),
+            "gini": round(gini, 4)}
+
+
 def full(n: int, k: int) -> Topology:
     """Complete graph (connectAll, floodsub_test.go:93-100). Requires k >= n-1."""
     if k < n - 1:

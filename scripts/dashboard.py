@@ -395,6 +395,16 @@ def render(snap: dict) -> str:
         "CRASHED" if snap.get("crashes") else
         "PAUSED (resumable)" if snap.get("paused") else "live")
     out.append(f"== graft telemetry :: {title} ({shape}) [{status}] ==")
+    ds = run.get("degree_stats")
+    if ds:
+        # heavy-tailed underlays: the run header states the graph shape
+        # every number below was measured on (sim/topology.degree_stats)
+        out.append(f"  underlay degree min/mean/p99/max "
+                   f"{ds.get('min')}/{ds.get('mean')}/{ds.get('p99')}/"
+                   f"{ds.get('max')}   gini {ds.get('gini')}")
+    elif run.get("degree_buckets"):
+        out.append("  degree buckets " + " ".join(
+            f"{nb}x{kb}" for nb, kb in run["degree_buckets"]))
     if "tick" not in snap:
         # a first-chunk crash journals no health rows — the crash pointer
         # (the post-mortem entry point) must still render, and so must the

@@ -1,0 +1,109 @@
+"""Heavy-tailed underlay builder (sim/topology.powerlaw, ISSUE 15).
+
+Pins the three contracts the degree-bucketed engine rides on:
+
+- **Shard-build parity**: every row of every plane is a pure function of
+  ``(n, d_min, d_max, alpha, seed, row)``, so ``rows=(start, count)``
+  builds concat across RAGGED splits (including a short last shard) into
+  exactly the full build, bit for bit.
+- **Bucket consistency**: ``powerlaw_buckets`` tiles ``n``, ceilings are
+  non-increasing (hubs first), the hub ceiling bounds the realized hub
+  degree, and every peer's realized degree fits its bucket's ceiling —
+  the precondition ``sim.bucketed.bucketize_state`` enforces at runtime.
+- **degree_stats**: the bench-record/dashboard-header shape summary
+  reports the realized min/mean/p99/max and a heavy-tail Gini.
+"""
+
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import topology
+
+
+def _assert_topo_equal(a, b):
+    np.testing.assert_array_equal(a.neighbors, b.neighbors)
+    np.testing.assert_array_equal(a.outbound, b.outbound)
+    np.testing.assert_array_equal(a.reverse_slot, b.reverse_slot)
+    np.testing.assert_array_equal(a.degree, b.degree)
+
+
+class TestShardParity:
+    def test_ragged_splits_concat_to_full_build(self):
+        """Ragged row splits — misaligned boundaries and a SHORT last
+        shard — concat bit-for-bit into the full build."""
+        n, k = 600, 16
+        kw = dict(d_min=4, d_max=16, alpha=2.0, seed=11)
+        full = topology.powerlaw(n, k, **kw)
+        for bounds in ([0, 193, 450, 600], [0, 599, 600], [0, 7, 600]):
+            parts = [topology.powerlaw(n, k, **kw, rows=(s, e - s))
+                     for s, e in zip(bounds, bounds[1:])]
+            cat = topology.Topology(
+                *(np.concatenate([getattr(p, f) for p in parts])
+                  for f in topology.Topology._fields))
+            _assert_topo_equal(cat, full)
+
+    def test_single_row_shard_matches(self):
+        n, k = 128, 16
+        kw = dict(d_min=4, d_max=16, alpha=2.0, seed=3)
+        full = topology.powerlaw(n, k, **kw)
+        one = topology.powerlaw(n, k, **kw, rows=(17, 1))
+        np.testing.assert_array_equal(one.neighbors[0], full.neighbors[17])
+        np.testing.assert_array_equal(one.reverse_slot[0],
+                                      full.reverse_slot[17])
+
+    def test_symmetric_and_duplicate_free(self):
+        n, k = 256, 16
+        topo = topology.powerlaw(n, k, d_min=4, d_max=16, seed=7)
+        nbr, rsl = topo.neighbors, topo.reverse_slot
+        for i in range(n):
+            row = nbr[i][nbr[i] >= 0]
+            assert len(set(row.tolist())) == len(row), f"dup nbrs at {i}"
+            assert i not in row, f"self-edge at {i}"
+        # reverse_slot closes the loop: neighbors[j, rsl] == i
+        valid = (nbr >= 0) & (rsl >= 0)
+        ii, ss = np.nonzero(valid)
+        jj, rr = nbr[ii, ss], rsl[ii, ss]
+        np.testing.assert_array_equal(nbr[jj, rr], ii)
+
+
+class TestBuckets:
+    def test_partition_tiles_and_bounds_degrees(self):
+        n = 1024
+        kw = dict(d_min=8, d_max=64, alpha=2.0)
+        buckets = topology.powerlaw_buckets(n, **kw)
+        assert sum(nb for nb, _ in buckets) == n
+        ceils = [kb for _, kb in buckets]
+        assert ceils == sorted(ceils, reverse=True), "hubs must come first"
+        topo = topology.powerlaw(n, buckets[0][1], **kw, seed=5)
+        start = 0
+        for nb, kb in buckets:
+            assert topo.degree[start:start + nb].max() <= kb, \
+                f"bucket at rows [{start}, {start + nb}) overflows {kb}"
+            start += nb
+        # degrees are non-increasing with id (hubs are the LOW ids — the
+        # region EclipseWindow targets)
+        assert (np.diff(topo.degree) <= 0).all()
+
+    def test_round_to_lane_friendly(self):
+        for nb, kb in topology.powerlaw_buckets(2048, d_min=8, d_max=64,
+                                                round_to=8):
+            assert kb % 8 == 0
+
+
+class TestDegreeStats:
+    def test_known_sequence(self):
+        stats = topology.degree_stats(np.array([2, 2, 2, 2]))
+        assert stats == {"n": 4, "sum": 8, "min": 2, "max": 2,
+                         "mean": 2.0, "p99": 2, "gini": 0.0}
+
+    def test_heavy_tail_has_positive_gini(self):
+        topo = topology.powerlaw(1024, 64, d_min=8, d_max=64, seed=5)
+        stats = topology.degree_stats(topo)
+        assert stats["min"] >= 8 and stats["max"] <= 64
+        assert stats["n"] == 1024 and stats["sum"] == int(topo.degree.sum())
+        uniform = topology.degree_stats(np.full(1024, 12))
+        assert stats["gini"] > 0.2 > uniform["gini"] == 0.0
+
+    def test_empty_refused(self):
+        with pytest.raises(ValueError, match="empty"):
+            topology.degree_stats(np.array([], dtype=np.int64))

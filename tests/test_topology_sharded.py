@@ -83,6 +83,21 @@ class TestShardParity:
             np.testing.assert_array_equal(
                 cat, getattr(full, field), err_msg=(field, p))
 
+    def test_ragged_splits_concat_to_full_build(self):
+        """Uneven row splits — misaligned boundaries and a SHORT last
+        shard — also concat bit-for-bit (ISSUE 15: elastic meshes hand
+        ragged row ranges to survivors, not tidy n/p blocks)."""
+        n, k, degree = 512, 16, 6
+        full = _full(n, k, degree)
+        for bounds in ([0, 129, 380, 512], [0, 511, 512]):
+            parts = [topology.sparse_hash(n, k, degree=degree,
+                                          rows=(s, e - s))
+                     for s, e in zip(bounds, bounds[1:])]
+            for field in ("neighbors", "outbound", "reverse_slot"):
+                cat = np.concatenate([getattr(t, field) for t in parts])
+                np.testing.assert_array_equal(
+                    cat, getattr(full, field), err_msg=(field, bounds))
+
     def test_chunk_size_does_not_change_the_build(self):
         n, k, degree = 300, 16, 5
         a = topology.sparse_hash(n, k, degree=degree, chunk_rows=7)
