@@ -193,7 +193,9 @@ NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
          "telemetry_1k", "telemetry_10k",
          "supervised_overlap_1k", "supervised_overlap_10k",
          "eclipse_50k", "flashcrowd_50k",
-         "powerlaw_100k", "powerlaw_1m", "heavytail_eclipse", "headline"]
+         "powerlaw_100k", "powerlaw_1m", "powerlaw_10m",
+         "heavytail_eclipse",
+         "powerlaw_100k_mh", "powerlaw_10m_mh", "headline"]
 # execution order puts headline FIRST (banked before anything can time
 # out — losing it cost round 5 its record, VERDICT r5 weak #2) and its
 # line is re-emitted LAST so the driver's single-line stdout parse still
@@ -229,8 +231,11 @@ TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60,
                  "eclipse_50k": 10, "flashcrowd_50k": 10,
                  # heavy-tail family (ISSUE 15): frontier-style short
                  # windows; heavytail_eclipse covers its [3, 8) window
-                 "powerlaw_100k": 10, "powerlaw_1m": 3,
-                 "heavytail_eclipse": 10}
+                 "powerlaw_100k": 10, "powerlaw_1m": 3, "powerlaw_10m": 2,
+                 "heavytail_eclipse": 10,
+                 # row-sharded bucketed family (ISSUE 16): the sharded
+                 # execution path at frontier-style windows
+                 "powerlaw_100k_mh": 10, "powerlaw_10m_mh": 2}
 
 
 def _fleet_b() -> int:
@@ -703,6 +708,103 @@ def bench_bucketed(name: str, ticks: int, repeats: int) -> str:
     return line
 
 
+def bench_bucketed_mh(name: str, ticks: int, repeats: int) -> str:
+    """ROW-SHARDED bucketed lines (ISSUE 16): the same compiled unit
+    scripts/run_multihost.py --engine bucketed dispatches per process —
+    ``make_sharded_bucketed_run`` over the local device mesh, every
+    bucket's edge planes row-split across shards — measured with the
+    degree shape AND the per-(bucket x shard) byte accounting stamped
+    into the record (scripts/dashboard.py renders those instead of a
+    dense estimate). The HBM gate prices the sharded layout closed-form
+    BEFORE the underlay builds, exactly like the launcher."""
+    import resource
+
+    import jax
+    import numpy as np
+    from go_libp2p_pubsub_tpu.ops.dispatch import resolved_formulations
+    from go_libp2p_pubsub_tpu.parallel.sharding import (
+        make_mesh, make_sharded_bucketed_run, shard_bucketed_state)
+    from go_libp2p_pubsub_tpu.sim import scenarios, topology
+    from go_libp2p_pubsub_tpu.sim.bucketed import (decode_bucketed,
+                                                   init_bucketed_state)
+    from go_libp2p_pubsub_tpu.sim.engine import (delivery_fraction,
+                                                 delivery_latency_ticks)
+    from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
+    from go_libp2p_pubsub_tpu.sim.state import check_hbm_budget
+
+    n = _cap_peers(POWERLAW_MH_FULL_N[name])
+    devs = jax.devices()
+    # closed-form per-(bucket x shard) gate before any topology build —
+    # the launcher's discipline (scripts/run_multihost.py)
+    acct = check_hbm_budget(
+        scenarios.powerlaw_cfg(n, shard_align=scenarios.POWERLAW_MH_ALIGN),
+        len(devs), what=f"{name} n={n} row-sharded bucketed state")
+
+    t_build = time.perf_counter()
+    cfg, tp, topo_rows, subscribed = scenarios.powerlaw_mh_spec(n)
+    bs = init_bucketed_state(cfg, topo_rows(0, n), subscribed=subscribed)
+    build_extra = {
+        "build_wall_s": round(time.perf_counter() - t_build, 2),
+        "build_peak_rss_bytes":
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024,
+    }
+    deg = np.concatenate([
+        np.asarray((np.asarray(e.neighbors) >= 0).sum(axis=1))
+        for e in bs.e])
+    dstats = topology.degree_stats(deg)
+
+    mesh = make_mesh(devs)
+    run = make_sharded_bucketed_run(mesh, cfg, tp)
+    bs = shard_bucketed_state(bs, mesh, cfg)
+    keys = jax.random.split(jax.random.PRNGKey(0), 1 + repeats)
+    bs = run(bs, jax.random.split(keys[0], ticks))
+    np.asarray(bs.g.tick)
+    rtt = _fetch_rtt()
+    rates = []
+    for k in keys[1:]:
+        t0 = time.perf_counter()
+        bs = run(bs, jax.random.split(k, ticks))
+        np.asarray(bs.g.tick)
+        raw = time.perf_counter() - t0
+        dt = max(raw - rtt, raw * 0.05)
+        rates.append(ticks / dt)
+    hbps = statistics.median(rates)
+
+    dec = decode_bucketed(bs, cfg)
+    flags = int(np.asarray(dec.g.fault_flags))
+    platform = jax.devices()[0].platform
+    line = json.dumps({
+        "metric": f"network_heartbeats_per_sec@{_label(name)}[{platform}]",
+        "value": round(hbps, 2),
+        "unit": "heartbeats/s",
+        "platform": platform,
+        "vs_baseline": round(hbps / TARGET_HBPS, 4),
+        "min": round(min(rates), 2),
+        "max": round(max(rates), 2),
+        "repeats": repeats,
+        "ticks_per_window": ticks,
+        "fetch_rtt_ms": round(rtt * 1e3, 1),
+        "delivery_fraction": round(float(delivery_fraction(dec.g, cfg)), 4),
+        "mean_delivery_latency_ticks": round(
+            float(delivery_latency_ticks(dec.g, cfg)), 3),
+        "n_peers": cfg.n_peers,
+        "n_devices": len(devs),
+        "sharded_route": cfg.sharded_route,
+        "degree_stats": dstats,
+        "degree_buckets": [list(b) for b in cfg.degree_buckets],
+        "bucketed_rng": cfg.bucketed_rng,
+        "state_nbytes_per_shard": acct["per_shard"],
+        "bucket_shards": acct["bucket_shards"],
+        "fault_flags": flags,
+        "fault_flag_names": decode_flags(flags),
+        "resolved": resolved_formulations(cfg),
+        **_memory_record(cfg),
+        **build_extra,
+    })
+    print(line, flush=True)
+    return line
+
+
 def run_scenario(name: str) -> str | None:
     from go_libp2p_pubsub_tpu.sim import scenarios
 
@@ -725,6 +827,11 @@ def run_scenario(name: str) -> str | None:
         # (sim/bucketed.bucketed_run); the kernel-mode sweep knobs don't
         # apply — per-edge seams resolve per bucket
         return bench_bucketed(name, ticks, repeats)
+
+    if name in POWERLAW_MH_FULL_N:
+        # the row-sharded bucketed family (ISSUE 16) rides the SHARDED
+        # execution path over the local device mesh
+        return bench_bucketed_mh(name, ticks, repeats)
 
     if name == "fleet_256x1k":
         # the batched-fleet line rides its own measurement path (aggregate
@@ -801,7 +908,7 @@ def run_scenario(name: str) -> str | None:
     assert set(builders) | {"fleet_256x1k", "telemetry_1k",
                             "telemetry_10k", "supervised_overlap_1k",
                             "supervised_overlap_10k"} \
-        | set(POWERLAW_FULL_N) == set(NAMES), \
+        | set(POWERLAW_FULL_N) | set(POWERLAW_MH_FULL_N) == set(NAMES), \
         "scenario registry drifted from NAMES"
     assert FRONTIER_FULL_N == scenarios.FRONTIER_NS, \
         "bench FRONTIER_FULL_N drifted from scenarios.FRONTIER_NS"
@@ -915,7 +1022,19 @@ ATTACK_FULL_N = {"eclipse_50k": 50_000, "flashcrowd_50k": 50_000}
 # duplicate of sim/scenarios.POWERLAW_NS (run_scenario asserts sync for
 # the scenario pair); heavytail_eclipse rides the 100k graph
 POWERLAW_FULL_N = {"powerlaw_100k": 131_072, "powerlaw_1m": 1_048_576,
+                   "powerlaw_10m": 10_485_760,
                    "heavytail_eclipse": 131_072}
+
+# row-sharded bucketed family (ISSUE 16) — the _mh lines measure the
+# SHARDED bucketed execution path (parallel/sharding.
+# make_sharded_bucketed_run) over the local device mesh, the same
+# compiled unit scripts/run_multihost.py --engine bucketed dispatches
+# per process. Parent-safe like POWERLAW_FULL_N; capped runs are
+# labeled by what ran. Capped N must stay a multiple of
+# scenarios.POWERLAW_MH_ALIGN (64) — the aligned partition is the
+# point of the family.
+POWERLAW_MH_FULL_N = {"powerlaw_100k_mh": 131_072,
+                      "powerlaw_10m_mh": 10_485_760}
 
 
 def _label(name: str) -> str:
@@ -945,6 +1064,11 @@ def _label(name: str) -> str:
     if name in POWERLAW_FULL_N:
         # same capped-label discipline for the heavy-tail family
         full = POWERLAW_FULL_N[name]
+        n = _cap_peers(full)
+        return name if n == full else f"{name}_capped_{n // 1000}k"
+    if name in POWERLAW_MH_FULL_N:
+        # same capped-label discipline for the row-sharded bucketed family
+        full = POWERLAW_MH_FULL_N[name]
         n = _cap_peers(full)
         return name if n == full else f"{name}_capped_{n // 1000}k"
     if name in OVERLAP_FULL_N:
@@ -1145,6 +1269,17 @@ def main() -> None:
             attempts += 1
             env = dict(os.environ, BENCH_SCENARIOS=name, BENCH_IN_PROC="1",
                        **fallback_env, **budget_env)
+            if name in POWERLAW_MH_FULL_N:
+                # the row-sharded bucketed line needs a real mesh: on a
+                # CPU host, force 8 virtual devices (8 divides the
+                # POWERLAW_MH_ALIGN=64 bucket alignment, so every bucket
+                # row-splits evenly; a TPU backend ignores this flag —
+                # it sizes only the cpu platform)
+                flags = env.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    env["XLA_FLAGS"] = (
+                        flags + " --xla_force_host_platform_device_count"
+                        "=8").strip()
             if name == "fleet_256x1k":
                 # fleet lanes map onto local devices (sim/fleet.py
                 # shard_fleet): on a multi-core CPU host, force a host

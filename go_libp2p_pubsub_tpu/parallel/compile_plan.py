@@ -145,6 +145,12 @@ def _sharded_prelude(mesh, cfg: SimConfig, tp: TopicParams):
     if cfg.sharded_route not in ("replicated", "halo"):
         raise ValueError(f"unknown sharded_route {cfg.sharded_route!r}; "
                          "expected 'replicated' or 'halo'")
+    if cfg.degree_buckets is not None:
+        raise ValueError(
+            "sharded dense plan: cfg.degree_buckets is set — heavy-tailed "
+            "configs take the row-sharded bucketed plane (parallel/"
+            "sharding.make_sharded_bucketed_run / compile_plan."
+            "bucketed_chunk_plan), not the dense-padded step")
     shardings = state_shardings(mesh, cfg)
     repl = NamedSharding(mesh, P())
     tp_sh = jax.tree.map(lambda _: repl, tp)
@@ -251,6 +257,61 @@ def sharded_chunk_plan(mesh, cfg: SimConfig, tp: TopicParams,
     sharded_run_keys.lower = lambda st, keys: _run.lower(
         st, tp, jax.device_put(keys, repl))
     return sharded_run_keys
+
+
+def bucketed_chunk_plan(mesh, cfg: SimConfig, tp: TopicParams,
+                        telemetry: bool = False, donate: bool = False):
+    """jit a whole chunk of the DEGREE-BUCKETED step — ``lax.scan`` of
+    ``sim.bucketed.bucketed_step`` with every bucket's edge planes
+    row-sharded over the mesh (parallel/sharding.bucketed_state_shardings)
+    and the flat reverse-edge exchange riding
+    ``parallel.halo.route_bucketed_flat`` under ``sharded_route="halo"``.
+    Same key discipline as ``sharded_chunk_plan``: the caller pre-splits
+    one master key and scans contiguous windows, so the chunked sharded
+    bucketed trajectory is bit-identical to the single-scan one (and,
+    under ``bucketed_rng="dense"``, to the dense engine's)."""
+    from ..sim.bucketed import bucketed_step, check_bucketable
+    from .kernel_context import kernel_mesh
+    from .sharding import DCN_AXIS, PEER_AXIS, bucketed_state_shardings
+
+    check_bucketable(cfg)
+    if telemetry:
+        raise ValueError(
+            "bucketed_chunk_plan: telemetry is not bucketed — "
+            "sim/telemetry.health_record reads the dense [N, K] planes")
+    if cfg.sharded_route not in ("replicated", "halo"):
+        raise ValueError(f"unknown sharded_route {cfg.sharded_route!r}; "
+                         "expected 'replicated' or 'halo'")
+    shardings = bucketed_state_shardings(mesh, cfg)
+    repl = NamedSharding(mesh, P())
+    tp_sh = jax.tree.map(lambda _: repl, tp)
+    peer_axes = tuple(ax for ax in (DCN_AXIS, PEER_AXIS)
+                      if ax in mesh.axis_names)
+
+    # tp rides as a traced argument, not a closure, for the same AOT/
+    # dispatch-agreement reason documented on sharded_step_plan
+    @partial(jax.jit,
+             in_shardings=(shardings, tp_sh, repl), out_shardings=shardings,
+             donate_argnums=(0,) if donate else ())
+    def _run(state, tp_arg: TopicParams, keys: jax.Array):
+        with kernel_mesh(mesh, peer_axes, route=cfg.sharded_route,
+                         capacity_factor=cfg.halo_capacity_factor,
+                         bucket_capacity=cfg.halo_bucket_capacity):
+            def body(carry, k):
+                return bucketed_step(carry, cfg, tp_arg, k), None
+            out, _ = jax.lax.scan(body, state, keys)
+        return out
+
+    def bucketed_run_keys(state, keys: jax.Array,
+                          tp_arg: TopicParams | None = None):
+        return _run(state, tp if tp_arg is None else tp_arg,
+                    jax.device_put(keys, repl))
+
+    bucketed_run_keys._run = _run
+    _LIVE_STEPS.append(_run)
+    bucketed_run_keys.lower = lambda st, keys: _run.lower(
+        st, tp, jax.device_put(keys, repl))
+    return bucketed_run_keys
 
 
 # ---------------------------------------------------------------------------

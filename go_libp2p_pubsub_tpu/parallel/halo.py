@@ -114,7 +114,8 @@ def required_capacity_factor(neighbors, reverse_slot, n_dev: int) -> int:
     return math.ceil(int(counts.max()) / mean_cap) if mean_cap else 0
 
 
-def required_bucket_capacity(neighbors, reverse_slot, n_dev: int) -> int:
+def required_bucket_capacity(neighbors, reverse_slot, n_dev: int,
+                             buckets=None) -> int:
     """The EXACT worst (src,dst)-device bucket population of this underlay
     on an ``n_dev``-way peer sharding — the degree-aware price, directly
     assignable to ``SimConfig.halo_bucket_capacity``. Where the factor
@@ -124,7 +125,18 @@ def required_bucket_capacity(neighbors, reverse_slot, n_dev: int) -> int:
     ships ``D * max_bucket`` entries per device instead of
     ``D * factor * ceil(Ld/D)`` — for a star-like underlay that is the
     difference between an exact fit and a poisoned run at any factor a
-    config would dare set."""
+    config would dare set.
+
+    With ``buckets`` (a ``cfg.degree_buckets`` partition, every bucket's
+    rows tiling ``n_dev`` — :func:`sim.topology.align_degree_buckets`),
+    the price is for :func:`route_bucketed_flat`'s DEGREE-BUCKETED flat
+    space instead: sources live at each bucket's own K-ceiling and
+    destinations are flat reverse slots in the concatenated ΣD space, so
+    each (src,dst) pair is counted exactly as the row-sharded bucketed
+    exchange routes it. ``n_dev`` is the FULL device count — on a 2-D
+    ``{'dcn', 'peers'}`` mesh the halo all_to_alls over the joint axis
+    tuple, so the joint pair count IS the per-axis worst case (any
+    single-axis slice of a joint bucket is no larger)."""
     nbr = np.asarray(neighbors)
     rks = np.asarray(reverse_slot)
     n, k = nbr.shape
@@ -132,10 +144,39 @@ def required_bucket_capacity(neighbors, reverse_slot, n_dev: int) -> int:
         raise ValueError(
             f"required_bucket_capacity: n_peers={n} must divide evenly "
             f"over n_dev={n_dev} (the peer sharding asserts the same)")
-    nl = n // n_dev
-    valid = (nbr >= 0) & (rks >= 0)
-    src_dev = np.repeat(np.arange(n) // nl, k).reshape(n, k)
-    dest_dev = np.clip(nbr, 0, n - 1) // nl
+    if buckets is None:
+        nl = n // n_dev
+        valid = (nbr >= 0) & (rks >= 0)
+        src_dev = np.repeat(np.arange(n) // nl, k).reshape(n, k)
+        dest_dev = np.clip(nbr, 0, n - 1) // nl
+        pair = (src_dev * n_dev + dest_dev)[valid]
+        counts = np.bincount(pair, minlength=n_dev * n_dev)
+        return int(counts.max()) if counts.size else 0
+    bks = [(int(r), int(kb)) for r, kb in buckets]
+    if sum(r for r, _ in bks) != n:
+        raise ValueError(
+            f"required_bucket_capacity: buckets cover "
+            f"{sum(r for r, _ in bks)} rows but the underlay has {n}")
+    for b, (r, kb) in enumerate(bks):
+        if r % n_dev:
+            raise ValueError(
+                f"required_bucket_capacity: bucket {b} ({r} rows x k_ceil "
+                f"{kb}) does not tile the {n_dev}-device mesh — realign "
+                "the partition with topology.align_degree_buckets")
+    starts = np.cumsum([0] + [r for r, _ in bks])[:-1]
+    kbs = np.array([kb for _, kb in bks], np.int64)
+    nbl = np.array([r // n_dev for r, _ in bks], np.int64)
+    bases = np.cumsum([0] + [r * kb for r, kb in bks])[:-1].astype(np.int64)
+    seg = nbl * kbs
+    rows = np.arange(n)
+    rb = np.searchsorted(starts, rows, side="right") - 1
+    src_dev = ((rows - starts[rb]) // nbl[rb])[:, None]
+    in_width = np.arange(k)[None, :] < kbs[rb][:, None]
+    valid = (nbr >= 0) & (rks >= 0) & in_width
+    jn = np.clip(nbr, 0, n - 1)
+    cb = np.searchsorted(starts, jn, side="right") - 1
+    flat = bases[cb] + (jn - starts[cb]) * kbs[cb] + np.clip(rks, 0, None)
+    dest_dev = (flat - bases[cb]) // seg[cb]
     pair = (src_dev * n_dev + dest_dev)[valid]
     counts = np.bincount(pair, minlength=n_dev * n_dev)
     return int(counts.max()) if counts.size else 0
@@ -264,5 +305,82 @@ def route_payloads_halo(payloads, neighbors, reverse_slot):
         in_specs=[(PEER, None), (PEER, None)] + [(PEER, None)] * n_pl,
         out_specs=[(PEER, None)] * n_pl + [()],
     )(neighbors, reverse_slot, *payloads)
+    note_halo_overflow(res[-1])
+    return list(res[:-1])
+
+
+def route_bucketed_flat(payloads, revs):
+    """Sharded flat reverse-edge exchange for the DEGREE-BUCKETED layout
+    (sim/bucketed._exchange_flat under a kernel mesh): ``payloads[b]`` /
+    ``revs[b]`` are the [Nb, Kb] bucket planes at each bucket's OWN
+    K-ceiling, ``revs`` the flat ΣD-space reverse indices (invalid slots
+    point at themselves). Each device owns every bucket's row slice
+    ``[d*Nb/D, (d+1)*Nb/D)`` and PUSHES its valid slots' payloads to the
+    device owning the reverse slot — the rev involution makes push-to-rev
+    identical to gather-from-rev, so the result is bit-exact against the
+    replicated ``concat + flat[rev]`` while the cross-device traffic is
+    capacity-padded all_to_alls of ~ΣD/D² per device pair at each
+    (src-bucket, dst-bucket) pair's own width: nothing here is sized
+    N·K_max, and nothing all-gathers the ΣD space.
+
+    Ascending flat keys restricted to one device's owned slots ARE that
+    device's bucket-major local order (bucket bases increase, row blocks
+    are contiguous), so the merged [ld] vector slices per bucket at the
+    static segment offsets."""
+    if current_kernel_mesh() is None:
+        raise ValueError("route_bucketed_flat outside a kernel_mesh context")
+    n_dev = peer_shards()
+    shapes = [tuple(int(x) for x in p.shape) for p in payloads]
+    if len({p.dtype for p in payloads}) > 1:
+        raise ValueError(
+            "route_bucketed_flat: all bucket payloads must share one dtype "
+            f"(got {[str(p.dtype) for p in payloads]}) — they concatenate "
+            "into one flat exchange vector")
+    for b, (nb, kb) in enumerate(shapes):
+        if nb % n_dev:
+            raise ValueError(
+                f"route_bucketed_flat: bucket {b} ({nb} rows x k_ceil {kb}) "
+                f"does not tile the {n_dev}-device mesh — realign the "
+                "partition with topology.align_degree_buckets")
+    nbl = [nb // n_dev for nb, _ in shapes]
+    seg = [nl * kb for nl, (_, kb) in zip(nbl, shapes)]
+    ld = sum(seg)
+    bases = np.cumsum([0] + [nb * kb for nb, kb in shapes]).astype(np.int64)
+    if bases[-1] > int(_BIG):
+        raise ValueError(
+            f"route_bucketed_flat: flat edge space of {int(bases[-1])} "
+            "slots exceeds the int32 key range")
+    bases32 = bases[:-1].astype(np.int32)
+    seg32 = np.array(seg, np.int32)
+    axis = _axis_tuple()
+    B = len(payloads)
+
+    def body(*args):
+        pl_l, rv_l = args[:B], args[B:]
+        d = jax.lax.axis_index(axis)
+        own = jnp.concatenate([
+            bases32[b] + d.astype(jnp.int32) * seg32[b]
+            + jnp.arange(seg[b], dtype=jnp.int32)
+            for b in range(B)])
+        keys = jnp.concatenate([r.reshape(-1) for r in rv_l])
+        valid = keys != own
+        jb = jnp.asarray(bases32)
+        js = jnp.asarray(seg32)
+        cbk = jnp.searchsorted(jb, keys, side="right") - 1
+        dest = (keys - jb[cbk]) // js[cbk]
+        vals = [jnp.concatenate([p.reshape(-1) for p in pl_l])]
+        outs, ovf = _route_local(keys, dest, valid, vals, ld, n_dev, axis)
+        flat = outs[0]
+        res, off = [], 0
+        for b in range(B):
+            res.append(flat[off:off + seg[b]].reshape(nbl[b], shapes[b][1]))
+            off += seg[b]
+        return (*res, jax.lax.psum(ovf, axis))
+
+    res = shard_kernel(
+        body,
+        in_specs=[(PEER, None)] * (2 * B),
+        out_specs=[(PEER, None)] * B + [()],
+    )(*payloads, *revs)
     note_halo_overflow(res[-1])
     return list(res[:-1])

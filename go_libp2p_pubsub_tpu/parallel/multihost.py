@@ -188,6 +188,128 @@ def init_state_local(cfg: SimConfig, topo: Topology,
         nbr_subscribed=jnp.asarray(nbr_sub_l), n_rows=nl)
 
 
+def init_bucketed_local(cfg: SimConfig, topo,
+                        process_id: int | None = None,
+                        num_processes: int | None = None,
+                        subscribed: np.ndarray | None = None,
+                        ip_group: np.ndarray | None = None,
+                        app_score: np.ndarray | None = None,
+                        malicious: np.ndarray | None = None):
+    """This process's host-local shard of a DEGREE-BUCKETED state, built
+    WITHOUT the global dense state ever materializing anywhere — the
+    heavy-tailed 10M construction path.
+
+    Two different row sets per process, matching
+    ``parallel.sharding.bucketed_partition_specs``:
+
+    - the global half ``g`` covers the contiguous peer block
+      ``[n0, n0+nl)`` (hosts-major, like :func:`init_state_local`) — built
+      directly at ZERO edge width (``k_slots=0`` through the shared
+      ``_device_init``, whose topology-derived plane widths come from the
+      passed arrays), so no dense [nl, K] slab backs it;
+    - each bucket's edge planes cover that BUCKET's local row window
+      ``[s_b + p*c_b/P, s_b + (p+1)*c_b/P)`` — built one bucket at a time
+      from ``topo(start, count)`` row-window topology (e.g.
+      ``lambda s, c: topology.powerlaw(..., rows=(s, c))``) through
+      ``bucketize_state(rows=...)``, so the transient peak is one
+      bucket's local slab, not the graph.
+
+    ``topo`` is either that callable or a full host-side Topology (sliced
+    per window — the small-N test path). Per-peer inputs are the GLOBAL
+    host-side arrays, exactly as :func:`init_state_local` takes them.
+    Concatenating every process's shards reproduces
+    ``init_bucketed_state`` bit for bit (tests/test_multihost.py)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from ..sim.bucketed import BucketedState, bucketize_state, \
+        check_bucketable, encode_bucketed
+    from ..sim.state import _device_init, decode_state
+    from ..sim.topology import Topology
+
+    check_bucketable(cfg)
+    if num_processes is None:
+        num_processes = jax.process_count()
+    if process_id is None:
+        process_id = jax.process_index()
+    n, t = cfg.n_peers, cfg.n_topics
+    n0, nl = local_peer_rows(n, num_processes, process_id)
+
+    if subscribed is None:
+        subscribed = np.ones((n, t), dtype=bool)
+    if ip_group is None:
+        ip_group = np.zeros(n, np.int32)
+    if app_score is None:
+        app_score = np.zeros(n, np.float32)
+    if malicious is None:
+        malicious = np.zeros(n, bool)
+
+    if isinstance(topo, Topology):
+        full_topo = topo
+
+        def topo_rows(start, count):
+            sl = slice(start, start + count)
+            return Topology(neighbors=full_topo.neighbors[sl],
+                            outbound=full_topo.outbound[sl],
+                            reverse_slot=full_topo.reverse_slot[sl],
+                            degree=full_topo.degree[sl])
+    else:
+        topo_rows = topo
+
+    # the global half at zero edge width: _device_init sizes the
+    # topology-derived planes from the passed arrays and the k_slots
+    # zeros planes at width 0, and "f32" makes its encode_state a no-op,
+    # so the result IS the compute-layout g with correctly-typed
+    # zero-width edge placeholders (encode_bucketed below applies the
+    # real codec to the non-edge planes)
+    gcfg = dataclasses.replace(cfg, k_slots=0, degree_buckets=None,
+                               state_precision="f32")
+    rows = slice(n0, n0 + nl)
+    g = _device_init(
+        gcfg,
+        jnp.zeros((nl, 0), jnp.int32), jnp.zeros((nl, 0), bool),
+        jnp.zeros((nl, 0), jnp.int32), jnp.asarray(subscribed[rows]),
+        jnp.asarray(ip_group[rows]), jnp.asarray(app_score[rows]),
+        jnp.asarray(malicious[rows]),
+        nbr_subscribed=jnp.zeros((nl, t, 0), bool), n_rows=nl)
+
+    e, rev = [], []
+    start = 0
+    for b, (c, kb) in enumerate(cfg.degree_buckets):
+        c, kb = int(c), int(kb)
+        if c % num_processes:
+            raise ValueError(
+                f"init_bucketed_local: bucket {b} ({c} rows x k_ceil {kb}) "
+                f"does not split over {num_processes} processes — realign "
+                "the partition with topology.align_degree_buckets")
+        cb = c // num_processes
+        gs = start + process_id * cb
+        tb = topo_rows(gs, cb)
+        if tb.neighbors.shape[0] != cb:
+            raise ValueError(
+                f"init_bucketed_local: topo({gs}, {cb}) returned "
+                f"{tb.neighbors.shape[0]} rows")
+        nbr_l = np.asarray(tb.neighbors)
+        nbr_sub_l = np.transpose(
+            subscribed[np.clip(nbr_l, 0, n - 1)], (0, 2, 1)) \
+            & (nbr_l >= 0)[:, None, :]
+        wrows = slice(gs, gs + cb)
+        slab = _device_init(
+            cfg,
+            jnp.asarray(nbr_l), jnp.asarray(tb.outbound),
+            jnp.asarray(tb.reverse_slot), jnp.asarray(subscribed[wrows]),
+            jnp.asarray(ip_group[wrows]), jnp.asarray(app_score[wrows]),
+            jnp.asarray(malicious[wrows]),
+            nbr_subscribed=jnp.asarray(nbr_sub_l), n_rows=cb)
+        part = bucketize_state(decode_state(slab, cfg), cfg, rows=(gs, cb))
+        e.append(part.e[b])
+        rev.append(part.rev[b])
+        start += c
+    return encode_bucketed(
+        BucketedState(g=g, e=tuple(e), rev=tuple(rev)), cfg)
+
+
 def global_state(local: SimState, mesh, cfg: SimConfig) -> SimState:
     """Assemble per-process host-local shards into ONE global sharded
     SimState on ``mesh`` (peer-major leaves concatenate hosts-major along
@@ -202,17 +324,20 @@ def global_state(local: SimState, mesh, cfg: SimConfig) -> SimState:
         tuple(local), mesh, tuple(specs)))
 
 
-def gather_state(state: SimState) -> SimState:
+def gather_state(state):
     """Host-complete numpy copy of a (possibly multi-process sharded)
-    SimState. COLLECTIVE: every process must call it (it all-gathers the
-    non-addressable shards), but only rank 0 should write the result —
-    the supervisor's ``state_to_host`` hook."""
+    state pytree — SimState and BucketedState alike. COLLECTIVE: every
+    process must call it (it all-gathers the non-addressable shards), but
+    only rank 0 should write the result — the supervisor's
+    ``state_to_host`` hook."""
     from jax.experimental import multihost_utils
     if jax.process_count() == 1:
-        return SimState(*[np.asarray(x) for x in state])
+        return jax.tree.map(np.asarray, state)
     # non-fully-addressable inputs come back fully replicated (tiled is
     # ignored for them — every leaf of a multi-process state is one)
-    return SimState(*multihost_utils.process_allgather(tuple(state)))
+    leaves, tdef = jax.tree.flatten(state)
+    return jax.tree.unflatten(
+        tdef, list(multihost_utils.process_allgather(tuple(leaves))))
 
 
 def local_rows_state(full: SimState, cfg: SimConfig,
@@ -232,3 +357,55 @@ def local_rows_state(full: SimState, cfg: SimConfig,
         f: (np.asarray(getattr(full, f))[n0:n0 + nl]
             if spec[f][2] else np.asarray(getattr(full, f)))
         for f in SimState._fields})
+
+
+def global_bucketed_state(local, mesh, cfg: SimConfig):
+    """Assemble per-process host-local BUCKETED shards
+    (:func:`init_bucketed_local` / :func:`local_bucketed_rows_state`) into
+    one global sharded BucketedState on ``mesh`` with the canonical
+    ``parallel.sharding.bucketed_partition_specs``."""
+    from jax.experimental import multihost_utils
+    from jax.sharding import PartitionSpec as P
+
+    from .sharding import bucketed_partition_specs
+    specs = bucketed_partition_specs(mesh, cfg)
+    leaves, tdef = jax.tree.flatten(local)
+    spec_leaves = jax.tree.leaves(specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+    out = multihost_utils.host_local_array_to_global_array(
+        tuple(leaves), mesh, tuple(spec_leaves))
+    return jax.tree.unflatten(tdef, list(out))
+
+
+def local_bucketed_rows_state(full, cfg: SimConfig,
+                              process_id: int | None = None,
+                              num_processes: int | None = None):
+    """Slice a host-complete BucketedState back to this process's rows —
+    the bucketed resume path, elastic in P: the global half re-slices to
+    the contiguous peer block and every bucket's planes to THAT bucket's
+    local window, so a checkpoint gathered at P restores at any P' that
+    divides the (P-independent) bucket alignment."""
+    from ..sim.bucketed import BucketedState, EdgePlanes
+
+    if num_processes is None:
+        num_processes = jax.process_count()
+    if process_id is None:
+        process_id = jax.process_index()
+    g = local_rows_state(full.g, cfg, process_id=process_id,
+                         num_processes=num_processes)
+    e, rev = [], []
+    for b, (c, kb) in enumerate(cfg.degree_buckets):
+        c, kb = int(c), int(kb)
+        if c % num_processes:
+            raise ValueError(
+                f"local_bucketed_rows_state: bucket {b} ({c} rows x "
+                f"k_ceil {kb}) does not split over {num_processes} "
+                "processes — realign the partition with "
+                "topology.align_degree_buckets")
+        cb = c // num_processes
+        sl = slice(process_id * cb, (process_id + 1) * cb)
+        e.append(EdgePlanes(**{
+            f: np.asarray(getattr(full.e[b], f))[sl]
+            for f in EdgePlanes._fields}))
+        rev.append(np.asarray(full.rev[b])[sl])
+    return BucketedState(g=g, e=tuple(e), rev=tuple(rev))

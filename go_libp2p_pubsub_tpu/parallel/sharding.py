@@ -112,3 +112,70 @@ def make_sharded_run_keys(mesh: Mesh, cfg: SimConfig, tp: TopicParams,
     public factory."""
     from .compile_plan import sharded_chunk_plan
     return sharded_chunk_plan(mesh, cfg, tp, telemetry=telemetry)
+
+
+# ---------------------------------------------------------------------------
+# the row-sharded bucketed plane (heavy-tailed underlays at ΣD cost)
+
+
+def bucketed_partition_specs(mesh: Mesh, cfg: SimConfig):
+    """A BucketedState-shaped pytree of PartitionSpecs: the global half
+    takes the dense state's specs (its zero-width edge placeholders keep
+    the leading N axis, so the peer-major specs still apply leaf for
+    leaf), and every bucket's edge/rev plane shards its OWN leading row
+    axis over the peer mesh axes — each device owns the same row
+    fraction of EVERY degree class, so hub buckets spread over the whole
+    mesh instead of piling onto rank 0."""
+    from ..sim.bucketed import EDGE_FIELDS, BucketedState, EdgePlanes
+    from ..sim.state import state_spec
+
+    peer_axes = (DCN_AXIS, PEER_AXIS) if DCN_AXIS in mesh.axis_names \
+        else PEER_AXIS
+    spec = state_spec(cfg)
+    n_buckets = len(cfg.degree_buckets)
+    edge = EdgePlanes(**{
+        f: P(peer_axes, *([None] * (len(spec[f][0]) - 1)))
+        for f in EDGE_FIELDS})
+    return BucketedState(
+        g=state_partition_specs(mesh, cfg),
+        e=(edge,) * n_buckets,
+        rev=(P(peer_axes, None),) * n_buckets)
+
+
+def bucketed_state_shardings(mesh: Mesh, cfg: SimConfig):
+    """A BucketedState-shaped pytree of NamedShardings. Refuses, by
+    bucket, any degree class whose rows do not tile the mesh — the
+    row-sharded plane needs every bucket aligned
+    (:func:`sim.topology.align_degree_buckets`)."""
+    from ..sim.bucketed import check_bucketable
+
+    check_bucketable(cfg)
+    n_dev = mesh.devices.size
+    for b, (n_rows, kb) in enumerate(cfg.degree_buckets):
+        if int(n_rows) % n_dev:
+            raise ValueError(
+                f"bucketed_state_shardings: bucket {b} ({int(n_rows)} rows "
+                f"x k_ceil {int(kb)}) does not tile the {n_dev}-device "
+                "mesh — realign the partition with "
+                "topology.align_degree_buckets")
+    specs = bucketed_partition_specs(mesh, cfg)
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_bucketed_state(bs, mesh: Mesh, cfg: SimConfig):
+    shardings = bucketed_state_shardings(mesh, cfg)
+    return jax.tree.map(jax.device_put, bs, shardings)
+
+
+def make_sharded_bucketed_run(mesh: Mesh, cfg: SimConfig, tp: TopicParams,
+                              donate: bool = False):
+    """jit a whole chunk of the DEGREE-BUCKETED step with every bucket's
+    rows sharded over the mesh — the heavy-tailed multi-host execution
+    unit (ΣD cost per tick, halo-routed flat exchange, zero N·D_max
+    collectives). Delegates to
+    :func:`parallel.compile_plan.bucketed_chunk_plan`; this name is the
+    public factory ``SupervisorConfig.run_fn`` and
+    ``scripts/run_multihost.py --engine bucketed`` wire through."""
+    from .compile_plan import bucketed_chunk_plan
+    return bucketed_chunk_plan(mesh, cfg, tp, donate=donate)

@@ -117,6 +117,19 @@ class BucketedState(NamedTuple):
     e: tuple            # tuple[EdgePlanes], hubs first
     rev: tuple          # tuple[jnp.ndarray [Nb, Kb] i32]
 
+    # the supervisor/checkpoint plumbing (sim/supervisor.py tick_ref,
+    # checkpoint.fleet_axis, _write_crash_dump) reads `.tick` /
+    # `.fault_flags` off whatever state an engine carries — on the
+    # bucketed layout both live on the global half. Properties, not
+    # fields: pytree flattening and _replace see only (g, e, rev).
+    @property
+    def tick(self):
+        return self.g.tick
+
+    @property
+    def fault_flags(self):
+        return self.g.fault_flags
+
 
 def _buckets(cfg: SimConfig) -> list:
     """cfg.degree_buckets -> [(row_start, n_rows, k_ceil)] hubs first."""
@@ -197,14 +210,19 @@ def _rev_tables(cfg: SimConfig):
     return bks, starts, kbs, bases
 
 
-def _flat_rev(cfg: SimConfig, e: tuple) -> tuple:
+def _flat_rev(cfg: SimConfig, e: tuple, row_offsets=None) -> tuple:
     """Per-bucket [Nb, Kb] flat reverse-edge index into the ΣD space.
 
     For a valid edge (row i of bucket b, slot s) with neighbor j owned by
     bucket c: ``bases[c] + (j - starts[c]) * K_c + reverse_slot``.
     Invalid slots index THEMSELVES, so an exchange returns the slot's own
     payload there — callers mask with the valid-slot predicate exactly as
-    the dense edge_gather_packed does."""
+    the dense edge_gather_packed does.
+
+    ``row_offsets[b]`` declares that ``e[b]`` carries only a row WINDOW of
+    bucket b starting that many rows in (the ``bucketize_state(rows=)``
+    shard-build path): the self indices stay GLOBAL flat positions, so
+    shard-built rev planes concatenate into exactly the full build's."""
     bks, starts, kbs, bases = _rev_tables(cfg)
     n = cfg.n_peers
     j_starts = jnp.asarray(starts)
@@ -214,44 +232,71 @@ def _flat_rev(cfg: SimConfig, e: tuple) -> tuple:
     for b, (s, c, kb) in enumerate(bks):
         nbr = e[b].neighbors
         rsl = e[b].reverse_slot
+        off = 0 if row_offsets is None else int(row_offsets[b])
+        rows = nbr.shape[0]
         valid = (nbr >= 0) & (rsl >= 0)
         nc = jnp.clip(nbr, 0, n - 1)
         cb = jnp.searchsorted(j_starts, nc, side="right") - 1
         flat = j_bases[cb] + (nc - j_starts[cb]) * j_kbs[cb] \
             + jnp.clip(rsl, 0, None)
         own = int(bases[b]) \
-            + jnp.arange(c, dtype=jnp.int32)[:, None] * kb \
+            + (off + jnp.arange(rows, dtype=jnp.int32))[:, None] * kb \
             + jnp.arange(kb, dtype=jnp.int32)[None, :]
         out.append(jnp.where(valid, flat, own).astype(jnp.int32))
     return tuple(out)
 
 
-def bucketize_state(state: SimState, cfg: SimConfig) -> BucketedState:
+def bucketize_state(state: SimState, cfg: SimConfig,
+                    rows: tuple | None = None) -> BucketedState:
     """Split a DECODED (compute-layout) dense SimState into bucket planes.
 
     Slots at or beyond a bucket's ceiling are DROPPED — the topology
     builder guarantees they are empty (checked here when the arrays are
-    concrete; a live edge there would silently vanish otherwise)."""
+    concrete; a live edge there would silently vanish otherwise).
+
+    ``rows=(start, count)`` declares that ``state``'s peer-major planes
+    carry ONLY that contiguous row window of the global id space (a
+    shard build — parallel/multihost.init_bucketed_local): each bucket's
+    planes cover the window's intersection with the bucket (possibly 0
+    rows), and the flat reverse indices stay GLOBAL, so concatenating
+    the shards' buckets row-wise reproduces the full build bit for bit
+    (tests/test_bucketed.py ragged shard-build contract). The global
+    dense state never needs to materialize."""
     check_bucketable(cfg)
     bks = _buckets(cfg)
-    e = []
+    r0 = 0 if rows is None else int(rows[0])
+    rc = cfg.n_peers if rows is None else int(rows[1])
+    if rows is not None and not isinstance(state.neighbors, jax.core.Tracer) \
+            and int(state.neighbors.shape[0]) != rc:
+        raise ValueError(
+            f"bucketize_state: rows={tuple(rows)} declared but the state "
+            f"carries {int(state.neighbors.shape[0])} peer rows")
+    e, offs = [], []
     for s, c, kb in bks:
-        if not isinstance(state.neighbors, jax.core.Tracer):
-            tail = np.asarray(state.neighbors[s:s + c, kb:])
+        lo, hi = max(s, r0), min(s + c, r0 + rc)
+        cnt = max(0, hi - lo)
+        lo = lo if cnt else s                 # empty window: offset 0
+        sl = slice(lo - r0, lo - r0 + cnt)
+        if cnt and not isinstance(state.neighbors, jax.core.Tracer):
+            tail = np.asarray(state.neighbors[sl, kb:])
             if tail.size and not np.all(tail < 0):
                 raise ValueError(
-                    f"bucketize_state: bucket rows [{s}, {s + c}) carry "
+                    f"bucketize_state: bucket rows [{lo}, {lo + cnt}) carry "
                     f"live edges beyond their k_ceil={kb} — the "
                     "degree_buckets partition does not cover this graph")
         planes = {}
         for f in EDGE_FIELDS:
             v = getattr(state, f)
-            planes[f] = v[s:s + c, ..., :kb]
+            planes[f] = v[sl, ..., :kb]
         e.append(EdgePlanes(**planes))
+        offs.append(lo - s)
     e = tuple(e)
     g = state._replace(**{f: getattr(state, f)[..., :0]
                           for f in EDGE_FIELDS})
-    return BucketedState(g=g, e=e, rev=_flat_rev(cfg, e))
+    return BucketedState(g=g, e=e,
+                         rev=_flat_rev(cfg, e,
+                                       row_offsets=None if rows is None
+                                       else offs))
 
 
 _PAD_FILLS = dict(
@@ -398,7 +443,21 @@ def _merge(bs: BucketedState, views: list) -> BucketedState:
 def _exchange_flat(bs: BucketedState, payloads: list) -> list:
     """payloads[b] is [Nb, Kb]; returns each edge's REVERSE edge's
     payload, per bucket. One ΣD-element concat + per-bucket [Nb, Kb]
-    gathers — nothing here is sized N·K_max."""
+    gathers — nothing here is sized N·K_max.
+
+    Under an active kernel mesh with the halo route, the exchange rides
+    :func:`parallel.halo.route_bucketed_flat` instead: each device PUSHES
+    its locally-owned flat slots to the device owning the reverse slot
+    (the rev involution makes push-to-rev == gather-from-rev), so the
+    cross-device traffic is capacity-padded all_to_alls, never a ΣD
+    all-gather. The replicated route keeps the concat+gather below —
+    under GSPMD that all-gathers ΣD elements, not N·K_max."""
+    from ..parallel.kernel_context import current_kernel_mesh
+
+    ctx = current_kernel_mesh()
+    if ctx is not None and ctx.route == "halo":
+        from ..parallel.halo import route_bucketed_flat
+        return route_bucketed_flat(payloads, list(bs.rev))
     flat = jnp.concatenate([p.reshape(-1) for p in payloads])
     return [flat[r] for r in bs.rev]
 
@@ -1324,16 +1383,22 @@ def bucketed_step(bs: BucketedState, cfg: SimConfig, tp: TopicParams,
     RNG consumption site mirror engine.step exactly; under
     ``bucketed_rng="dense"`` the whole tick is bit-exact against a dense
     step on the same graph (tests/test_bucketed.py)."""
-    from ..parallel.kernel_context import current_kernel_mesh
+    from ..parallel.kernel_context import (current_kernel_mesh,
+                                           drain_halo_overflow, peer_shards)
     from .engine import choose_publishers
     from ..ops.propagate import publish
 
-    if current_kernel_mesh() is not None:
-        raise RuntimeError(
-            "bucketed_step does not compose with the sharded kernel mesh "
-            "(halo routing assumes the dense [N, K] planes); shard by "
-            "ROWS at topology build instead (topology.powerlaw rows=...) "
-            "and run one bucketed step per shard")
+    ctx = current_kernel_mesh()
+    if ctx is not None:
+        n_dev = peer_shards()
+        for b, (n_rows, kb) in enumerate(cfg.degree_buckets or ()):
+            if int(n_rows) % n_dev:
+                raise ValueError(
+                    f"bucketed_step under the sharded kernel mesh: bucket "
+                    f"{b} ({int(n_rows)} rows x k_ceil {int(kb)}) does not "
+                    f"tile the {n_dev}-device mesh — realign the partition "
+                    "with topology.align_degree_buckets and drive the step "
+                    "through parallel/sharding.make_sharded_bucketed_run")
     check_bucketable(cfg)
     noise = _mk_noise(cfg)
     bs = decode_bucketed(bs, cfg)
@@ -1374,6 +1439,10 @@ def bucketed_step(bs: BucketedState, cfg: SimConfig, tp: TopicParams,
         bs = _churn_b(bs, cfg, tp, k_churn, scores_all, noise,
                       forbid_up_l=fault.want_down
                       if fault is not None else None)
+    notes = drain_halo_overflow()
+    if notes:
+        bs = bs._replace(g=bs.g._replace(
+            halo_overflow=bs.g.halo_overflow + sum(notes)))
     if cfg.invariant_mode != "off":
         bs = _record_flags_b(bs, cfg,
                              injected=fault.injected

@@ -88,6 +88,37 @@ def _sidecar(path: str) -> str:
     return path + ".fingerprint"
 
 
+def _named_leaves(state) -> list:
+    """``[(name, leaf), ...]`` for a checkpointable state pytree, in the
+    SAME order ``jax.tree.flatten`` yields leaves — so a restore can
+    rebuild any state via ``jax.tree.unflatten``. SimState names its
+    fields; a BucketedState (sim/bucketed.py) names ``g.<field>``, then
+    per bucket ``e<b>.<field>``, then ``rev<b>`` — flat, collision-free
+    npz keys that also make a torn-field error self-describing."""
+    if isinstance(state, SimState):
+        return list(zip(SimState._fields, state))
+    from .bucketed import BucketedState, EdgePlanes
+    if isinstance(state, BucketedState):
+        out = [(f"g.{f}", v) for f, v in zip(SimState._fields, state.g)]
+        for b, ep in enumerate(state.e):
+            out.extend((f"e{b}.{f}", v)
+                       for f, v in zip(EdgePlanes._fields, ep))
+        out.extend((f"rev{b}", r) for b, r in enumerate(state.rev))
+        return out
+    raise TypeError(
+        f"checkpoint: unsupported state type {type(state).__name__}; "
+        "expected SimState or BucketedState")
+
+
+def _bucket_string(cfg) -> str:
+    """Canonical clear-text form of a degree-bucket partition for the
+    sidecar: ``"512x64,512x32,..."`` (rows x k_ceil, hubs first)."""
+    bks = getattr(cfg, "degree_buckets", None)
+    if bks is None:
+        return ""
+    return ",".join(f"{int(r)}x{int(k)}" for r, k in bks)
+
+
 def _replace_path(tmp: str, final: str) -> None:
     """Atomically move ``tmp`` into place at ``final`` (file or dir)."""
     if os.path.isdir(final) and not os.path.islink(final):
@@ -134,15 +165,19 @@ def save(path: str, state: SimState, cfg=None, processes=None) -> None:
     # rank-0-ONLY write discipline (parallel/multihost.py — the state is
     # already gathered host-complete, only the coordinator writes) would
     # deadlock a collective that the other ranks never enter
+    # bucketed states always take the npz branch too: orbax's
+    # StandardCheckpointer round-trips the nested namedtuple as dicts and
+    # the missing-field fallback below is SimState-specific — the flat
+    # _named_leaves npz layout is the bucketed format
     if _HAVE_ORBAX and not path.endswith(".npz") \
-            and jax.process_count() == 1:
+            and jax.process_count() == 1 and isinstance(state, SimState):
         with ocp.StandardCheckpointer() as ckpt:
             ckpt.save(tmp, jax.device_get(state))
         # the context exit waits out any async write; only a fully
         # materialized payload ever reaches the final name
         _replace_path(tmp, path)
     else:
-        arrs = {f: np.asarray(v) for f, v in zip(SimState._fields, state)}
+        arrs = {f: np.asarray(v) for f, v in _named_leaves(state)}
         final = path if path.endswith(".npz") else path + ".npz"
         with open(tmp, "wb") as fh:      # file handle: savez can't rename it
             np.savez_compressed(fh, **arrs)
@@ -165,6 +200,13 @@ def save(path: str, state: SimState, cfg=None, processes=None) -> None:
             precision = getattr(cfg, "state_precision", None)
             if precision is not None:
                 f.write(f"state_precision={precision}\n")
+            # the degree-bucket partition travels in clear: a bucketed
+            # checkpoint's planes only mean anything under the SAME
+            # partition, and an elastic P -> P' resume must be refused BY
+            # NAME when the partitions drifted (restore below)
+            bks = _bucket_string(cfg)
+            if bks:
+                f.write(f"degree_buckets={bks}\n")
             p = jax.process_count() if processes is None else int(processes)
             f.write(f"processes={p}\n")
             f.flush()
@@ -230,6 +272,18 @@ def restore(path: str, like: SimState, cfg=None) -> SimState:
         fleet = fleet_axis(like)
         want = config_fingerprint(cfg, fleet=fleet)
         if stamped != want:
+            saved_bks = meta.get("degree_buckets", "")
+            want_bks = _bucket_string(cfg)
+            if saved_bks != want_bks:
+                def _part(s):
+                    return f"buckets [{s}]" if s else "the dense layout"
+                raise ValueError(
+                    f"checkpoint {path!r} bucket-partition mismatch: saved "
+                    f"under {_part(saved_bks)} but this run expects "
+                    f"{_part(want_bks)} — a bucketed checkpoint only "
+                    "resumes under its own bucket partition (realign with "
+                    "topology.align_degree_buckets BEFORE the first run, "
+                    "not between resumes)")
             saved_fleet = meta.get("fleet")
             if saved_fleet != (None if fleet is None else str(fleet)):
                 def _axis(b):
@@ -254,7 +308,7 @@ def restore(path: str, like: SimState, cfg=None) -> SimState:
                 f"checkpoint {path!r} was saved under a different config "
                 f"(fingerprint {stamped[:12]}… != {want[:12]}…); restoring "
                 "it under this config would silently mis-resume")
-    if _HAVE_ORBAX and os.path.isdir(path):
+    if _HAVE_ORBAX and os.path.isdir(path) and isinstance(like, SimState):
         with ocp.StandardCheckpointer() as ckpt:
             try:
                 try:
@@ -290,9 +344,11 @@ def restore(path: str, like: SimState, cfg=None) -> SimState:
             f"write): {type(e).__name__}: {e}") from e
     # fields added after a checkpoint was written restore from ``like``
     # (new fields carry inert defaults, e.g. provenance buffers at -1);
-    # fields PRESENT must match ``like`` exactly — no silent acceptance
+    # fields PRESENT must match ``like`` exactly — no silent acceptance.
+    # _named_leaves walks ``like`` in jax.tree.flatten order, so the same
+    # loop restores SimState and BucketedState checkpoints alike
     vals = []
-    for f in SimState._fields:
+    for f, want in _named_leaves(like):
         if f in npz.files:
             try:
                 arr = npz[f]
@@ -302,8 +358,8 @@ def restore(path: str, like: SimState, cfg=None) -> SimState:
                 raise CheckpointCorrupt(
                     f"checkpoint {npz_path!r} field {f!r} is unreadable "
                     f"(torn write): {type(e).__name__}: {e}") from e
-            _validate(f, arr, getattr(like, f))
+            _validate(f, arr, want)
             vals.append(jnp.asarray(arr))
         else:
-            vals.append(getattr(like, f))
-    return SimState(*vals)
+            vals.append(want)
+    return jax.tree.unflatten(jax.tree.structure(like), vals)

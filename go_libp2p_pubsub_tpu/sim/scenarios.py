@@ -476,19 +476,32 @@ def outage_small(n_peers: int = 512, k_slots: int = 16, degree: int = 6,
 # BUCKETED_SCENARIOS rather than SCENARIOS (whose generic consumers feed
 # engine.run).
 
-POWERLAW_NS = {"powerlaw_100k": 131_072, "powerlaw_1m": 1_048_576}
+POWERLAW_NS = {"powerlaw_100k": 131_072, "powerlaw_1m": 1_048_576,
+               "powerlaw_10m": 10_485_760}
+
+# Row alignment for MULTI-HOST bucketed runs: every bucket boundary rounds
+# to a multiple of this, so any device/process count dividing it shards
+# every bucket evenly. Deliberately INDEPENDENT of the live process count:
+# the partition feeds the checkpoint fingerprint and the elastic P -> P'
+# resume (sim/supervisor.py) must see the SAME partition at both sizes.
+POWERLAW_MH_ALIGN = 64
 
 
 def powerlaw_cfg(n_peers: int, d_min: int = 8, d_max: int = 64,
                  alpha: float = 2.0, n_topics: int = 2,
                  msg_window: int = 64, state_precision: str = "compact",
-                 bucketed_rng: str = "bucket") -> SimConfig:
+                 bucketed_rng: str = "bucket",
+                 shard_align: int | None = None) -> SimConfig:
     """The heavy-tail SimConfig alone — no topology build. The bucket
     partition is closed-form (topology.powerlaw_buckets), so HBM budget
     gates price the REAL bucketed layout before any underlay
-    construction (the frontier_cfg discipline)."""
+    construction (the frontier_cfg discipline). ``shard_align`` rounds
+    the partition for the row-sharded multi-host plane
+    (topology.align_degree_buckets; pass POWERLAW_MH_ALIGN)."""
     buckets = topology.powerlaw_buckets(n_peers, d_min=d_min, d_max=d_max,
                                         alpha=alpha)
+    if shard_align is not None:
+        buckets = topology.align_degree_buckets(buckets, shard_align)
     return SimConfig(
         n_peers=n_peers, k_slots=buckets[0][1], n_topics=n_topics,
         msg_window=msg_window, publishers_per_tick=16, prop_substeps=8,
@@ -521,6 +534,34 @@ def powerlaw_spec(n_peers: int, d_min: int = 8, d_max: int = 64,
     return cfg, default_topic_params(cfg.n_topics), topo, subscribed
 
 
+def powerlaw_mh_spec(n_peers: int, d_min: int = 8, d_max: int = 64,
+                     alpha: float = 2.0, subnet_fraction: float = 0.3,
+                     **cfg_kw):
+    """Multi-host heavy-tail spec: ``(cfg, tp, topo_rows, subscribed)``
+    where ``topo_rows(start, count)`` builds only those underlay rows
+    (topology.powerlaw is a pure function of row id, so
+    parallel/multihost.init_bucketed_local can call it once per local
+    bucket block and the full graph never materializes on any host). The
+    partition is shard-aligned by default (POWERLAW_MH_ALIGN) so the
+    config fingerprints identically at every process count — the elastic
+    P -> P' resume contract."""
+    cfg_kw.setdefault("shard_align", POWERLAW_MH_ALIGN)
+    cfg = powerlaw_cfg(n_peers, d_min=d_min, d_max=d_max, alpha=alpha,
+                       **cfg_kw)
+    rng = np.random.default_rng(SEED)
+    subscribed = np.zeros((n_peers, cfg.n_topics), dtype=bool)
+    subscribed[:, 0] = True
+    for t in range(1, cfg.n_topics):
+        subscribed[:, t] = rng.random(n_peers) < subnet_fraction
+
+    def topo_rows(start: int, count: int) -> "topology.Topology":
+        return topology.powerlaw(n_peers, cfg.k_slots, d_min=d_min,
+                                 d_max=d_max, alpha=alpha, seed=SEED,
+                                 rows=(start, count))
+
+    return cfg, default_topic_params(cfg.n_topics), topo_rows, subscribed
+
+
 def powerlaw_bucketed(n_peers: int, **kw):
     """Single-process heavy-tail constructor: (cfg, tp, BucketedState)."""
     from . import bucketed
@@ -534,6 +575,18 @@ def powerlaw_100k(n_peers: int = POWERLAW_NS["powerlaw_100k"], **kw):
 
 
 def powerlaw_1m(n_peers: int = POWERLAW_NS["powerlaw_1m"], **kw):
+    return powerlaw_bucketed(n_peers, **kw)
+
+
+def powerlaw_10m(n_peers: int = POWERLAW_NS["powerlaw_10m"], **kw):
+    """The real 10M heavy-tailed mesh — the supervised MULTI-HOST
+    scenario (scripts/run_multihost.py --engine bucketed). The bucket
+    partition carries the shard alignment so any process/device count
+    dividing POWERLAW_MH_ALIGN tiles every bucket; building the state
+    single-process through this constructor works for tests but the
+    launcher builds per-rank shards (parallel/multihost.
+    init_bucketed_local) so the graph never materializes whole."""
+    kw.setdefault("shard_align", POWERLAW_MH_ALIGN)
     return powerlaw_bucketed(n_peers, **kw)
 
 
@@ -567,6 +620,7 @@ def heavytail_eclipse(n_peers: int = POWERLAW_NS["powerlaw_100k"],
 BUCKETED_SCENARIOS = {
     "powerlaw_100k": powerlaw_100k,
     "powerlaw_1m": powerlaw_1m,
+    "powerlaw_10m": powerlaw_10m,
     "heavytail_eclipse": heavytail_eclipse,
 }
 

@@ -411,28 +411,39 @@ def per_peer_byte_ceilings(cfg: SimConfig) -> dict:
     )
 
 
-def bucketed_edge_nbytes(cfg: SimConfig) -> dict:
+def bucketed_edge_nbytes(cfg: SimConfig, per_bucket: bool = False):
     """field -> bytes of each K-axis edge plane under the degree-bucketed
     layout (sim/bucketed.py): the sum over buckets of the SAME field
     priced at the bucket's ``(n_rows, k_ceil)`` — so the codec
     (f32/compact) prices each bucket exactly as state_spec prices a
     dense graph of that shape — plus ``bucket_rev``, the flat int32
-    reverse-index planes the packed exchanges gather through."""
+    reverse-index planes the packed exchanges gather through.
+
+    ``per_bucket=True`` returns the UNsummed list instead: one
+    ``{"rows": n_b, "k_ceil": k_b, <field>: bytes, ..., "bucket_rev": b}``
+    per bucket — what the per-(bucket x shard) HBM gate and the dashboard
+    price from (:func:`state_nbytes`, :func:`check_hbm_budget`)."""
     from .bucketed import EDGE_FIELDS, _buckets, check_bucketable
     check_bucketable(cfg)
     out = {f: 0 for f in EDGE_FIELDS}
     rev = 0
+    buckets = []
     for _, n_b, k_b in _buckets(cfg):
         sub = dataclasses.replace(cfg, n_peers=n_b, k_slots=k_b,
                                   degree_buckets=None)
         sub_spec = state_spec(sub)
+        entry = {"rows": n_b, "k_ceil": k_b}
         for f in EDGE_FIELDS:
             shape, dtype, _ = sub_spec[f]
-            out[f] += int(np.prod(shape, dtype=np.int64)) \
+            nb = int(np.prod(shape, dtype=np.int64)) \
                 * np.dtype(dtype).itemsize
+            out[f] += nb
+            entry[f] = nb
         rev += n_b * k_b * 4
+        entry["bucket_rev"] = n_b * k_b * 4
+        buckets.append(entry)
     out["bucket_rev"] = rev
-    return out
+    return buckets if per_bucket else out
 
 
 def state_nbytes(cfg: SimConfig, n_dev: int | dict = 1) -> dict:
@@ -461,19 +472,36 @@ def state_nbytes(cfg: SimConfig, n_dev: int | dict = 1) -> dict:
         fields[f] = nbytes
         total += nbytes
         per_shard += nbytes // n_dev if peer_major else nbytes
+    bucket_shards = None
     if cfg.degree_buckets is not None:
         # reprice the K-axis planes at the bucketed layout: each edge
         # plane is padded to its bucket's ceiling instead of k_slots, so
-        # resting bytes scale with sum-of-degrees, not N * D_max. All
-        # edge planes are peer-major; bucket row counts need not divide
-        # n_dev evenly, so per-shard prices the ceiling split.
-        for f, nbytes in bucketed_edge_nbytes(cfg).items():
+        # resting bytes scale with sum-of-degrees, not N * D_max. The
+        # row-sharded plane splits EVERY bucket's rows over the mesh
+        # (parallel/sharding.bucketed_partition_specs), so per-shard sums
+        # each (bucket x field) plane's own ceiling split — exact when
+        # the partition is aligned (topology.align_degree_buckets),
+        # a one-row ceiling otherwise.
+        agg = {f: 0 for f in bucketed_edge_nbytes(cfg)}
+        bucket_shards = []
+        for entry in bucketed_edge_nbytes(cfg, per_bucket=True):
+            shard_entry = {"rows": entry["rows"], "k_ceil": entry["k_ceil"]}
+            for f, nb in entry.items():
+                if f in ("rows", "k_ceil"):
+                    continue
+                agg[f] += nb
+                shard_entry[f] = -(-nb // n_dev)
+                per_shard += shard_entry[f]
+            bucket_shards.append(shard_entry)
+        for f, nbytes in agg.items():
             old = fields.get(f, 0)
             fields[f] = nbytes
             total += nbytes - old
-            per_shard += -(-nbytes // n_dev) - old // n_dev
+            per_shard -= old // n_dev
     out = {"total": total, "per_shard": per_shard, "n_dev": n_dev,
            "fields": fields}
+    if bucket_shards is not None:
+        out["bucket_shards"] = bucket_shards
     if mesh is not None:
         out["mesh"] = mesh
     return out
@@ -514,13 +542,29 @@ def check_hbm_budget(cfg: SimConfig, n_dev: int | dict = 1,
         budget = hbm_budget_bytes()
     if budget is None or acct["per_shard"] <= budget:
         return acct
-    spec = state_spec(cfg)
-    # fields absent from the spec (the bucketed layout's synthetic
-    # bucket_rev plane) are peer-major by construction
-    shard_fields = {f: (b // acct["n_dev"]
-                        if f not in spec or spec[f][2] else b)
-                    for f, b in acct["fields"].items()}
-    worst = sorted(shard_fields.items(), key=lambda kv: -kv[1])[:4]
+    if "bucket_shards" in acct:
+        # name the worst (field x bucket) plane: the row-sharded bucketed
+        # plane prices each bucket's rows across the mesh, so the refusal
+        # points at the exact slab to re-partition, not an aggregate.
+        per_bucket = []
+        for b, entry in enumerate(acct["bucket_shards"]):
+            tag = f"b{b} {entry['rows']}x{entry['k_ceil']}"
+            per_bucket += [(f"{f}[{tag}]", nb) for f, nb in entry.items()
+                           if f not in ("rows", "k_ceil")]
+        spec = state_spec(cfg)
+        edge = set(f for e in acct["bucket_shards"] for f in e)
+        per_bucket += [(f, b // acct["n_dev"] if f not in spec or spec[f][2]
+                        else b)
+                       for f, b in acct["fields"].items() if f not in edge]
+        worst = sorted(per_bucket, key=lambda kv: -kv[1])[:4]
+    else:
+        spec = state_spec(cfg)
+        # fields absent from the spec (the bucketed layout's synthetic
+        # bucket_rev plane) are peer-major by construction
+        shard_fields = {f: (b // acct["n_dev"]
+                            if f not in spec or spec[f][2] else b)
+                        for f, b in acct["fields"].items()}
+        worst = sorted(shard_fields.items(), key=lambda kv: -kv[1])[:4]
     names = ", ".join(f"{f}={b / 2 ** 20:.1f}MiB" for f, b in worst)
     raise ValueError(
         f"GRAFT_HBM_BUDGET: {what} prices "

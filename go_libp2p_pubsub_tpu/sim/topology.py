@@ -449,6 +449,42 @@ def powerlaw_buckets(n: int, d_min: int = 8, d_max: int = 64,
     return tuple(out)
 
 
+def align_degree_buckets(buckets, align: int) -> tuple:
+    """``buckets`` (:func:`powerlaw_buckets` output) with every cumulative
+    bucket boundary rounded UP to a multiple of ``align`` — the partition
+    the ROW-SHARDED bucketed plane needs, where every bucket's rows must
+    split evenly over the device mesh (parallel/sharding.
+    bucketed_state_shardings refuses unaligned buckets by name).
+
+    Rounding UP moves boundary rows INTO the earlier — wider — bucket,
+    which is always safe: ceilings are non-increasing hubs-first, so an
+    absorbed row's edges all fit below its new (wider) ceiling; rounding
+    DOWN would orphan high-degree rows under a too-narrow ceiling.
+    Buckets emptied by the move drop out.
+
+    Pick an ``align`` that is INDEPENDENT of the current process count
+    (scenarios.POWERLAW_MH_ALIGN): the partition feeds the checkpoint
+    fingerprint, and an elastic P -> P' resume (sim/supervisor.py) must
+    see the SAME partition at both sizes — any P' dividing ``align``
+    shards the aligned buckets evenly."""
+    bks = tuple((int(r), int(k)) for r, k in buckets)
+    n = sum(r for r, _ in bks)
+    if align <= 0 or n % align:
+        raise ValueError(
+            f"align_degree_buckets: {n} rows do not tile align={align}; "
+            "the id space itself must be a multiple of the alignment")
+    out, prev = [], 0
+    end = 0
+    for r, kb in bks:
+        end += r
+        new_end = min(n, -(-end // align) * align)
+        new_end = max(new_end, prev)             # keep boundaries monotone
+        if new_end > prev:
+            out.append((new_end - prev, kb))
+        prev = new_end
+    return tuple(out)
+
+
 def degree_stats(topo: "Topology | np.ndarray") -> dict:
     """Shape summary of an underlay's degree sequence — stamped into
     bench records and the dashboard header so every banked line states

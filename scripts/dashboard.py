@@ -75,6 +75,14 @@ def _sparkline(vals, width: int = 40) -> str:
                    for v in vals)
 
 
+def _bytes(n) -> str:
+    """Human bytes for the pricing block (GiB/MiB/KiB to one decimal)."""
+    for unit, width in (("GiB", 2 ** 30), ("MiB", 2 ** 20), ("KiB", 2 ** 10)):
+        if n >= width:
+            return f"{n / width:.1f}{unit}"
+    return f"{int(n)}B"
+
+
 def _topic_fracs(row: dict) -> list:
     out = []
     t = 0
@@ -210,6 +218,7 @@ def _snapshot_of(j: dict, path: str) -> dict:
         "paused": any(n.get("kind") == "window_end" for n in current),
     }
     _attach_liveness(snap, run)
+    _attach_launcher(snap, j)
     if not rows:
         return snap
     members = sorted({r.get("member", -1) for r in rows})
@@ -323,6 +332,61 @@ def _attach_liveness(snap: dict, run: dict) -> None:
     snap["mh"] = mh
 
 
+def _attach_launcher(snap: dict, j: dict) -> None:
+    """Multihost-launcher view (scripts/run_multihost.py ``--journal``):
+    the launcher leads its journal with the run header — engine, process
+    and device counts, and for the row-sharded bucketed engine the
+    per-(bucket x shard) byte pricing the HBM gate computed closed-form.
+    The dashboard renders THAT accounting instead of re-deriving a dense
+    [N, K] estimate it can't get right for bucketed layouts. The
+    launcher's metric line (same journal) supplies hb/s and delivery for
+    engines that refuse the health stream (bucketed: sim/telemetry reads
+    the dense planes)."""
+    head = next((n for n in reversed(j["notes"])
+                 if n.get("info") == "multihost run"), None)
+    if head is None:
+        return
+    snap["launcher"] = {k: head.get(k) for k in (
+        "scenario", "engine", "n_peers", "processes", "devices",
+        "topology", "state_precision", "state_nbytes_per_shard",
+        "bucket_shards") if head.get(k) is not None}
+    for k in ("scenario", "n_peers"):
+        if snap["run"].get(k) is None and head.get(k) is not None:
+            snap["run"][k] = head[k]
+    metric = next((n for n in reversed(j["notes"])
+                   if "metric" in n and "hbps" in n), None)
+    if metric is not None:
+        snap["launcher"]["hbps"] = metric.get("hbps")
+        snap["launcher"]["delivery_fraction"] = \
+            metric.get("delivery_fraction")
+        snap["launcher"]["resumed_from"] = metric.get("resumed_from")
+
+
+def _render_launcher(snap: dict, out: list) -> None:
+    la = snap.get("launcher")
+    if not la:
+        return
+    line = f"  engine {la.get('engine', 'dense')}"
+    if la.get("processes"):
+        line += f"   procs {la['processes']}"
+    if la.get("devices"):
+        line += f"   devices {la['devices']}"
+    if la.get("state_nbytes_per_shard") is not None:
+        line += f"   state/shard {_bytes(la['state_nbytes_per_shard'])}"
+    out.append(line)
+    for b, e in enumerate(la.get("bucket_shards") or []):
+        per = sum(v for k, v in e.items() if k not in ("rows", "k_ceil"))
+        out.append(f"    bucket b{b} {e['rows']}x{e['k_ceil']}: "
+                   f"{_bytes(per)}/shard")
+    if la.get("hbps") is not None:
+        line = f"  launcher hb/s {la['hbps']}"
+        if la.get("delivery_fraction") is not None:
+            line += f"   delivery {la['delivery_fraction']}"
+        if la.get("resumed_from") is not None:
+            line += f"   resumed@{la['resumed_from']}"
+        out.append(line)
+
+
 def _attach_attacks(snap: dict, run: dict, rows: list) -> None:
     """Attack-scenario view (ISSUE 10): the run header stamps its
     ``attack_windows`` schedule (sim/telemetry.py header) and optionally
@@ -411,6 +475,7 @@ def render(snap: dict) -> str:
         # rank-liveness block: a rank that dies during init/compile is
         # exactly the DEAD-RANK-banner case
         out.append("  (no health rows yet)")
+        _render_launcher(snap, out)
         _render_mh(snap, out)
         for c in snap.get("crashes", []):
             out.append(f"  CRASH @ tick {c.get('tick')}: {c.get('error')}")
@@ -481,6 +546,7 @@ def render(snap: dict) -> str:
     if snap.get("checkpoints"):
         out.append("  checkpoints @ " + ", ".join(
             str(t) for t in snap["checkpoints"][-4:]))
+    _render_launcher(snap, out)
     _render_mh(snap, out)
     for c in snap.get("crashes", []):
         out.append(f"  CRASH @ tick {c.get('tick')}: {c.get('error')}")

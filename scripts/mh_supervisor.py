@@ -143,6 +143,10 @@ def _launch_rank(args, rank: int, procs: int, coordinator: str,
            "--checkpoint-dir", os.path.join(run_dir, "ckpt")]
     if args.n:
         cmd += ["--n", str(args.n)]
+    if args.engine:
+        cmd += ["--engine", args.engine]
+    if args.bucketed_rng:
+        cmd += ["--bucketed-rng", args.bucketed_rng]
     if args.topology:
         cmd += ["--topology", args.topology]
     if args.chunk_ticks:
@@ -197,6 +201,14 @@ def main() -> int:
                          "at 8 then elastically finishes at 4")
     ap.add_argument("--scenario", default="frontier_250k")
     ap.add_argument("--n", type=int, default=None)
+    ap.add_argument("--engine", default=None,
+                    choices=["dense", "bucketed"],
+                    help="forwarded to every rank (run_multihost.py "
+                         "--engine): bucketed drives the powerlaw family "
+                         "on the row-sharded degree-bucketed step")
+    ap.add_argument("--bucketed-rng", default=None,
+                    choices=["bucket", "dense"],
+                    help="forwarded to every rank (run_multihost.py)")
     ap.add_argument("--topology", default=None,
                     choices=[None, "replicated", "sharded"])
     ap.add_argument("--ticks", type=int, default=100)

@@ -29,6 +29,17 @@ CPU smoke (localhost, two terminals or a driver spawning both):
     JAX_PLATFORMS=cpu python scripts/run_multihost.py \
         --coordinator localhost:9911 --num-processes 2 --process-id <r> \
         --scenario frontier_250k --n 128 --ticks 4 --dump-state /tmp/out.npz
+
+Heavy-tailed (degree-bucketed) engine — the powerlaw family rides the
+row-sharded bucketed step (parallel/sharding.make_sharded_bucketed_run):
+every bucket's rows split across the (dcn x peers) mesh, each rank builds
+only its own bucket blocks (parallel/multihost.init_bucketed_local), and
+GRAFT_HBM_BUDGET prices the closed-form partition per (bucket x shard)
+before any underlay row is constructed:
+
+    GRAFT_HBM_BUDGET=16GiB python scripts/run_multihost.py \
+        --engine bucketed --scenario powerlaw_10m --topology sharded \
+        --ticks 600 --checkpoint-dir /shared/ckpt
 """
 
 import argparse
@@ -48,7 +59,17 @@ def main() -> None:
     ap.add_argument("--process-id", type=int, default=None)
     ap.add_argument("--scenario", default="frontier_250k",
                     help="frontier family member "
-                         "(frontier_250k/500k/1m/4m/10m)")
+                         "(frontier_250k/500k/1m/4m/10m), or with "
+                         "--engine bucketed a powerlaw family member "
+                         "(powerlaw_100k/1m/10m)")
+    ap.add_argument("--engine", default="dense",
+                    choices=["dense", "bucketed"],
+                    help="dense: the uniform-degree [N, K] sharded step "
+                         "(frontier family). bucketed: the degree-"
+                         "bucketed row-sharded step (powerlaw family) — "
+                         "every bucket's rows split across the mesh, "
+                         "per-tick cost and HBM scale with "
+                         "sum-of-degrees instead of N * D_max")
     ap.add_argument("--n", type=int, default=None,
                     help="peer-count override (smoke runs)")
     ap.add_argument("--topology", default="replicated",
@@ -61,6 +82,13 @@ def main() -> None:
                          "underlay (topology.sparse_hash — mandatory at "
                          "10M, where the global table alone is ~2.7 GiB "
                          "of host RAM per process)")
+    ap.add_argument("--bucketed-rng", default=None,
+                    choices=["bucket", "dense"],
+                    help="--engine bucketed only: per-edge RNG layout. "
+                         "'dense' reproduces the dense engine bit for "
+                         "bit (the parity contract); 'bucket' (scenario "
+                         "default) draws at bucket width for "
+                         "sum-of-degrees cost")
     ap.add_argument("--ticks", type=int, default=100)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--chunk-ticks", type=int, default=None)
@@ -119,7 +147,7 @@ def main() -> None:
     import numpy as np
 
     from go_libp2p_pubsub_tpu.parallel.sharding import (
-        make_mesh_2d, make_sharded_run_keys)
+        make_mesh_2d, make_sharded_bucketed_run, make_sharded_run_keys)
     from go_libp2p_pubsub_tpu.sim import scenarios
     from go_libp2p_pubsub_tpu.sim.state import check_hbm_budget
     from go_libp2p_pubsub_tpu.sim.supervisor import (
@@ -129,22 +157,47 @@ def main() -> None:
     rank = jax.process_index()
     coord = multihost.is_coordinator()
 
-    if not args.scenario.startswith("frontier"):
-        raise SystemExit(
-            f"--scenario {args.scenario!r}: the multihost launcher drives "
-            "the frontier family (frontier_250k/500k/1m/4m/10m), whose "
-            "spec-level constructor builds host-local shards; other "
-            "scenarios construct full device states")
-    n = args.n or scenarios.FRONTIER_NS[args.scenario]
-    # XL scenarios run compact by construction (scenarios.frontier_4m/_10m);
-    # the spec path takes the precision explicitly
-    precision = "compact" if args.scenario in (
-        "frontier_4m", "frontier_10m") else "f32"
+    bucketed = args.engine == "bucketed"
     sharded_topo = args.topology == "sharded"
-    trows = multihost.local_peer_rows(n, n_proc, rank) if sharded_topo \
-        else None
-    cfg, tp, topo, subscribed = scenarios.frontier_spec(
-        n, state_precision=precision, rows=trows)
+    if bucketed:
+        if args.scenario not in scenarios.POWERLAW_NS:
+            raise SystemExit(
+                f"--engine bucketed --scenario {args.scenario!r}: the "
+                "bucketed engine drives the powerlaw family "
+                "(powerlaw_100k/1m/10m) — the frontier family is "
+                "uniform-degree and takes the dense engine")
+        n = args.n or scenarios.POWERLAW_NS[args.scenario]
+        # topo_rows is a pure function of row id: the sharded topology
+        # builds ONLY each rank's bucket blocks (init_bucketed_local);
+        # replicated materializes the full underlay on every host first
+        spec_kw = ({"bucketed_rng": args.bucketed_rng}
+                   if args.bucketed_rng else {})
+        cfg, tp, topo_rows, subscribed = scenarios.powerlaw_mh_spec(
+            n, **spec_kw)
+        # defer the (possibly full-graph) build until the HBM gate below
+        # has priced the closed-form partition — a 10M launch over budget
+        # refuses before a single underlay row is constructed
+        topo = topo_rows
+    else:
+        if args.bucketed_rng:
+            raise SystemExit("--bucketed-rng requires --engine bucketed")
+        if not args.scenario.startswith("frontier"):
+            raise SystemExit(
+                f"--scenario {args.scenario!r}: the multihost launcher "
+                "drives the frontier family (frontier_250k/500k/1m/4m/"
+                "10m) on the dense engine and the powerlaw family "
+                "(powerlaw_100k/1m/10m) under --engine bucketed; other "
+                "scenarios construct full device states")
+        n = args.n or scenarios.FRONTIER_NS[args.scenario]
+        # XL scenarios run compact by construction
+        # (scenarios.frontier_4m/_10m); the spec path takes the
+        # precision explicitly
+        precision = "compact" if args.scenario in (
+            "frontier_4m", "frontier_10m") else "f32"
+        trows = multihost.local_peer_rows(n, n_proc, rank) if sharded_topo \
+            else None
+        cfg, tp, topo, subscribed = scenarios.frontier_spec(
+            n, state_precision=precision, rows=trows)
 
     # hosts-major device order so each host's contiguous peer block lands
     # on its own chips (make_mesh_2d layout contract)
@@ -157,18 +210,41 @@ def main() -> None:
     budget = check_hbm_budget(cfg, len(devs),
                               what=f"{args.scenario} state")
     if coord:
-        print(json.dumps({
+        header = {
             "info": "multihost run", "scenario": args.scenario, "n_peers": n,
             "processes": n_proc, "devices": len(devs),
-            "topology": args.topology,
+            "engine": args.engine, "topology": args.topology,
             "state_precision": cfg.state_precision,
             "state_nbytes_total": budget["total"],
-            "state_nbytes_per_shard": budget["per_shard"]}), flush=True)
+            "state_nbytes_per_shard": budget["per_shard"]}
+        if "bucket_shards" in budget:
+            # per-(bucket x shard) pricing for dashboards
+            # (scripts/dashboard.py renders these instead of re-deriving
+            # a dense estimate it can't get right for bucketed layouts)
+            header["bucket_shards"] = budget["bucket_shards"]
+        print(json.dumps(header), flush=True)
+        if args.journal:
+            # the journal leads with the header, so dashboard.py can
+            # render the run's shape and per-(bucket x shard) pricing
+            # without parsing launcher stdout
+            with open(args.journal, "a") as f:
+                f.write(json.dumps(header) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
 
-    local = multihost.init_state_local(cfg, topo, rank, n_proc,
-                                       subscribed=subscribed,
-                                       topo_local=sharded_topo)
-    state = multihost.global_state(local, mesh, cfg)
+    if bucketed:
+        if not sharded_topo:
+            # replicated: the full underlay once per host, sliced per
+            # bucket block by init_bucketed_local
+            topo = topo(0, n)
+        local = multihost.init_bucketed_local(cfg, topo, rank, n_proc,
+                                              subscribed=subscribed)
+        state = multihost.global_bucketed_state(local, mesh, cfg)
+    else:
+        local = multihost.init_state_local(cfg, topo, rank, n_proc,
+                                           subscribed=subscribed,
+                                           topo_local=sharded_topo)
+        state = multihost.global_state(local, mesh, cfg)
 
     # sharded chunk runner: one compiled scan per (exec_cfg, chunk shape),
     # cached so retries and steady-state chunks re-dispatch the same
@@ -177,6 +253,11 @@ def main() -> None:
     # EVERY rank runs the telemetry program (the reduction's collectives
     # are part of it), only rank 0 journals (write_files below)
     health = args.health or os.environ.get("GRAFT_HEALTH_STREAM") or None
+    if bucketed and health:
+        raise SystemExit(
+            "--engine bucketed: the health stream reads the dense [N, K] "
+            "planes (sim/telemetry.health_record) — drop --health/"
+            "GRAFT_HEALTH_STREAM or run the dense engine")
     _runs: dict = {}
 
     def run_fn(st, exec_cfg, tp_arg, keys):
@@ -185,11 +266,20 @@ def main() -> None:
         # argument, so a cached runner can never serve a stale tp
         fn = _runs.get(exec_cfg)
         if fn is None:
-            fn = _runs[exec_cfg] = make_sharded_run_keys(
-                mesh, exec_cfg, tp_arg, telemetry=health is not None)
+            fn = _runs[exec_cfg] = (
+                make_sharded_bucketed_run(mesh, exec_cfg, tp_arg)
+                if bucketed else
+                make_sharded_run_keys(mesh, exec_cfg, tp_arg,
+                                      telemetry=health is not None))
         return fn(st, keys, tp_arg)
 
     def state_from_host(host_state):
+        # the checkpoint restores host-complete; each rank re-slices its
+        # rows at the CURRENT process count (elastic P -> P' resume)
+        if bucketed:
+            loc = multihost.local_bucketed_rows_state(host_state, cfg,
+                                                      rank, n_proc)
+            return multihost.global_bucketed_state(loc, mesh, cfg)
         loc = multihost.local_rows_state(host_state, cfg, rank, n_proc)
         return multihost.global_state(loc, mesh, cfg)
 
@@ -246,15 +336,19 @@ def main() -> None:
         from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction
         from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
         flags = int(np.asarray(host.fault_flags))
+        # delivery census reads only row/message planes — for the
+        # bucketed engine those all live in the g half
+        census = host.g if bucketed else host
         line = {
             "metric": f"multihost_run@{args.scenario}"
                       f"[{jax.devices()[0].platform}x{n_proc}p]",
+            "engine": args.engine,
             "n_peers": n, "ticks": args.ticks, "wall_s": round(wall, 2),
             "hbps": round(args.ticks / max(wall, 1e-9), 3),
             "chunks": report.chunks_run, "retries": report.retries,
             "resumed_from": report.resumed_from,
             "delivery_fraction": round(
-                float(delivery_fraction(host, cfg)), 4),
+                float(delivery_fraction(census, cfg)), 4),
             "fault_flags": flags, "fault_flag_names": decode_flags(flags),
             "state_nbytes_per_shard": budget["per_shard"],
         }
@@ -268,9 +362,9 @@ def main() -> None:
                 f.flush()
                 os.fsync(f.fileno())
         if args.dump_state:
+            from go_libp2p_pubsub_tpu.sim.checkpoint import _named_leaves
             np.savez(args.dump_state,
-                     **{f: np.asarray(v) for f, v in
-                        zip(host._fields, host)})
+                     **{f: np.asarray(v) for f, v in _named_leaves(host)})
     # all ranks exit together (the gather above already synchronized)
 
 

@@ -24,6 +24,7 @@ for step in "supervisor_smoke:python scripts/supervisor_smoke.py" \
             "bench_overlap:env BENCH_SCENARIOS=supervised_overlap_1k,supervised_overlap_10k python bench.py" \
             "bench_attacks:env BENCH_SCENARIOS=eclipse_50k,flashcrowd_50k python bench.py" \
             "bench_powerlaw:env BENCH_SCENARIOS=powerlaw_100k,powerlaw_1m,heavytail_eclipse GRAFT_DEADLINE_S=900 GRAFT_HBM_BUDGET=16GiB python bench.py" \
+            "bench_powerlaw_mh:env BENCH_SCENARIOS=powerlaw_100k_mh,powerlaw_10m_mh GRAFT_DEADLINE_S=900 GRAFT_HBM_BUDGET=16GiB python bench.py" \
             "modes_sort:env GRAFT_EDGE_GATHER=sort BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "modes_mxu:env GRAFT_EDGE_GATHER=mxu BENCH_SCENARIOS=10k_beacon,headline python bench.py" \
             "hop_pallas_mxu:env GRAFT_HOP_MODE=pallas-mxu BENCH_SCENARIOS=10k_beacon,headline python bench.py" \

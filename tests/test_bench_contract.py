@@ -49,15 +49,16 @@ def test_bench_emits_driver_parseable_json():
 
 
 def test_full_suite_fits_budget_at_reduced_n():
-    """All 20 configs at reduced N must complete, rc=0, within
+    """All 26 configs at reduced N must complete, rc=0, within
     BENCH_TOTAL_BUDGET on CPU — the structural guarantee that the r5
     timeout (rc=124, headline line missing) cannot recur. Every metric
     line must be present, the 100k_default headline first AND last.
     GRAFT_FLEET_SIZE=4 keeps the batched-fleet line (ISSUE 7) at
     contract scale; the frontier family (ISSUE 8), the tracing-overhead
-    pair (ISSUE 9), and the attack pair (ISSUE 10) ride the same
-    BENCH_MAX_N cap with capped-N labels — reduced runs can never bank
-    under the full labels."""
+    pair (ISSUE 9), the attack pair (ISSUE 10), the heavy-tail family
+    (ISSUE 15) and the row-sharded bucketed family (ISSUE 16) ride the
+    same BENCH_MAX_N cap with capped-N labels — reduced runs can never
+    bank under the full labels."""
     budget = 900
     res, metrics, _, elapsed = _run_bench({
         "BENCH_N": "256", "BENCH_MAX_N": "256", "BENCH_TICKS": "2",
@@ -66,8 +67,8 @@ def test_full_suite_fits_budget_at_reduced_n():
         timeout=budget + 120)
     assert res.returncode == 0, res.stderr[-500:]
     assert elapsed < budget, f"suite blew the budget: {elapsed:.0f}s"
-    # 20 configs + the headline re-emit
-    assert len(metrics) == 21, [m["metric"] for m in metrics]
+    # 26 configs + the headline re-emit
+    assert len(metrics) == 27, [m["metric"] for m in metrics]
     for m in metrics:
         assert m["value"] > 0, m
         # every record carries the memory accounting (ISSUE 8 satellite)
@@ -85,7 +86,12 @@ def test_full_suite_fits_budget_at_reduced_n():
                      "telemetry_1k_capped_0k", "telemetry_10k_capped_0k",
                      "supervised_overlap_1k_capped_0k",
                      "supervised_overlap_10k_capped_0k",
-                     "eclipse_50k_capped_0k", "flashcrowd_50k_capped_0k"}
+                     "eclipse_50k_capped_0k", "flashcrowd_50k_capped_0k",
+                     "powerlaw_100k_capped_0k", "powerlaw_1m_capped_0k",
+                     "powerlaw_10m_capped_0k",
+                     "heavytail_eclipse_capped_0k",
+                     "powerlaw_100k_mh_capped_0k",
+                     "powerlaw_10m_mh_capped_0k"}
     fleet = next(m for m in metrics if "fleet_4x0k" in m["metric"])
     assert fleet["fleet_size"] == 4
     assert fleet["per_member_hbps"] > 0
@@ -105,6 +111,22 @@ def test_full_suite_fits_budget_at_reduced_n():
     # including the XL frontier pair (compact storage by construction)
     xl = next(m for m in metrics if "frontier_10m" in m["metric"])
     assert xl["build_wall_s"] >= 0 and xl["build_peak_rss_bytes"] > 0
+    # the heavy-tail line (ISSUE 15): the degree shape and bucket
+    # partition travel with every banked number
+    pl = next(m for m in metrics if "powerlaw_100k_capped" in m["metric"])
+    assert pl["degree_stats"]["n"] == 256 and pl["degree_buckets"]
+    # the row-sharded bucketed line (ISSUE 16): the SHARDED execution
+    # path over a real 8-device mesh, with the per-(bucket x shard)
+    # byte accounting dashboards render stamped into the record
+    mh = next(m for m in metrics if "powerlaw_100k_mh" in m["metric"])
+    assert mh["n_devices"] == 8
+    assert mh["state_nbytes_per_shard"] > 0
+    assert mh["degree_stats"]["n"] == 256 and mh["sharded_route"]
+    assert len(mh["bucket_shards"]) == len(mh["degree_buckets"])
+    for entry, (rows, k_ceil) in zip(mh["bucket_shards"],
+                                     mh["degree_buckets"]):
+        assert entry["rows"] == rows and entry["k_ceil"] == k_ceil
+        assert entry["neighbors"] > 0 and entry["bucket_rev"] > 0
 
 
 def test_sigterm_flushes_partial_record():

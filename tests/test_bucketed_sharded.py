@@ -1,0 +1,554 @@
+"""Row-sharded bucketed engine (ISSUE 16): the heavy-tailed mesh across a
+real (dcn x peers) slice.
+
+Lenses, in order of importance:
+
+- **Ragged shard construction** — ``bucketize_state(rows=(start, count))``
+  builds one shard's per-bucket planes directly; shard-concat equals the
+  full build bit for bit, including a short last shard and a shard
+  boundary landing INSIDE a degree bucket.
+- **Bucketed checkpoints** — npz round-trip through the named-leaf layout,
+  bucket-partition mismatch refused BY NAME (a bucketed checkpoint only
+  resumes under its own partition), and the elastic P -> P' re-slice
+  (``local_bucketed_rows_state``) recomposing the gathered state.
+- **Per-(bucket x shard) pricing** — the closed-form ``powerlaw_10m``
+  partition prices under GRAFT_HBM_BUDGET per (bucket x shard) with no
+  topology build, and an over-budget refusal names the worst
+  ``field[b# rowsxk]`` plane.
+- **Refusal by name** — the dense-padded sharded plan refuses bucketed
+  configs pointing at the row-sharded route; unaligned partitions refuse
+  naming ``topology.align_degree_buckets``.
+- **The real multi-process run** (slow tier) — 2 CPU processes over a
+  localhost coordinator drive ``run_multihost.py --engine bucketed``,
+  bit-exact (under ``bucketed_rng="dense"``) against the single-process
+  bucketed AND dense engines; plus the SIGKILL -> relaunch -> P'=1
+  elastic-resume leg under scripts/mh_supervisor.py.
+"""
+
+import dataclasses
+import functools
+import json
+import os
+import re
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from go_libp2p_pubsub_tpu.sim import (SimConfig, init_state, scenarios,
+                                      topology)
+from go_libp2p_pubsub_tpu.sim import bucketed as bk
+from go_libp2p_pubsub_tpu.sim import checkpoint
+from go_libp2p_pubsub_tpu.sim.state import (check_hbm_budget, decode_state,
+                                            state_spec)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N, K = 128, 16
+BUCKETS = topology.powerlaw_buckets(N, d_min=4, d_max=16, alpha=2.0,
+                                    round_to=4)
+NP = 256            # the launcher smoke's peer count (powerlaw family)
+
+
+def _cfg(**over):
+    kw = dict(n_peers=N, k_slots=K, n_topics=2, msg_window=8,
+              publishers_per_tick=2, prop_substeps=4,
+              scoring_enabled=True, gater_enabled=True,
+              churn_disconnect_prob=0.05, churn_reconnect_prob=0.2,
+              state_precision="f32", degree_buckets=BUCKETS,
+              bucketed_rng="dense")
+    kw.update(over)
+    return SimConfig(**kw)
+
+
+@functools.lru_cache(maxsize=None)
+def _dense_decoded():
+    """One decoded full-width dense state every construction lens slices."""
+    cfg = _cfg()
+    topo = topology.powerlaw(N, K, d_min=4, d_max=16, alpha=2.0, seed=11)
+    return cfg, decode_state(init_state(cfg, topo), cfg)
+
+
+def _rows_view(dense, cfg, start, count):
+    """The [start, start+count) row slice of a dense state — what one rank
+    of the sharded construction holds."""
+    spec = state_spec(cfg)
+    return dense._replace(**{
+        f: getattr(dense, f)[start:start + count]
+        for f in dense._fields
+        if getattr(dense, f) is not None and spec[f][2]})
+
+
+def _assert_parts_equal_full(full, parts, cfg):
+    spec = state_spec(cfg)
+    for f in full.g._fields:
+        want = getattr(full.g, f)
+        if want is None:
+            continue
+        want = np.asarray(want)
+        vals = [np.asarray(getattr(p.g, f)) for p in parts]
+        if spec[f][2]:
+            np.testing.assert_array_equal(want, np.concatenate(vals),
+                                          err_msg=f"g.{f}")
+        else:
+            for v in vals:
+                np.testing.assert_array_equal(want, v, err_msg=f"g.{f}")
+    for b in range(len(cfg.degree_buckets)):
+        for f in full.e[b]._fields:
+            want = np.asarray(getattr(full.e[b], f))
+            got = np.concatenate(
+                [np.asarray(getattr(p.e[b], f)) for p in parts])
+            np.testing.assert_array_equal(want, got, err_msg=f"e{b}.{f}")
+        want = np.asarray(full.rev[b])
+        got = np.concatenate([np.asarray(p.rev[b]) for p in parts])
+        np.testing.assert_array_equal(want, got, err_msg=f"rev{b}")
+
+
+class TestRaggedRowsBuild:
+    """bucketize_state(rows=) — the per-rank construction primitive."""
+
+    @pytest.mark.parametrize("n_shards", [2, 4])
+    def test_even_shard_concat_equals_full(self, n_shards):
+        cfg, dense = _dense_decoded()
+        full = bk.bucketize_state(dense, cfg)
+        nl = N // n_shards
+        parts = [bk.bucketize_state(_rows_view(dense, cfg, p * nl, nl),
+                                    cfg, rows=(p * nl, nl))
+                 for p in range(n_shards)]
+        _assert_parts_equal_full(full, parts, cfg)
+
+    def test_short_last_shard(self):
+        """A ragged split whose last shard is shorter than the others."""
+        cfg, dense = _dense_decoded()
+        full = bk.bucketize_state(dense, cfg)
+        splits = [(0, 48), (48, 48), (96, 32)]
+        parts = [bk.bucketize_state(_rows_view(dense, cfg, s, c), cfg,
+                                    rows=(s, c)) for s, c in splits]
+        _assert_parts_equal_full(full, parts, cfg)
+
+    def test_shard_boundary_splits_a_bucket(self):
+        """A shard boundary strictly INSIDE a degree bucket: both sides
+        carry a partial block of that bucket's rows and the concat must
+        still equal the full build (the row_offsets path in _flat_rev)."""
+        cfg, dense = _dense_decoded()
+        starts = np.cumsum([0] + [r for r, _ in BUCKETS])
+        # cut the second bucket in half
+        cut = int(starts[1]) + int(BUCKETS[1][0]) // 2
+        assert starts[1] < cut < starts[2], (starts, cut)
+        splits = [(0, cut), (cut, N - cut)]
+        full = bk.bucketize_state(dense, cfg)
+        parts = [bk.bucketize_state(_rows_view(dense, cfg, s, c), cfg,
+                                    rows=(s, c)) for s, c in splits]
+        _assert_parts_equal_full(full, parts, cfg)
+
+    def test_declared_rows_must_match_state(self):
+        cfg, dense = _dense_decoded()
+        half = _rows_view(dense, cfg, 0, N // 2)
+        with pytest.raises(ValueError, match="rows"):
+            bk.bucketize_state(half, cfg, rows=(0, N))
+
+
+class TestLocalShards:
+    """init_bucketed_local / local_bucketed_rows_state — the multi-host
+    construction and elastic re-slice planes (slow tier: per-bucket
+    device_init compiles)."""
+
+    @pytest.mark.parametrize("n_proc", [2, 4])
+    def test_init_bucketed_local_concat_equals_full(self, n_proc):
+        from go_libp2p_pubsub_tpu.parallel.multihost import (
+            init_bucketed_local, local_bucketed_rows_state)
+        cfg, _ = _dense_decoded()
+        topo = topology.powerlaw(N, K, d_min=4, d_max=16, alpha=2.0,
+                                 seed=11)
+        full = jax.tree.map(np.asarray, bk.init_bucketed_state(cfg, topo))
+        locals_ = [init_bucketed_local(cfg, topo, p, n_proc)
+                   for p in range(n_proc)]
+        for p, loc in enumerate(locals_):
+            want = local_bucketed_rows_state(full, cfg, p, n_proc)
+            for (f, a), (_, b) in zip(checkpoint._named_leaves(want),
+                                      checkpoint._named_leaves(loc)):
+                np.testing.assert_array_equal(
+                    np.asarray(a), np.asarray(b),
+                    err_msg=f"rank {p}/{n_proc} leaf {f}")
+
+
+class TestBucketedCheckpoint:
+    def _host_state(self):
+        cfg, dense = _dense_decoded()
+        return cfg, jax.tree.map(
+            np.asarray, bk.encode_bucketed(bk.bucketize_state(dense, cfg),
+                                           cfg))
+
+    def test_npz_roundtrip(self, tmp_path):
+        cfg, bs = self._host_state()
+        path = str(tmp_path / "ckpt_t0")
+        checkpoint.save(path, bs, cfg=cfg)
+        back = checkpoint.restore(path, bs, cfg=cfg)
+        for (f, a), (_, b) in zip(checkpoint._named_leaves(bs),
+                                  checkpoint._named_leaves(back)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                          err_msg=f"leaf {f}")
+
+    def test_sidecar_stamps_bucket_partition(self, tmp_path):
+        cfg, bs = self._host_state()
+        path = str(tmp_path / "ckpt_t0")
+        checkpoint.save(path, bs, cfg=cfg)
+        meta = checkpoint.sidecar_meta(path)
+        assert meta["degree_buckets"] == ",".join(
+            f"{r}x{k}" for r, k in BUCKETS)
+
+    def test_partition_mismatch_refuses_by_name(self, tmp_path):
+        cfg, bs = self._host_state()
+        path = str(tmp_path / "ckpt_t0")
+        checkpoint.save(path, bs, cfg=cfg)
+        realigned = topology.align_degree_buckets(BUCKETS, 64)
+        assert realigned != BUCKETS      # or the lens is vacuous
+        cfg2 = dataclasses.replace(cfg, degree_buckets=realigned,
+                                   k_slots=realigned[0][1])
+        with pytest.raises(ValueError, match="bucket-partition mismatch"):
+            checkpoint.restore(path, bs, cfg=cfg2)
+
+    def test_dense_checkpoint_refused_for_bucketed_run(self, tmp_path):
+        cfg, dense = _dense_decoded()
+        cfg_d = dataclasses.replace(cfg, degree_buckets=None)
+        from go_libp2p_pubsub_tpu.sim.state import encode_state
+        host = jax.tree.map(np.asarray, encode_state(dense, cfg_d))
+        path = str(tmp_path / "ckpt_t0")
+        checkpoint.save(path, host, cfg=cfg_d)
+        _, bs = self._host_state()
+        with pytest.raises(ValueError, match="bucket-partition mismatch"):
+            checkpoint.restore(path, bs, cfg=cfg)
+
+    @pytest.mark.parametrize("n_proc", [2, 4])
+    def test_elastic_reslice_concat_is_identity(self, n_proc):
+        """local_bucketed_rows_state at P' recomposes the gathered state:
+        per-rank g rows are peer-major contiguous blocks, per-rank bucket
+        rows are that bucket's own split — concatenating every rank's
+        slices reproduces every leaf."""
+        from go_libp2p_pubsub_tpu.parallel.multihost import (
+            local_bucketed_rows_state)
+        cfg, bs = self._host_state()
+        spec = state_spec(cfg)
+        parts = [local_bucketed_rows_state(bs, cfg, p, n_proc)
+                 for p in range(n_proc)]
+        for f in bs.g._fields:
+            want = getattr(bs.g, f)
+            if want is None or not spec[f][2]:
+                continue
+            got = np.concatenate([np.asarray(getattr(p.g, f))
+                                  for p in parts])
+            np.testing.assert_array_equal(np.asarray(want), got,
+                                          err_msg=f"g.{f}")
+        for b in range(len(BUCKETS)):
+            for f in bs.e[b]._fields:
+                got = np.concatenate([np.asarray(getattr(p.e[b], f))
+                                      for p in parts])
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(bs.e[b], f)), got,
+                    err_msg=f"e{b}.{f}")
+            got = np.concatenate([np.asarray(p.rev[b]) for p in parts])
+            np.testing.assert_array_equal(np.asarray(bs.rev[b]), got,
+                                          err_msg=f"rev{b}")
+
+
+class TestBucketShardPricing:
+    def test_powerlaw_10m_prices_per_bucket_shard(self):
+        """The acceptance gate: the closed-form 10M partition prices under
+        16 GiB/shard on an 8-way mesh with NO topology build, and the
+        accounting carries the per-(bucket x shard) rows dashboards and
+        refusals read."""
+        cfg = scenarios.powerlaw_cfg(
+            scenarios.POWERLAW_NS["powerlaw_10m"],
+            shard_align=scenarios.POWERLAW_MH_ALIGN)
+        acct = check_hbm_budget(cfg, 8, budget=16 * 2 ** 30,
+                                what="powerlaw_10m")
+        assert acct["per_shard"] <= 16 * 2 ** 30
+        shards = acct["bucket_shards"]
+        assert len(shards) == len(cfg.degree_buckets)
+        for entry, (r, k) in zip(shards, cfg.degree_buckets):
+            assert entry["rows"] == r and entry["k_ceil"] == k
+            assert r % scenarios.POWERLAW_MH_ALIGN == 0
+        # per-shard is exactly the sum of the per-bucket ceiling splits
+        # plus the g half's row/replicated planes
+        edge = sum(v for e in shards for f, v in e.items()
+                   if f not in ("rows", "k_ceil"))
+        assert edge < acct["per_shard"]
+
+    def test_refusal_names_field_and_bucket(self):
+        cfg = scenarios.powerlaw_cfg(
+            scenarios.POWERLAW_NS["powerlaw_10m"],
+            shard_align=scenarios.POWERLAW_MH_ALIGN)
+        with pytest.raises(ValueError) as ei:
+            check_hbm_budget(cfg, 8, budget=1 << 20, what="powerlaw_10m")
+        msg = str(ei.value)
+        assert "GRAFT_HBM_BUDGET" in msg
+        assert re.search(r"\w+\[b\d+ \d+x\d+\]=", msg), msg
+
+
+class TestShardedRefusals:
+    @pytest.fixture()
+    def mesh8(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices (conftest XLA_FLAGS)")
+        from go_libp2p_pubsub_tpu.parallel.sharding import make_mesh
+        return make_mesh(jax.devices()[:8])
+
+    def test_dense_sharded_plan_refuses_bucketed_cfg(self, mesh8):
+        from go_libp2p_pubsub_tpu.parallel.compile_plan import (
+            sharded_chunk_plan)
+        from go_libp2p_pubsub_tpu.sim.scenarios import default_topic_params
+        cfg = _cfg()
+        with pytest.raises(ValueError, match="row-sharded bucketed"):
+            sharded_chunk_plan(mesh8, cfg, default_topic_params(2))
+
+    def test_unaligned_partition_refuses_naming_the_fix(self, mesh8):
+        from go_libp2p_pubsub_tpu.parallel.sharding import (
+            bucketed_state_shardings)
+        r0, k0 = BUCKETS[0]
+        ragged = ((1, k0), (r0 - 1, k0)) + tuple(BUCKETS[1:])
+        cfg = _cfg(degree_buckets=ragged)
+        with pytest.raises(ValueError, match="align_degree_buckets"):
+            bucketed_state_shardings(mesh8, cfg)
+
+    def test_bucketed_step_guard_under_mesh(self, mesh8):
+        from go_libp2p_pubsub_tpu.parallel.kernel_context import kernel_mesh
+        from go_libp2p_pubsub_tpu.sim.scenarios import default_topic_params
+        _, dense = _dense_decoded()
+        r0, k0 = BUCKETS[0]
+        ragged = ((1, k0), (r0 - 1, k0)) + tuple(BUCKETS[1:])
+        cfg = _cfg(degree_buckets=ragged)
+        bs = bk.bucketize_state(dense, cfg)
+        with kernel_mesh(mesh8, ("peers",)):
+            with pytest.raises(ValueError, match="align_degree_buckets"):
+                bk.bucketed_step(bs, cfg, default_topic_params(2),
+                                 jax.random.PRNGKey(0))
+
+    def test_route_bucketed_flat_needs_a_mesh(self):
+        from go_libp2p_pubsub_tpu.parallel.halo import route_bucketed_flat
+        with pytest.raises(ValueError, match="kernel_mesh"):
+            route_bucketed_flat([np.zeros((8, 4), np.uint32)],
+                                [np.zeros((8, 4), np.int32)])
+
+    def test_align_degree_buckets_contract(self):
+        aligned = topology.align_degree_buckets(BUCKETS, 64)
+        assert sum(r for r, _ in aligned) == N
+        assert all(r % 64 == 0 for r, _ in aligned)
+        ks = [k for _, k in aligned]
+        assert ks == sorted(ks, reverse=True)
+        with pytest.raises(ValueError, match="multiple"):
+            topology.align_degree_buckets(((100, 8),), 64)
+
+
+# ---------------------------------------------------------------------------
+# 8-device sharded execution parity (slow tier; fresh subprocess — the
+# backend multi-mesh poison test_sharding.py documents)
+
+
+def _subprocess(code, timeout=540):
+    from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
+    env = cpu_mesh_env(dict(os.environ), 8)
+    return subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=timeout,
+                          cwd=REPO)
+
+
+def test_sharded_bucketed_routes_bit_exact():
+    """Both sharded routes of the bucketed step on a real 8-device mesh —
+    'replicated' and 'halo' (route_bucketed_flat: per-(src, dst)-bucket
+    push at exact measured capacity) — reproduce the single-device
+    bucketed trajectory bit for bit, with zero halo overflow."""
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from go_libp2p_pubsub_tpu.sim import SimConfig, TopicParams, topology
+from go_libp2p_pubsub_tpu.sim.bucketed import (
+    bucketed_run, init_bucketed_state, densify_state, decode_bucketed)
+from go_libp2p_pubsub_tpu.parallel.sharding import (
+    make_mesh, make_sharded_bucketed_run, shard_bucketed_state)
+from go_libp2p_pubsub_tpu.parallel.halo import required_bucket_capacity
+
+N, K = 128, 16
+bks = topology.powerlaw_buckets(N, d_min=4, d_max=16, alpha=2.0, round_to=4)
+bks = topology.align_degree_buckets(bks, 8)
+topo = topology.powerlaw(N, K, d_min=4, d_max=16, alpha=2.0, seed=11)
+cap = required_bucket_capacity(topo.neighbors, topo.reverse_slot, 8,
+                               buckets=bks)
+kw = dict(n_peers=N, k_slots=K, n_topics=2, msg_window=8,
+          publishers_per_tick=2, prop_substeps=4,
+          scoring_enabled=True, behaviour_penalty_weight=-1.0,
+          gossip_threshold=-10.0, publish_threshold=-20.0,
+          graylist_threshold=-30.0,
+          churn_disconnect_prob=0.05, churn_reconnect_prob=0.2,
+          px_enabled=True, accept_px_threshold=-5.0, retain_score_ticks=10,
+          gater_enabled=True, degree_buckets=bks, bucketed_rng="dense",
+          invariant_mode="record", state_precision="f32")
+tp = TopicParams.disabled(2)
+key = jax.random.PRNGKey(0)
+T = 4
+cfg0 = SimConfig(**kw)
+bs_ref = bucketed_run(init_bucketed_state(cfg0, topo), cfg0, tp, key, T)
+ref = jax.tree.map(np.asarray,
+                   densify_state(decode_bucketed(bs_ref, cfg0), cfg0))
+mesh = make_mesh(jax.devices()[:8])
+for route in ("replicated", "halo"):
+    cfg = SimConfig(**kw, sharded_route=route,
+                    halo_bucket_capacity=cap if route == "halo" else 0)
+    run = make_sharded_bucketed_run(mesh, cfg, tp)
+    bs0 = shard_bucketed_state(init_bucketed_state(cfg, topo), mesh, cfg)
+    out = run(bs0, jax.random.split(key, T))
+    got = jax.tree.map(np.asarray,
+                       densify_state(decode_bucketed(out, cfg), cfg))
+    bad = [f for f in ref._fields
+           if getattr(ref, f) is not None
+           and not np.array_equal(getattr(ref, f), getattr(got, f))]
+    assert not bad, (route, bad)
+    assert int(got.halo_overflow) == 0, int(got.halo_overflow)
+print("BUCKETED_SHARDED_OK")
+"""
+    res = _subprocess(code)
+    assert "BUCKETED_SHARDED_OK" in res.stdout, res.stderr[-3000:]
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance smoke (slow tier): 2 real CPU processes over a localhost
+# coordinator drive the bucketed engine; bit-exact vs single-process
+# bucketed AND dense engines; then the SIGKILL -> relaunch -> P'=1 leg.
+
+
+def _spawn_rank(rank, port, extra, tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env.pop("XLA_FLAGS", None)      # one local CPU device per rank
+    return subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "run_multihost.py"),
+         "--coordinator", f"localhost:{port}", "--num-processes", "2",
+         "--process-id", str(rank), "--engine", "bucketed",
+         "--scenario", "powerlaw_100k", "--n", str(NP), "--seed", "7",
+         "--bucketed-rng", "dense"] + extra,
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=str(tmp_path))
+
+
+def _run_pair(port, extra, tmp_path):
+    procs = [_spawn_rank(r, port, extra, tmp_path) for r in range(2)]
+    outs = [p.communicate(timeout=600) for p in procs]
+    for (out, err), p in zip(outs, procs):
+        assert p.returncode == 0, f"rank rc={p.returncode}\n{err[-3000:]}"
+    return outs
+
+
+@functools.lru_cache(maxsize=None)
+def _mh_reference(ticks):
+    """Single-process bucketed trajectory under the launcher's key
+    discipline (supervised_run pre-splits PRNGKey(seed) into per-tick
+    keys) on the exact powerlaw_mh_spec the launcher builds."""
+    cfg, tp, topo_rows, sub = scenarios.powerlaw_mh_spec(
+        NP, bucketed_rng="dense")
+    topo = topo_rows(0, NP)
+    bs = bk.init_bucketed_state(cfg, topo, subscribed=sub)
+    step = jax.jit(lambda s, k: bk.bucketed_step(s, cfg, tp, k))
+    for k in jax.random.split(jax.random.PRNGKey(7), ticks):
+        bs = step(bs, k)
+    return cfg, tp, topo, sub, jax.block_until_ready(bs)
+
+
+def _assert_dump_matches(dump_path, bs_ref):
+    got = np.load(dump_path)
+    for f, v in checkpoint._named_leaves(bs_ref):
+        np.testing.assert_array_equal(
+            np.asarray(v), got[f],
+            err_msg=f"leaf {f} diverged (multi-process vs single)")
+
+
+def test_two_process_bucketed_bit_exact(tmp_path):
+    """2 real processes, gloo collectives, the row-sharded bucketed step:
+    the gathered final state equals the single-process bucketed scan leaf
+    for leaf, and (bucketed_rng='dense') the dense engine field for field
+    — the layout is an execution strategy, not a model change."""
+    dump = tmp_path / "run1.npz"
+    _run_pair(19931, ["--ticks", "3", "--dump-state", str(dump)], tmp_path)
+    cfg, tp, topo, sub, bs_ref = _mh_reference(3)
+    _assert_dump_matches(dump, bs_ref)
+
+    # the dense engine on the same graph, same keys: bit-exact too
+    from go_libp2p_pubsub_tpu.sim.engine import run_keys
+    cfg_d = dataclasses.replace(cfg, degree_buckets=None)
+    st = init_state(cfg_d, topo, subscribed=sub)
+    out = run_keys(st, cfg_d, tp,
+                   jax.random.split(jax.random.PRNGKey(7), 3))
+    dense = decode_state(jax.block_until_ready(out), cfg_d)
+    buck = bk.densify_state(bk.decode_bucketed(bs_ref, cfg), cfg)
+    for f in dense._fields:
+        a, b = getattr(dense, f), getattr(buck, f)
+        if a is None and b is None:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b),
+            err_msg=f"field {f}: bucketed multi-process vs dense engine")
+
+
+def test_mh_supervisor_bucketed_sigkill_relaunch_elastic(tmp_path):
+    """The resilience acceptance on the BUCKETED plane: rank 1 of a
+    2-process run SIGKILLs itself (GRAFT_CHAOS) after the t=2 bucketed
+    checkpoint drained; the group supervisor relaunches at P'=1, the
+    relaunched rank restores the P=2 bucketed checkpoint through
+    local_bucketed_rows_state (elastic re-slice), and the final state is
+    bit-exact vs the uninterrupted single-process bucketed scan."""
+    run_dir = tmp_path / "mh"
+    final = tmp_path / "final.npz"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               GRAFT_CHAOS="kill@1:4",
+               GRAFT_MH_PEER_TIMEOUT_S="6", GRAFT_MH_ABORT_GRACE_S="3",
+               GRAFT_MH_BEAT_INTERVAL_S="0.5")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "mh_supervisor.py"),
+         "--procs", "2,1", "--engine", "bucketed",
+         "--scenario", "powerlaw_100k", "--n", str(NP),
+         "--bucketed-rng", "dense",
+         "--ticks", "6", "--seed", "7", "--chunk-ticks", "2",
+         "--run-dir", str(run_dir), "--max-relaunches", "2",
+         "--backoff-base-s", "0.05", "--dump-state", str(final)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=560)
+    journal = [json.loads(ln)
+               for ln in (run_dir / "mh_journal.jsonl").read_text()
+               .splitlines()]
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, journal)
+
+    attempts = [r for r in journal if r["kind"] == "mh_attempt"]
+    assert len(attempts) >= 2
+    assert attempts[0]["procs"] == 2 and attempts[-1]["procs"] == 1
+    assert any(r["kind"] == "mh_done" for r in journal)
+
+    # the relaunched rank RESUMED from the bucketed checkpoint
+    last = attempts[-1]["attempt"]
+    rank0_log = (run_dir / f"rank0.attempt{last}.log").read_text()
+    metric = next(json.loads(ln) for ln in rank0_log.splitlines()
+                  if ln.startswith("{") and "\"metric\"" in ln)
+    assert metric["resumed_from"] is not None
+    assert metric["engine"] == "bucketed"
+
+    _, _, _, _, bs_ref = _mh_reference(6)
+    _assert_dump_matches(final, bs_ref)
+
+
+def test_powerlaw_10m_gate_refuses_before_building(tmp_path):
+    """GRAFT_HBM_BUDGET gates the real 10M launch CLOSED-FORM: the refusal
+    lands in seconds (a 10M underlay build would take minutes and the
+    state would OOM first) and names a (field x bucket) plane."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+               GRAFT_HBM_BUDGET="64MiB")
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "run_multihost.py"),
+         "--engine", "bucketed", "--scenario", "powerlaw_10m",
+         "--topology", "sharded", "--ticks", "1"],
+        env=env, capture_output=True, text=True, timeout=240,
+        cwd=str(tmp_path))
+    assert res.returncode != 0
+    assert "GRAFT_HBM_BUDGET" in res.stderr
+    assert re.search(r"\w+\[b\d+ \d+x\d+\]=", res.stderr), \
+        res.stderr[-2000:]

@@ -111,13 +111,15 @@ def test_replicated_control_trips_the_grep(eight_devices):
 
 
 def test_halo_step_within_packed_budget_2d_mesh(eight_devices, tmp_path):
-    """The multihost layout: the SAME packed-budget guard on the 2-D
-    {'dcn': 2, 'peers': 4} make_mesh_2d mesh — the DCN axis must not
-    reintroduce a dense collective (the peer axis shards over both mesh
-    axes, parallel/sharding.state_partition_specs). Runs in a fresh
-    subprocess: a second mesh in one process hits the backend multi-mesh
-    poison test_sharding.py documents; the subprocess dumps the compiled
-    HLO and the grep runs here."""
+    """The multihost layout, EXECUTED: the halo-routed step on the 2-D
+    {'dcn': 2, 'peers': 4} make_mesh_2d mesh (a) runs 3 real ticks that
+    match single-device execution — the DCN axis only changes WHERE
+    shards live, never what they compute — (b) leaves the peer-major
+    state genuinely split into 8 distinct row blocks across BOTH axes,
+    and (c) still fits the packed-budget guard (the dump the grep below
+    reads). Runs in a fresh subprocess: a second mesh in one process
+    hits the backend multi-mesh poison test_sharding.py documents; the
+    subprocess dumps the compiled HLO and the grep runs here."""
     import os
     import subprocess
     import sys
@@ -129,10 +131,12 @@ def test_halo_step_within_packed_budget_2d_mesh(eight_devices, tmp_path):
 import jax
 jax.config.update("jax_platforms", "cpu")
 import sys
+import numpy as np
 sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
 from tests.test_hlo_sharded_budget import _build
 from go_libp2p_pubsub_tpu.parallel.sharding import (
     make_mesh_2d, make_sharded_step, shard_state)
+from go_libp2p_pubsub_tpu.sim.engine import step_jit
 
 cfg, tp, st = _build("halo")
 mesh = make_mesh_2d(2, jax.devices()[:8])
@@ -141,6 +145,22 @@ sharded_step = make_sharded_step(mesh, cfg, tp)
 st_sh = shard_state(st, mesh, cfg)
 text = sharded_step.lower(st_sh, jax.random.PRNGKey(0)).compile().as_text()
 open({str(hlo)!r}, "w").write(text)
+
+st_un = st
+key = jax.random.PRNGKey(43)
+for _ in range(3):
+    key, k = jax.random.split(key)
+    st_sh = sharded_step(st_sh, k)
+    st_un = step_jit(st_un, cfg, tp, k)
+for name, a, b in zip(st_un._fields, st_un, st_sh):
+    np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5,
+        err_msg=f"field {{name}} diverged on the 2-D mesh")
+# the dcn axis is genuinely partitioned: 8 DISTINCT peer-row blocks,
+# one per (dcn, peers) coordinate — not 4 blocks replicated twice
+blocks = {{(s.index[0].start, s.index[0].stop)
+           for s in st_sh.mesh.addressable_shards}}
+assert len(blocks) == 8, sorted(blocks)
 print("HLO_2D_OK")
 """
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -153,3 +173,75 @@ print("HLO_2D_OK")
     assert not bad, (
         f"dense collectives above the packed budget ({BUDGET} words) in "
         f"the 2-D halo-routed step: {bad[:5]}")
+
+
+def test_bucketed_halo_step_within_packed_budget(eight_devices, tmp_path):
+    """The ROW-SHARDED BUCKETED engine's acceptance guard (ISSUE 16): the
+    halo-routed bucketed step at a heavy-tailed partition compiles with NO
+    all-gather/dynamic-slice above the packed budget — every cross-shard
+    exchange rides route_bucketed_flat's capacity-padded (src,dst)-bucket
+    planes at each bucket's OWN K-ceiling, never a dense [N, D_max]
+    gather. Positive control IN THE SAME subprocess/mesh: the dense-padded
+    layout (degree_buckets=None) on the replicated route MUST trip the
+    grep with an >= N*K collective, so a budget loosened by accident can
+    never pass vacuously. Fresh subprocess for the same multi-mesh
+    poison reason as above; both HLO dumps are grepped here."""
+    import os
+    import subprocess
+    import sys
+
+    from go_libp2p_pubsub_tpu.utils.platform_probe import cpu_mesh_env
+
+    hlo_b = tmp_path / "bucketed.hlo"
+    hlo_d = tmp_path / "dense_control.hlo"
+    code = f"""
+import dataclasses
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+from tests.test_hlo_sharded_budget import _build
+from go_libp2p_pubsub_tpu.parallel.halo import required_bucket_capacity
+from go_libp2p_pubsub_tpu.parallel.sharding import (
+    make_mesh, make_sharded_bucketed_run, make_sharded_step,
+    shard_bucketed_state, shard_state)
+from go_libp2p_pubsub_tpu.sim import topology
+from go_libp2p_pubsub_tpu.sim.bucketed import init_bucketed_state
+
+cfg0, tp, _ = _build("halo")
+N, K = cfg0.n_peers, cfg0.k_slots
+bks = topology.powerlaw_buckets(N, d_min=4, d_max=K, alpha=2.0, round_to=8)
+bks = topology.align_degree_buckets(bks, 8)
+topo = topology.powerlaw(N, K, d_min=4, d_max=K, alpha=2.0, seed=11)
+cap = required_bucket_capacity(topo.neighbors, topo.reverse_slot, 8,
+                               buckets=bks)
+cfg = dataclasses.replace(cfg0, degree_buckets=bks, bucketed_rng="bucket",
+                          halo_bucket_capacity=cap, flood_publish=False,
+                          edge_gather_mode="auto")
+mesh = make_mesh(jax.devices()[:8])
+run = make_sharded_bucketed_run(mesh, cfg, tp)
+bs0 = shard_bucketed_state(init_bucketed_state(cfg, topo), mesh, cfg)
+keys = jax.random.split(jax.random.PRNGKey(0), 2)
+open({str(hlo_b)!r}, "w").write(run.lower(bs0, keys).compile().as_text())
+
+cfg_d = dataclasses.replace(cfg0, sharded_route="replicated")
+st = shard_state(_build("replicated")[2], mesh, cfg_d)
+step = make_sharded_step(mesh, cfg_d, tp)
+open({str(hlo_d)!r}, "w").write(
+    step.lower(st, jax.random.PRNGKey(0)).compile().as_text())
+print("HLO_BUCKETED_OK")
+"""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = cpu_mesh_env(dict(os.environ), 8)
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=540,
+                         cwd=repo)
+    assert "HLO_BUCKETED_OK" in res.stdout, res.stderr[-3000:]
+    bad = _dense_collectives(hlo_b.read_text(), BUDGET)
+    assert not bad, (
+        f"dense collectives above the packed budget ({BUDGET} words) in "
+        f"the sharded bucketed chunk: {bad[:5]}")
+    control = _dense_collectives(hlo_d.read_text(), BUDGET)
+    assert control and max(e for e, _ in control) >= N * K, (
+        "control failed: the dense-padded replicated step shows no "
+        "dense collective to the grep")
