@@ -247,17 +247,30 @@ class ChaosPlan:
                                 chunk attempt whose start tick >= TICK
         stall@RANK:TICK:SECS    rank RANK sleeps SECS inside that chunk
                                 attempt (trips the chunk deadline)
+        ingest_stall@TICK:SECS  the rank-0 command-plane reader pauses
+                                SECS at the first boundary drain whose
+                                chunk start >= TICK (the stalled-producer
+                                watchdog trips → coast mode)
+        ingest_kill@TICK        the reader stops for good (a SIGKILLed
+                                producer that never comes back)
 
     Each spec fires ONCE per run directory: the marker file
     ``chaos_<action>_r<rank>_t<tick>.fired`` is written (fsync'd) BEFORE
     the fault, so the relaunched group resumes past the injected fault
     instead of dying to it forever. With ``run_dir=None`` the marker is
     in-memory (once per process). ``fire(info)`` is shaped as
-    ``supervised_run``'s ``_chunk_hook``."""
+    ``supervised_run``'s ``_chunk_hook``; the ``ingest_*`` family fires
+    queue-side instead (``fire_ingest``, called by
+    ``sim/commands.CommandQueue.frame_for`` — ingestion is rank 0's, so
+    the specs pin to rank 0)."""
 
     def __init__(self, specs: list, rank: int, run_dir: str | None = None,
                  kill=None, sleep=time.sleep):
-        self.specs = [s for s in specs if s["rank"] == int(rank)]
+        mine = [s for s in specs if s["rank"] == int(rank)]
+        self.ingest_specs = [s for s in mine
+                             if s["action"].startswith("ingest_")]
+        self.specs = [s for s in mine
+                      if not s["action"].startswith("ingest_")]
         self.rank = int(rank)
         self.run_dir = run_dir
         self._fired: set = set()
@@ -283,13 +296,26 @@ class ChaosPlan:
                                 "tick": int(fields[1]),
                                 "seconds": float(fields[2])})
                     continue
+                # ingest chaos has no RANK field: the command-plane
+                # reader lives on rank 0 by construction
+                if action == "ingest_stall" and len(fields) == 2:
+                    out.append({"action": "ingest_stall", "rank": 0,
+                                "tick": int(fields[0]),
+                                "seconds": float(fields[1])})
+                    continue
+                if action == "ingest_kill" and len(fields) == 1:
+                    out.append({"action": "ingest_kill", "rank": 0,
+                                "tick": int(fields[0]), "seconds": 0.0})
+                    continue
             except ValueError as e:
                 raise ValueError(
                     f"GRAFT_CHAOS entry {part!r}: {e} — expected "
-                    "kill@RANK:TICK or stall@RANK:TICK:SECS") from e
+                    "kill@RANK:TICK, stall@RANK:TICK:SECS, "
+                    "ingest_stall@TICK:SECS or ingest_kill@TICK") from e
             raise ValueError(
-                f"GRAFT_CHAOS entry {part!r}: expected kill@RANK:TICK or "
-                "stall@RANK:TICK:SECS")
+                f"GRAFT_CHAOS entry {part!r}: expected kill@RANK:TICK, "
+                "stall@RANK:TICK:SECS, ingest_stall@TICK:SECS or "
+                "ingest_kill@TICK")
         return out
 
     @classmethod
@@ -338,3 +364,17 @@ class ChaosPlan:
                 self._kill()
             else:
                 self._sleep(spec["seconds"])
+
+    def fire_ingest(self, chunk_start: int, queue) -> None:
+        """The command-plane fire point (``CommandQueue.frame_for``):
+        same once-per-run-dir fsync'd-marker discipline as ``fire``, but
+        the fault lands on the ingest reader thread — pause (the
+        watchdog trips and the run coasts) or permanent stop."""
+        for spec in self.ingest_specs:
+            if chunk_start < spec["tick"] \
+                    or not self._claim(spec, {"chunk_start": chunk_start}):
+                continue
+            if spec["action"] == "ingest_kill":
+                queue.kill_reader()
+            else:
+                queue.pause_reader(spec["seconds"])

@@ -128,7 +128,8 @@ def _replace_path(tmp: str, final: str) -> None:
     os.replace(tmp, final)
 
 
-def save(path: str, state: SimState, cfg=None, processes=None) -> None:
+def save(path: str, state: SimState, cfg=None, processes=None,
+         extra: dict | None = None) -> None:
     """Write a checkpoint directory (orbax) or .npz file (fallback); with
     ``cfg``, stamp its fingerprint in a sidecar for restore to verify.
 
@@ -209,6 +210,12 @@ def save(path: str, state: SimState, cfg=None, processes=None) -> None:
                 f.write(f"degree_buckets={bks}\n")
             p = jax.process_count() if processes is None else int(processes)
             f.write(f"processes={p}\n")
+            # caller-supplied clear lines (sidecar_meta parses any
+            # key=value) — provenance, never a restore refusal. The live
+            # command plane stamps its consumed ``stream_offset`` here:
+            # the exactly-once ingestion cursor a relaunch resumes from
+            for k, v in (extra or {}).items():
+                f.write(f"{k}={v}\n")
             f.flush()
             os.fsync(f.fileno())
         _replace_path(side_tmp, _sidecar(path))

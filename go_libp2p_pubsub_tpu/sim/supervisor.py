@@ -227,6 +227,20 @@ class SupervisorConfig:
     # rank's window cleanly at a chunk boundary (through the multi-process
     # fail-fast crash path) instead of blocking forever in a gather
     liveness: object | None = None
+    # --- live command plane (sim/commands.py) ---
+    # a CommandQueue (or multihost BroadcastCommands): each chunk
+    # dispatch drains one fixed-shape directive frame at the boundary
+    # and injects it through the jitted replay scan before the chunk
+    # runs. The consumed stream offset is stamped into every checkpoint
+    # sidecar (``stream_offset=``) and the queue is start()ed at the
+    # stamped offset on resume — directive application is exactly-once
+    # across SIGKILL→relaunch. Frames are cached per chunk_start, so
+    # retries re-apply the SAME frame to the SAME pre-apply input
+    # (dispatch re-anchors _Pending.src below); speculative-input
+    # donation is disabled while a command plane is attached, because a
+    # donated-input catch-up replays from keys alone and would lose the
+    # injected directives.
+    commands: object | None = None
     # rungs of the degrade ladder applied BEFORE the first chunk. The
     # relaunch supervisor (scripts/mh_supervisor.py) records the agreed
     # rung in its run journal and hands it to every rank via
@@ -768,6 +782,20 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         state, done = _try_resume(sup, cfg, state, start_tick, n_ticks,
                                   report)
 
+    # live command plane: begin tailing at the stamped stream offset —
+    # the exactly-once cursor a resumed run replays ingestion from
+    ingest = sup.commands
+    if ingest is not None:
+        ing_off = 0
+        if report.resumed_from:
+            try:
+                ing_off = int(checkpoint.sidecar_meta(report.resumed_from)
+                              .get("stream_offset") or 0)
+            except Exception:
+                ing_off = 0
+        ingest.start(ing_off)
+        report.log("ingest_start", offset=ing_off)
+
     def beat(tick: int, chunk: int) -> None:
         # liveness progress stamp (parallel/resilience.RankLiveness): a
         # shared-fs hiccup must never fail the run itself — the beater
@@ -837,12 +865,30 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
 
     def dispatch(src, c_done: int, ticks: int, info: dict, donate: bool,
                  hook=_chunk_hook) -> _Pending:
+        anchor_src = src
+        frame = None
+        if ingest is not None and not info.get("catchup"):
+            # boundary drain (cached per chunk_start — a retry gets the
+            # SAME frame) injected through the jitted replay scan with
+            # the BASE cfg as the static key, so the apply compiles once
+            # for the whole run, degrade rungs included
+            frame = ingest.frame_for(start_tick + c_done, ticks)
+            if frame.count:
+                src = ingest.apply(src, cfg, tp, frame)
+            info["directives"] = int(frame.count)
+            info["ingest_frame"] = frame
         keys_chunk = None
         if not (fold and not traced and cfg.invariant_mode != "raise"
                 and sup.run_fn is None):
             keys_chunk = chunk_keys(c_done, c_done + ticks)
-        return _dispatch_chunk(src, exec_cfg, tp, keys_chunk, key, sup,
-                               traced, hook, info, donate=donate)
+        p = _dispatch_chunk(src, exec_cfg, tp, keys_chunk, key, sup,
+                            traced, hook, info, donate=donate)
+        if frame is not None:
+            # retries reset the carry to _Pending.src and re-dispatch,
+            # which re-applies the cached frame — so the recorded input
+            # must be the PRE-apply state or the frame applies twice
+            p.src = anchor_src
+        return p
 
     def handle_failure(e: Exception, info: dict, fail_done: int,
                        this_chunk: int, last_good, good_done: int) -> None:
@@ -921,6 +967,7 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         # chunk before the device ran it — scripts/dashboard.py prefers
         # this field and falls back to wall for old journals)
         done_wall = time.time()
+        fr = p.info.pop("ingest_frame", None)
         failures = 0
         done += p.ticks
         carry, carry_done = p.out, done
@@ -954,6 +1001,19 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
                 writer.submit(lambda: journal.note(
                     "chunk", rows=0, tick_start=t0, ticks=tks,
                     done_wall=done_wall))
+        if journal is not None and fr is not None:
+            # ingest markers ride the writer AFTER the chunk that
+            # carried them confirmed — a discarded speculative chunk's
+            # refusals/stall markers journal when its retry lands, never
+            # twice (the frame cache hands the retry the same notes)
+            for kind, meta in fr.notes:
+                writer.submit(lambda k=kind, m=dict(meta):
+                              journal.note(k, **m))
+            writer.submit(lambda f=fr, t=start_tick + done: journal.note(
+                "ingest", tick=t, directives=f.count, shed=f.shed,
+                shed_total=f.shed_total, refused_total=f.refused_total,
+                queue_depth=f.depth, lag_ticks=f.lag, offset=f.offset,
+                coasting=f.coasting))
         window_end = sup.max_chunks is not None \
             and report.chunks_run >= sup.max_chunks and done < n_ticks
         # a window end is ALWAYS a boundary: the max_chunks contract says
@@ -980,9 +1040,16 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
                 report.checkpoints.append(path)
                 report.log("checkpoint", tick=start_tick + done, path=path)
 
-                def save(to_save=to_save, path=path):
+                # exactly-once stamp: the consumed stream offset as of
+                # THIS chunk's frame rides the sidecar, so a relaunch
+                # replays ingestion from precisely here
+                extra = {"stream_offset": fr.offset} \
+                    if fr is not None else None
+
+                def save(to_save=to_save, path=path, extra=extra):
                     os.makedirs(sup.checkpoint_dir, exist_ok=True)
-                    checkpoint.save(path, to_save, cfg=cfg)  # crash-atomic
+                    checkpoint.save(path, to_save, cfg=cfg,
+                                    extra=extra)  # crash-atomic
                     _prune_checkpoints(sup.checkpoint_dir,
                                        sup.keep_checkpoints)
                 writer.submit(save)
@@ -1076,7 +1143,8 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
                 s_info = {"chunk_start": start_tick + p_end,
                           "chunk_ticks": s_ticks, "attempt": 0,
                           "degrade_level": report.degrade_level}
-                donate = not p_boundary and sup.run_fn is None
+                donate = not p_boundary and sup.run_fn is None \
+                    and sup.commands is None
                 try:
                     spec = dispatch(pend.out, p_end, s_ticks, s_info,
                                     donate=donate)
@@ -1131,11 +1199,21 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
             # retries/degrade_level ride the terminal marker so post-hoc
             # analysis (dashboard, banked-window reports) can see what a
             # number cost without parsing the whole event trail
+            ing_meta = {}
+            if ingest is not None:
+                ing_meta = {
+                    "commands_applied": int(
+                        getattr(ingest, "applied_total", 0)),
+                    "commands_shed": int(getattr(ingest, "shed_total", 0)),
+                    "commands_refused": int(
+                        getattr(ingest, "refused_total", 0)),
+                    "ingest_offset": int(
+                        getattr(ingest, "consumed_offset", 0))}
             writer.submit(lambda: journal.note(
                 "window_end" if done < n_ticks else "run_end",
                 tick=start_tick + done, chunks=report.chunks_run,
                 retries=report.retries,
-                degrade_level=report.degrade_level))
+                degrade_level=report.degrade_level, **ing_meta))
         # drain barrier at window end: every checkpoint is durable and the
         # journal fsync'd before the caller sees the final state (a
         # deferred writer error — failed checkpoint save — raises here,

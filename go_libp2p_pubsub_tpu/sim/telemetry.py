@@ -222,6 +222,17 @@ _INT_COLS = {"tick", "member", "mesh_deg_min", "mesh_deg_max",
              "published_window", "halo_overflow", "fault_flags"}
 
 
+# first-class ingest vitals (the live command plane, sim/commands.py):
+# one ``{"kind": "ingest", ...}`` journal marker per chunk with exactly
+# these fields — the dashboard's ingest rows, bench.py's sustained-rate
+# line, and the contract tests all read this schema, never ad-hoc keys.
+# ``offset`` is the consumed stream byte cursor (the exactly-once resume
+# stamp); ``coasting`` flags the stalled-producer degradation mode
+INGEST_COLUMNS = ("tick", "directives", "shed", "shed_total",
+                  "refused_total", "queue_depth", "lag_ticks", "offset",
+                  "coasting")
+
+
 def health_columns(n_topics: int) -> list:
     """Ordered ``(name, is_int)`` column schema of a journal health row.
     ``member`` is the fleet input index (-1 for an unbatched run);

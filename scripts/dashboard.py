@@ -219,6 +219,7 @@ def _snapshot_of(j: dict, path: str) -> dict:
     }
     _attach_liveness(snap, run)
     _attach_launcher(snap, j)
+    _attach_ingest(snap, current)
     if not rows:
         return snap
     members = sorted({r.get("member", -1) for r in rows})
@@ -428,6 +429,49 @@ def _attach_attacks(snap: dict, run: dict, rows: list) -> None:
                               "detail": f"contract evaluation failed: {e}"}]
 
 
+def _attach_ingest(snap: dict, notes: list) -> None:
+    """Live command plane view (sim/commands.py): the per-chunk
+    ``ingest`` markers carry queue depth, lag, shed and the consumed
+    stream offset (telemetry.INGEST_COLUMNS); an ``ingest_stalled``
+    marker opens a coast episode and carries the producer-restart
+    command the COASTING banner surfaces (the DEAD-RANK pattern)."""
+    last = next((n for n in reversed(notes)
+                 if n.get("kind") == "ingest"), None)
+    if last is None:
+        return
+    ing = {k: last.get(k) for k in
+           ("tick", "directives", "shed", "shed_total", "refused_total",
+            "queue_depth", "lag_ticks", "offset", "coasting")}
+    if ing.get("coasting"):
+        stall = next((n for n in reversed(notes)
+                      if n.get("kind") == "ingest_stalled"), None)
+        if stall is not None:
+            ing["stalled_tick"] = stall.get("tick")
+            ing["source"] = stall.get("source")
+            ing["resume_cmd"] = stall.get("resume_cmd")
+    snap["ingest"] = ing
+
+
+def _render_ingest(snap: dict, out: list) -> None:
+    """The ingest-health block (``_attach_ingest``) — shared by the
+    normal render path and the no-health-rows-yet early return."""
+    ing = snap.get("ingest")
+    if not ing:
+        return
+    out.append(f"  ingest q {ing.get('queue_depth', 0)}"
+               f"   lag {ing.get('lag_ticks', 0)} ticks"
+               f"   shed {ing.get('shed_total', 0)}"
+               f"   refused {ing.get('refused_total', 0)}"
+               f"   offset {ing.get('offset', 0)}")
+    if ing.get("coasting") and not snap.get("done"):
+        out.append(f"  COASTING: directive ingest stalled @ tick "
+                   f"{ing.get('stalled_tick', ing.get('tick'))} — chip "
+                   "stepping with empty frames; restart the producer "
+                   f"from offset {ing.get('offset', 0)}")
+        if ing.get("resume_cmd"):
+            out.append(f"    resume: {ing['resume_cmd']}")
+
+
 def _render_mh(snap: dict, out: list) -> None:
     """The multihost rank-liveness block (``_attach_liveness``) — shared
     by the normal render path and the no-health-rows-yet early return."""
@@ -477,6 +521,7 @@ def render(snap: dict) -> str:
         out.append("  (no health rows yet)")
         _render_launcher(snap, out)
         _render_mh(snap, out)
+        _render_ingest(snap, out)
         for c in snap.get("crashes", []):
             out.append(f"  CRASH @ tick {c.get('tick')}: {c.get('error')}")
             out.append(f"    replay: python scripts/replay_crash.py "
@@ -548,6 +593,7 @@ def render(snap: dict) -> str:
             str(t) for t in snap["checkpoints"][-4:]))
     _render_launcher(snap, out)
     _render_mh(snap, out)
+    _render_ingest(snap, out)
     for c in snap.get("crashes", []):
         out.append(f"  CRASH @ tick {c.get('tick')}: {c.get('error')}")
         out.append(f"    replay: python scripts/replay_crash.py "

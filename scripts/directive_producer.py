@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""External directive producer for the live command plane.
+
+Feeds a run's ``--source`` file (scripts/run_multihost.py /
+sim/commands.CommandQueue) by copying an input directive stream line by
+line, fsync'ing each write — the durability contract the exactly-once
+resume leans on: every byte the consumer's stamped ``stream_offset``
+covers is on disk, so a producer restarted with ``--from-offset`` (the
+offset carried by the run's ``ingest_stalled`` journal marker and the
+dashboard's COASTING banner) resumes the copy without duplicating or
+dropping a single directive.
+
+    # fresh feed at 200 lines/s
+    python scripts/directive_producer.py \
+        --stream workload.ndjsonl --out /shared/live.ndjsonl --rate 200
+
+    # restart after a crash, from the offset the run stamped
+    python scripts/directive_producer.py \
+        --stream workload.ndjsonl --out /shared/live.ndjsonl \
+        --from-offset 18342
+
+``--lines N`` stops the copy after N lines and parks the process
+(SIGKILL fodder for the resilience drills: the run's stalled-producer
+watchdog trips, the run coasts, and the drill restarts the producer from
+the stamped offset). ``--from-offset`` is a byte offset into ``--out``
+mirroring ``--stream`` byte-for-byte — the copy seeks the INPUT to the
+same offset and truncates any torn tail beyond it in the output.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--stream", required=True,
+                    help="input NDJSON directive/trace file to feed from")
+    ap.add_argument("--out", required=True,
+                    help="the run's --source file (appended, fsync'd "
+                         "per line)")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="lines per second (0 = as fast as possible)")
+    ap.add_argument("--from-offset", type=int, default=0,
+                    help="resume the copy at this byte offset (the "
+                         "run's stamped stream_offset)")
+    ap.add_argument("--lines", type=int, default=None,
+                    help="stop after N lines and sleep forever (chaos "
+                         "drills SIGKILL the parked process)")
+    args = ap.parse_args()
+
+    delay = 1.0 / args.rate if args.rate > 0 else 0.0
+    written = 0
+    with open(args.stream, "rb") as src:
+        src.seek(args.from_offset)
+        # byte-mirror discipline: drop any torn/unstamped tail so the
+        # output offset realigns with the input offset exactly
+        with open(args.out, "ab") as dst:
+            if dst.tell() != args.from_offset:
+                dst.truncate(args.from_offset)
+                dst.seek(args.from_offset)
+            for line in src:
+                dst.write(line)
+                dst.flush()
+                os.fsync(dst.fileno())
+                written += 1
+                if args.lines is not None and written >= args.lines:
+                    print(f"[producer] parked after {written} lines at "
+                          f"offset {src.tell()}", flush=True)
+                    while True:
+                        time.sleep(3600)
+                if delay:
+                    time.sleep(delay)
+        end_offset = src.tell()
+    print(f"[producer] done: {written} lines, offset {end_offset}",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

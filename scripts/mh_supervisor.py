@@ -158,6 +158,15 @@ def _launch_rank(args, rank: int, procs: int, coordinator: str,
         # sequences and wedge the group, the exact asymmetry hazard this
         # driver exists to close; write_files keeps the writing on rank 0
         cmd += ["--health", args.health]
+    if args.source:
+        # --source changes the COMPILED program too (the per-boundary
+        # directive frame broadcast + replay apply are collectives every
+        # rank must trace identically), so EVERY rank gets the flag;
+        # only rank 0 actually tails the file
+        cmd += ["--source", args.source,
+                "--directive-slots", str(args.directive_slots),
+                "--ingest-stall-timeout", str(args.ingest_stall_timeout),
+                "--ingest-coast-poll", str(args.ingest_coast_poll)]
     if rank == 0:
         if args.dump_state:
             cmd += ["--dump-state", args.dump_state]
@@ -237,6 +246,15 @@ def main() -> int:
     ap.add_argument("--dump-state", default=None)
     ap.add_argument("--journal", default=None)
     ap.add_argument("--health", default=None)
+    ap.add_argument("--source", default=None,
+                    help="live command plane directive stream, forwarded "
+                         "to every rank (run_multihost.py --source); the "
+                         "checkpoint's stamped stream_offset makes "
+                         "directive ingestion exactly-once across "
+                         "relaunches")
+    ap.add_argument("--directive-slots", type=int, default=64)
+    ap.add_argument("--ingest-stall-timeout", type=float, default=10.0)
+    ap.add_argument("--ingest-coast-poll", type=float, default=0.05)
     args = ap.parse_args()
 
     try:

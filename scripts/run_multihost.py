@@ -118,6 +118,26 @@ def main() -> None:
                          "this rank at a chunk boundary instead of "
                          "hanging a collective. scripts/mh_supervisor.py "
                          "owns the directory when it drives the group")
+    ap.add_argument("--source", default=None,
+                    help="live command plane (sim/commands.py): NDJSON "
+                         "directive stream (publish/join/leave/attack) or "
+                         "recorded reference trace, drained per chunk "
+                         "boundary. Rank 0 tails the file; frames "
+                         "broadcast to every rank as traced chunk inputs "
+                         "— the flag changes the COMPILED program, so "
+                         "EVERY rank must get it")
+    ap.add_argument("--directive-slots", type=int, default=64,
+                    help="fixed directive slots per chunk (the jit-static "
+                         "frame shape); offered load beyond the budget "
+                         "is journaled load-shedding, never a retrace")
+    ap.add_argument("--ingest-stall-timeout", type=float, default=10.0,
+                    help="seconds of producer silence before the run "
+                         "enters coast mode (empty frames + "
+                         "ingest_stalled journal marker)")
+    ap.add_argument("--ingest-coast-poll", type=float, default=0.05,
+                    help="per-boundary pacing sleep while coasting, so a "
+                         "stalled run cannot sprint arbitrarily far from "
+                         "its stream before the producer restarts")
     args = ap.parse_args()
 
     from go_libp2p_pubsub_tpu.parallel import multihost, resilience
@@ -298,9 +318,29 @@ def main() -> None:
             mh_peer_timeout_s=(liveness.peer_timeout_s
                                if liveness is not None else None))
 
+    # live command plane: rank 0 owns the real queue (and the chaos
+    # ingest drills); under >1 process every rank wraps in
+    # BroadcastCommands so the per-boundary frame broadcast — a
+    # collective — runs rank-symmetrically
+    commands = None
+    if args.source:
+        from go_libp2p_pubsub_tpu.sim import commands as cmdmod
+        queue = None
+        if coord:
+            queue = cmdmod.CommandQueue(
+                args.source, n_peers=cfg.n_peers, n_topics=cfg.n_topics,
+                msg_window=cfg.msg_window, slots=args.directive_slots,
+                stall_timeout_s=args.ingest_stall_timeout,
+                coast_poll_s=args.ingest_coast_poll, chaos=chaos)
+        commands = cmdmod.BroadcastCommands(
+            queue, slots=args.directive_slots) if n_proc > 1 else queue
+        health_meta.update(ingest_source=os.path.abspath(args.source),
+                           directive_slots=args.directive_slots)
+
     sup = SupervisorConfig.from_env(
         scenario=args.scenario,
         run_fn=run_fn,
+        commands=commands,
         state_to_host=multihost.gather_state,
         state_from_host=state_from_host,
         write_files=coord,
@@ -332,6 +372,8 @@ def main() -> None:
     finally:
         if liveness is not None:
             liveness.stop()
+        if commands is not None:
+            commands.close()
     if coord:
         from go_libp2p_pubsub_tpu.sim.engine import delivery_fraction
         from go_libp2p_pubsub_tpu.sim.invariants import decode_flags
@@ -355,6 +397,16 @@ def main() -> None:
         if run_dir:
             line["mh_rung"] = int(os.environ.get("GRAFT_MH_RUNG", "0"))
             line["mh_relaunches"] = relaunches
+        if commands is not None:
+            line["commands_applied"] = int(
+                getattr(commands, "applied_total", 0))
+            line["commands_shed"] = int(getattr(commands, "shed_total", 0))
+            line["commands_refused"] = int(
+                getattr(commands, "refused_total", 0))
+            line["ingest_offset"] = int(
+                getattr(commands, "consumed_offset", 0))
+            line["commands_per_sec"] = round(
+                line["commands_applied"] / max(wall, 1e-9), 3)
         print(json.dumps(line), flush=True)
         if args.journal:
             with open(args.journal, "a") as f:

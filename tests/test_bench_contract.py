@@ -56,9 +56,10 @@ def test_full_suite_fits_budget_at_reduced_n():
     GRAFT_FLEET_SIZE=4 keeps the batched-fleet line (ISSUE 7) at
     contract scale; the frontier family (ISSUE 8), the tracing-overhead
     pair (ISSUE 9), the attack pair (ISSUE 10), the heavy-tail family
-    (ISSUE 15) and the row-sharded bucketed family (ISSUE 16) ride the
-    same BENCH_MAX_N cap with capped-N labels — reduced runs can never
-    bank under the full labels."""
+    (ISSUE 15), the row-sharded bucketed family (ISSUE 16) and the
+    live-command-plane pair (ISSUE 19) ride the same BENCH_MAX_N cap
+    with capped-N labels — reduced runs can never bank under the full
+    labels."""
     budget = 900
     res, metrics, _, elapsed = _run_bench({
         "BENCH_N": "256", "BENCH_MAX_N": "256", "BENCH_TICKS": "2",
@@ -67,8 +68,8 @@ def test_full_suite_fits_budget_at_reduced_n():
         timeout=budget + 120)
     assert res.returncode == 0, res.stderr[-500:]
     assert elapsed < budget, f"suite blew the budget: {elapsed:.0f}s"
-    # 26 configs + the headline re-emit
-    assert len(metrics) == 27, [m["metric"] for m in metrics]
+    # 28 configs + the headline re-emit
+    assert len(metrics) == 29, [m["metric"] for m in metrics]
     for m in metrics:
         assert m["value"] > 0, m
         # every record carries the memory accounting (ISSUE 8 satellite)
@@ -91,7 +92,8 @@ def test_full_suite_fits_budget_at_reduced_n():
                      "powerlaw_10m_capped_0k",
                      "heavytail_eclipse_capped_0k",
                      "powerlaw_100k_mh_capped_0k",
-                     "powerlaw_10m_mh_capped_0k"}
+                     "powerlaw_10m_mh_capped_0k",
+                     "ingest_1k_capped_0k", "ingest_10k_capped_0k"}
     fleet = next(m for m in metrics if "fleet_4x0k" in m["metric"])
     assert fleet["fleet_size"] == 4
     assert fleet["per_member_hbps"] > 0
@@ -111,6 +113,16 @@ def test_full_suite_fits_budget_at_reduced_n():
     # including the XL frontier pair (compact storage by construction)
     xl = next(m for m in metrics if "frontier_10m" in m["metric"])
     assert xl["build_wall_s"] >= 0 and xl["build_peak_rss_bytes"] > 0
+    # the live-command-plane line (ISSUE 19): all three offered loads
+    # present, and the overload leg's deterministic shed travels with
+    # the banked number (load past the watermark MUST shed, in-budget
+    # loads must not)
+    ing = next(m for m in metrics if "ingest_1k" in m["metric"])
+    assert ing["unit"] == "commands/s"
+    assert ing["light"]["shed"] == 0
+    assert ing["overload"]["shed"] > 0
+    assert ing["overload"]["applied"] + ing["overload"]["shed"] \
+        == ing["overload"]["offered_total"]
     # the heavy-tail line (ISSUE 15): the degree shape and bucket
     # partition travel with every banked number
     pl = next(m for m in metrics if "powerlaw_100k_capped" in m["metric"])

@@ -1,0 +1,864 @@
+"""Live command plane (sim/commands.py, ISSUE 19): bounded host→device
+directive ingestion with admission control, coast-mode degradation, and
+exactly-once resume.
+
+Layers under test, cheapest first: the jit-free parser fuzz (every
+malformed line refused BY NAME, none crash), the bounded queue's drain /
+shed / stall / offset-cursor semantics, the jitted replay apply
+(supervised run with a directive stream bit-exact vs a manually
+interleaved engine+replay reference, ONE replay trace for the whole
+run), the exactly-once kill→resume leg (stamped ``stream_offset``
+sidecar), the overload leg (deterministic journaled shedding, zero
+retraces, chip never blocked) — capped by THE acceptance test: a real
+supervised 2-process CPU run fed by an external producer subprocess that
+is SIGKILLed mid-window (run coasts, journals the stall, producer
+restarts from the stamped offset) plus a rank-SIGKILL group-relaunch
+leg, both finishing bit-exact vs the same stream ingested uninterrupted.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from go_libp2p_pubsub_tpu.sim import commands as cmds  # noqa: E402
+from go_libp2p_pubsub_tpu.sim.commands import (  # noqa: E402
+    CommandQueue, DirectiveError, parse_line, write_stream)
+
+pytestmark = pytest.mark.commands
+
+
+# ---------------------------------------------------------------------------
+# directive parser: refusal BY NAME, jit-free (no jax import in sight)
+
+
+class TestDirectiveParser:
+    N, T = 64, 2
+
+    def _parse(self, line, **kw):
+        kw.setdefault("n_peers", self.N)
+        kw.setdefault("n_topics", self.T)
+        return parse_line(line, **kw)
+
+    def test_valid_publish_join_leave(self):
+        p = self._parse('{"op":"publish","tick":3,"peer":5,"topic":1}')
+        assert p.ops == (("publish", 5, 1),) and p.tick == 3
+        j = self._parse('{"op":"join","peer":0,"topic":0}')
+        assert j.ops == (("join", 0, 0),) and j.tick == -1  # untimed
+        v = self._parse('{"op":"leave","tick":0,"peer":63,"topic":1}')
+        assert v.ops == (("leave", 63, 1),)
+
+    def test_attack_storm_expands_to_publishes(self):
+        p = self._parse('{"op":"attack","tick":2,"kind":"storm",'
+                        '"topic":1,"peers":[1,2,3]}')
+        assert p.ops == (("publish", 1, 1), ("publish", 2, 1),
+                         ("publish", 3, 1))
+
+    def test_watermark_and_end(self):
+        assert self._parse('{"op":"tick","tick":9}').kind == "tick"
+        assert self._parse('{"op":"end"}').kind == "end"
+        assert self._parse("").kind == "blank"
+
+    @pytest.mark.parametrize("line,name", [
+        ("not json at all", "not valid JSON"),
+        ('{"op":"publish","peer":5}', "topic"),
+        ('{"op":"frobnicate"}', "unknown"),
+        ('[1, 2, 3]', "JSON object"),
+        ('{"op":"publish","peer":-1,"topic":0}', "out of range"),
+        ('{"op":"publish","peer":64,"topic":0}', "out of range"),
+        ('{"op":"publish","peer":0,"topic":2}', "out of range"),
+        ('{"op":"publish","peer":"x","topic":0}', "must be an integer"),
+        ('{"op":"publish","peer":true,"topic":0}', "must be an integer"),
+        ('{"op":"join","peer":0,"topic":0,"tick":-7}', "tick"),
+        ('{"op":"attack","kind":"surge","topic":0,"peers":[1]}',
+         "unknown kind"),
+        ('{"op":"attack","kind":"storm","topic":0,"peers":[]}',
+         "non-empty"),
+        ('{"op":"attack","kind":"storm","topic":0,"peers":[999]}',
+         "out of range"),
+        ('{"op":"tick"}', "watermark"),
+    ])
+    def test_refused_by_name(self, line, name):
+        with pytest.raises(DirectiveError, match=name):
+            self._parse(line)
+
+    def test_oversized_batch_refused(self):
+        peers = list(range(50))
+        with pytest.raises(DirectiveError, match="max_batch"):
+            self._parse(json.dumps({"op": "attack", "kind": "storm",
+                                    "topic": 0, "peers": peers}),
+                        max_batch=10)
+
+    def test_fuzz_garbage_never_crashes(self):
+        """Random byte garbage: every line either parses or raises
+        DirectiveError — no other exception type ever escapes."""
+        rng = random.Random(314159)
+        alphabet = '{}[]",:0-9abcdef\\ \t\x00\xff'
+        for _ in range(500):
+            line = "".join(rng.choice(alphabet)
+                           for _ in range(rng.randrange(0, 60)))
+            try:
+                self._parse(line)
+            except DirectiveError:
+                pass
+
+    def test_fuzz_structured_never_crashes(self):
+        """Structured fuzz: valid JSON objects with adversarial field
+        types/values — same contract."""
+        rng = random.Random(7)
+        vals = [None, True, -1, 0, 63, 64, 10**12, 0.5, "x", [], {},
+                [1, 2], {"a": 1}]
+        keys = ["op", "tick", "peer", "topic", "kind", "peers", "type",
+                "timestamp", "peerID"]
+        ops = ["publish", "join", "leave", "attack", "tick", "end",
+               "nonsense", 7, None]
+        for _ in range(500):
+            d = {k: rng.choice(vals)
+                 for k in rng.sample(keys, rng.randrange(0, len(keys)))}
+            if rng.random() < 0.7:
+                d["op"] = rng.choice(ops)
+            try:
+                self._parse(json.dumps(d))
+            except DirectiveError:
+                pass
+
+    def test_trace_events_map_to_directives(self):
+        j = self._parse(json.dumps(
+            {"type": "JOIN", "timestamp": 3.0, "peerID": "5",
+             "join": {"topic": "1"}}))
+        assert j.ops == (("join", 5, 1),) and j.tick == 3
+        pub = self._parse(json.dumps(
+            {"type": "PUBLISH_MESSAGE", "timestamp": 2.5, "peerID": 7,
+             "publishMessage": {"topic": 0}}))
+        assert pub.ops == (("publish", 7, 0),) and pub.tick == 2
+        # unsupported event types are counted skips, not refusals
+        assert self._parse(json.dumps(
+            {"type": "GRAFT", "timestamp": 1.0,
+             "peerID": "5"})).kind == "skip:GRAFT"
+
+    def test_trace_events_with_index_maps(self):
+        p = self._parse(json.dumps(
+            {"type": "JOIN", "timestamp": 0, "peerID": "Qmfoo",
+             "join": {"topic": "blocks"}}),
+            peer_index={"Qmfoo": 9}, topic_index={"blocks": 1})
+        assert p.ops == (("join", 9, 1),)
+        with pytest.raises(DirectiveError, match="not in peer_index"):
+            self._parse(json.dumps(
+                {"type": "JOIN", "timestamp": 0, "peerID": "Qmbar",
+                 "join": {"topic": "blocks"}}),
+                peer_index={"Qmfoo": 9}, topic_index={"blocks": 1})
+
+    def test_op_codes_mirror_replay(self):
+        """commands.py duplicates the replay op codes to stay jax-free;
+        this is the pin that keeps the mirror honest."""
+        import importlib
+        rp = importlib.import_module("go_libp2p_pubsub_tpu.trace.replay")
+        assert (cmds.OP_NOP, cmds.OP_JOIN, cmds.OP_LEAVE,
+                cmds.OP_PUBLISH) == (rp.OP_NOP, rp.OP_JOIN, rp.OP_LEAVE,
+                                     rp.OP_PUBLISH)
+
+
+# ---------------------------------------------------------------------------
+# CommandQueue: drain / shed / stall / offset-cursor semantics (host-only)
+
+
+def _mkq(src, slots=4, stall=2.0, **kw):
+    kw.setdefault("n_peers", 64)
+    kw.setdefault("n_topics", 2)
+    kw.setdefault("msg_window", 32)
+    kw.setdefault("coast_poll_s", 0.01)
+    return CommandQueue(str(src), slots=slots, stall_timeout_s=stall, **kw)
+
+
+STREAM = [
+    {"op": "publish", "tick": 1, "peer": 3, "topic": 0},
+    {"op": "join", "tick": 3, "peer": 7, "topic": 1},
+    {"op": "bogus"},                                # refused, consumed
+    {"op": "tick", "tick": 9},
+    {"op": "publish", "tick": 9, "peer": 2, "topic": 0},
+]
+
+
+class TestCommandQueue:
+    def test_boundary_drain_routes_by_tick(self, tmp_path):
+        src = tmp_path / "s.ndjsonl"
+        size = write_stream(str(src), STREAM)
+        q = _mkq(src).start(0)
+        try:
+            f0 = q.frame_for(0, 5)       # [0,5): publish@1, join@3
+            assert f0.count == 2
+            assert list(f0.op[:2]) == [cmds.OP_PUBLISH, cmds.OP_JOIN]
+            assert list(f0.a[:2]) == [3, 7]
+            assert [k for k, _m in f0.notes] == ["directive_refused"]
+            f1 = q.frame_for(5, 5)       # [5,10): publish@9
+            assert f1.count == 1 and f1.a[0] == 2
+            f2 = q.frame_for(10, 5)      # past EOF: empty, fully consumed
+            assert f2.count == 0 and f2.offset == size
+            assert q.applied_total == 3 and q.refused_total == 1
+        finally:
+            q.close()
+
+    def test_frame_cache_returns_identical_frame(self, tmp_path):
+        src = tmp_path / "s.ndjsonl"
+        write_stream(str(src), STREAM)
+        q = _mkq(src).start(0)
+        try:
+            f0 = q.frame_for(0, 5)
+            again = q.frame_for(0, 5)    # a retry's re-fetch
+            assert again is f0
+        finally:
+            q.close()
+
+    def test_offset_cursor_is_exactly_once(self, tmp_path):
+        """A queue seeked to frame k's stamped offset reproduces frames
+        k+1... bit for bit: the byte offset is a complete ingestion
+        cursor (prefix consumption, refusals included)."""
+        src = tmp_path / "s.ndjsonl"
+        write_stream(str(src), STREAM)
+        q = _mkq(src).start(0)
+        f0 = q.frame_for(0, 5)
+        f1 = q.frame_for(5, 5)
+        q.close()
+        q2 = _mkq(src).start(f0.offset)
+        g1 = q2.frame_for(5, 5)
+        q2.close()
+        for fld in ("op", "a", "b", "c"):
+            np.testing.assert_array_equal(getattr(g1, fld),
+                                          getattr(f1, fld), err_msg=fld)
+        assert g1.offset == f1.offset and g1.count == f1.count
+
+    def test_overflow_sheds_deterministically(self, tmp_path):
+        src = tmp_path / "s.ndjsonl"
+        write_stream(str(src), [
+            {"op": "publish", "tick": 0, "peer": p, "topic": 0}
+            for p in range(10)])
+        q = _mkq(src, slots=4).start(0)
+        try:
+            f = q.frame_for(0, 2)
+            assert f.count == 4 and f.shed == 6 and f.shed_total == 6
+            # shed by stream position: the FIRST four peers won
+            assert list(f.a) == [0, 1, 2, 3]
+            assert ("ingest_shed", {"tick": 0, "shed": 6, "slots": 4}) \
+                in f.notes
+            # shed lines are consumed — nothing replays them
+            assert q.frame_for(2, 2).count == 0
+        finally:
+            q.close()
+
+    def test_stall_coast_resume_markers(self, tmp_path):
+        src = tmp_path / "s.ndjsonl"
+        with open(src, "w") as f:
+            f.write(json.dumps(
+                {"op": "publish", "tick": 1, "peer": 1, "topic": 0})
+                + "\n")
+        q = _mkq(src, stall=0.3).start(0)
+        try:
+            f0 = q.frame_for(0, 2)       # watermark 1 < 2: stalls, coasts
+            assert f0.coasting and f0.count == 1
+            assert [k for k, _m in f0.notes] == ["ingest_stalled"]
+            stall_meta = dict(f0.notes)["ingest_stalled"]
+            assert stall_meta["offset"] == os.path.getsize(src)
+            assert "directive_producer.py" in stall_meta["resume_cmd"]
+            f1 = q.frame_for(2, 2)       # still silent: keeps coasting,
+            assert f1.coasting and not f1.notes    # marker NOT repeated
+            with open(src, "a") as fh:   # producer comes back
+                fh.write(json.dumps(
+                    {"op": "publish", "tick": 5, "peer": 2, "topic": 0})
+                    + "\n")
+                fh.write(json.dumps({"op": "end"}) + "\n")
+            deadline = time.monotonic() + 5.0
+            while not q._eof and time.monotonic() < deadline:
+                time.sleep(0.02)    # let the tailing reader catch up
+            f2 = q.frame_for(4, 2)
+            assert not f2.coasting and f2.count == 1
+            assert "ingest_resumed" in [k for k, _m in f2.notes]
+        finally:
+            q.close()
+
+    def test_unread_stream_blocks_untimed_stream_does_not(self, tmp_path):
+        src = tmp_path / "s.ndjsonl"
+        write_stream(str(src), [{"op": "join", "peer": 1, "topic": 0}])
+        q = _mkq(src, stall=5.0).start(0)
+        try:
+            t0 = time.monotonic()
+            f = q.frame_for(0, 2)        # blocks only until primed
+            assert time.monotonic() - t0 < 4.0
+            assert f.count == 1 and not f.coasting
+        finally:
+            q.close()
+
+    def test_backpressure_bounds_queue_memory(self, tmp_path):
+        src = tmp_path / "s.ndjsonl"
+        write_stream(str(src), [
+            {"op": "publish", "tick": p // 8, "peer": p % 64, "topic": 0}
+            for p in range(200)])
+        q = _mkq(src, slots=4, maxlen=16).start(0)
+        try:
+            deadline = time.monotonic() + 10.0
+            start = 0
+            while q.applied_total + q.shed_total < 200 \
+                    and time.monotonic() < deadline:
+                with q._cond:
+                    assert len(q._q) <= 16       # the reader blocked
+                q.frame_for(start, 1)   # fresh boundary each drain
+                start += 1
+                time.sleep(0.005)
+            assert q.applied_total + q.shed_total == 200
+        finally:
+            q.close()
+
+
+class TestIngestChaos:
+    def test_parse_ingest_specs(self):
+        from go_libp2p_pubsub_tpu.parallel.resilience import ChaosPlan
+        specs = ChaosPlan.parse("ingest_stall@4:2.5, ingest_kill@8")
+        assert specs == [
+            {"action": "ingest_stall", "rank": 0, "tick": 4,
+             "seconds": 2.5},
+            {"action": "ingest_kill", "rank": 0, "tick": 8,
+             "seconds": 0.0}]
+
+    @pytest.mark.parametrize("bad", ["ingest_stall@4", "ingest_kill@4:2",
+                                     "ingest_stall@x:1"])
+    def test_parse_refuses_by_name(self, bad):
+        from go_libp2p_pubsub_tpu.parallel.resilience import ChaosPlan
+        with pytest.raises(ValueError, match="GRAFT_CHAOS"):
+            ChaosPlan.parse(bad)
+
+    def test_ingest_specs_live_on_rank0_and_skip_fire(self, tmp_path):
+        from go_libp2p_pubsub_tpu.parallel.resilience import ChaosPlan
+        plan = ChaosPlan(ChaosPlan.parse("ingest_kill@2"), rank=0,
+                         run_dir=str(tmp_path))
+        assert plan.specs == [] and len(plan.ingest_specs) == 1
+        plan.fire({"chunk_start": 5})    # chunk-hook path must skip them
+        assert not os.listdir(tmp_path)
+        assert ChaosPlan(ChaosPlan.parse("ingest_kill@2"),
+                         rank=1).ingest_specs == []
+
+    def test_fire_ingest_once_per_run_dir(self, tmp_path):
+        from go_libp2p_pubsub_tpu.parallel.resilience import ChaosPlan
+
+        class Q:
+            killed = 0
+
+            def kill_reader(self):
+                self.killed += 1
+
+        plan = ChaosPlan(ChaosPlan.parse("ingest_kill@2"), rank=0,
+                         run_dir=str(tmp_path))
+        q = Q()
+        plan.fire_ingest(0, q)
+        assert q.killed == 0
+        plan.fire_ingest(2, q)
+        plan.fire_ingest(4, q)
+        assert q.killed == 1
+        # relaunched process, same run dir: durable marker holds
+        ChaosPlan(ChaosPlan.parse("ingest_kill@2"), rank=0,
+                  run_dir=str(tmp_path)).fire_ingest(2, q)
+        assert q.killed == 1
+        assert [n for n in os.listdir(tmp_path)
+                if n.endswith(".fired")] == ["chaos_ingest_kill_r0_t2.fired"]
+
+    def test_chaos_ingest_kill_coasts_the_queue(self, tmp_path):
+        from go_libp2p_pubsub_tpu.parallel.resilience import ChaosPlan
+        src = tmp_path / "s.ndjsonl"
+        with open(src, "w") as f:
+            f.write(json.dumps(
+                {"op": "publish", "tick": 1, "peer": 1, "topic": 0})
+                + "\n")
+        plan = ChaosPlan(ChaosPlan.parse("ingest_kill@2"), rank=0,
+                         run_dir=str(tmp_path))
+        q = _mkq(src, stall=0.3, chaos=plan).start(0)
+        try:
+            f0 = q.frame_for(0, 2)
+            assert f0.count == 1
+            f1 = q.frame_for(2, 2)       # chaos kills the reader: coast
+            assert f1.coasting
+            assert "ingest_stalled" in [k for k, _m in
+                                        f0.notes + f1.notes]
+        finally:
+            q.close()
+
+
+# ---------------------------------------------------------------------------
+# the jitted apply + supervised integration (single process)
+
+
+@pytest.fixture(scope="module")
+def small():
+    import jax
+
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    cfg, tp, state = scenarios.single_topic_1k(n_peers=128, k_slots=16,
+                                               degree=6)
+    return cfg, tp, state, jax.random.PRNGKey(42)
+
+
+DIRECTIVES = [
+    {"op": "publish", "tick": 1, "peer": 3, "topic": 0},
+    {"op": "join", "tick": 4, "peer": 7, "topic": 0},
+    {"op": "attack", "tick": 7, "kind": "storm", "topic": 0,
+     "peers": [10, 11, 12]},
+    {"op": "leave", "tick": 10, "peer": 7, "topic": 0},
+]
+
+SLOTS, CHUNK, TICKS = 8, 3, 12
+
+
+def _queue_for(cfg, src, **kw):
+    kw.setdefault("stall_timeout_s", 30.0)
+    return CommandQueue(str(src), n_peers=cfg.n_peers,
+                        n_topics=cfg.n_topics, msg_window=cfg.msg_window,
+                        slots=SLOTS, **kw)
+
+
+def _sup(q, **kw):
+    from go_libp2p_pubsub_tpu.sim.supervisor import SupervisorConfig
+    return SupervisorConfig(chunk_ticks=CHUNK, commands=q,
+                            backoff_base_s=0.0, sleep=lambda s: None,
+                            **kw)
+
+
+def _run(state, cfg, tp, key, q, n_ticks=TICKS, **kw):
+    from go_libp2p_pubsub_tpu.sim.supervisor import supervised_run
+    try:
+        return supervised_run(state, cfg, tp, key, n_ticks, _sup(q, **kw))
+    finally:
+        q.close()
+
+
+def _assert_states_equal(a, b):
+    for f, x, y in zip(a._fields, a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"field {f}")
+
+
+def _manual_reference(state, cfg, tp, key, directives, n_ticks=TICKS,
+                      chunk=CHUNK, slots=SLOTS):
+    """First-principles reference: engine chunks interleaved with replay
+    frames built by hand — the trajectory the command plane must hit."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.sim import engine
+    st = state
+    all_keys = jax.random.split(key, n_ticks)
+    for start in range(0, n_ticks, chunk):
+        prims = []
+        for d in directives:
+            if not start <= d["tick"] < start + chunk:
+                continue
+            if d["op"] == "attack":
+                prims += [("publish", p, d["topic"]) for p in d["peers"]]
+            else:
+                prims.append((d["op"], d["peer"], d["topic"]))
+        if prims:
+            op = np.zeros(slots, np.int32)
+            a = np.zeros(slots, np.int32)
+            b = np.zeros(slots, np.int32)
+            c = np.zeros(slots, np.int32)
+            for i, (kind, peer, topic) in enumerate(prims):
+                a[i], c[i] = peer, topic
+                if kind == "publish":
+                    op[i] = cmds.OP_PUBLISH
+                    b[i] = (start * slots + i) % cfg.msg_window
+                else:
+                    op[i] = cmds.OP_JOIN if kind == "join" \
+                        else cmds.OP_LEAVE
+                    b[i] = -1
+            st = cmds.apply_frame(st, cfg, tp, cmds.empty_frame(slots)
+                                  ._replace(op=op, a=a, b=b, c=c,
+                                            count=len(prims)))
+        st = engine.run_keys(st, cfg, tp, all_keys[start:start + chunk])
+    return st
+
+
+class TestSupervisedIngest:
+    def test_stream_run_bit_exact_vs_manual_replay(self, small, tmp_path):
+        """The promotion claim: a supervised run fed the NDJSON stream
+        equals engine chunks manually interleaved with replay frames —
+        trace/replay.py IS the ingestion path."""
+        cfg, tp, state, key = small
+        src = tmp_path / "s.ndjsonl"
+        write_stream(str(src), DIRECTIVES)
+        out, rep = _run(state, cfg, tp, key, _queue_for(cfg, src))
+        ref = _manual_reference(state, cfg, tp, key, DIRECTIVES)
+        _assert_states_equal(ref, out)
+        assert [e.get("directives") for e in rep.events
+                if "directives" in e] == [1, 1, 3, 1]
+
+    def test_kill_resume_exactly_once_bit_exact(self, small, tmp_path):
+        """ISSUE 19 single-process resume leg: kill mid-run, resume from
+        the checkpoint — the stamped stream_offset seeks the queue so
+        every directive applies exactly once; final state bit-exact vs
+        the uninterrupted run of the same stream."""
+        import glob
+
+        from go_libp2p_pubsub_tpu.sim import checkpoint
+        cfg, tp, state, key = small
+        src = tmp_path / "s.ndjsonl"
+        write_stream(str(src), DIRECTIVES)
+        ref, _ = _run(state, cfg, tp, key, _queue_for(cfg, src))
+
+        ck = str(tmp_path / "ck")
+
+        def kill(info):
+            if info["chunk_start"] >= 9:
+                raise KeyboardInterrupt("simulated preemption")
+
+        from go_libp2p_pubsub_tpu.sim.supervisor import supervised_run
+        q1 = _queue_for(cfg, src)
+        with pytest.raises(KeyboardInterrupt):
+            try:
+                supervised_run(state, cfg, tp, key, TICKS,
+                               _sup(q1, checkpoint_dir=ck),
+                               _chunk_hook=kill)
+            finally:
+                q1.close()
+        # every drained checkpoint carries the ingestion cursor
+        stamped = [checkpoint.sidecar_meta(p).get("stream_offset")
+                   for p in glob.glob(os.path.join(ck, "*"))
+                   if not p.endswith(".fingerprint")]
+        assert stamped and all(s is not None for s in stamped)
+
+        out, rep = _run(state, cfg, tp, key, _queue_for(cfg, src),
+                        checkpoint_dir=ck)
+        assert rep.resumed_tick is not None
+        start = next(e for e in rep.events
+                     if e["event"] == "ingest_start")
+        assert start["offset"] > 0      # seeked, not replayed from 0
+        _assert_states_equal(ref, out)
+
+    def test_overload_sheds_deterministically_zero_retrace(
+            self, small, tmp_path):
+        """ISSUE 19 overload leg: offered load past the slot budget is
+        journaled load-shedding — exact counts, zero retraces (compile
+        caches asserted), and the chip never blocks on ingest (no stall
+        markers, EOF stream)."""
+        import importlib
+
+        from go_libp2p_pubsub_tpu.parallel import compile_plan
+        from go_libp2p_pubsub_tpu.sim.telemetry import read_journal
+        rp = importlib.import_module("go_libp2p_pubsub_tpu.trace.replay")
+        cfg, tp, state, key = small
+        src = tmp_path / "s.ndjsonl"
+        # 4x the slot budget offered into chunk [0,3), plus steady load
+        over = [{"op": "publish", "tick": 1, "peer": p, "topic": 0}
+                for p in range(4 * SLOTS)]
+        over += [{"op": "publish", "tick": t, "peer": t, "topic": 0}
+                 for t in range(3, TICKS)]
+        write_stream(str(src), over)
+        health = str(tmp_path / "health.jsonl")
+
+        aot_before = None
+        seen_keys = set()
+
+        out, rep = _run(state, cfg, tp, key, _queue_for(cfg, src),
+                        health_path=health)
+        j = read_journal(health)
+        ing = [n for n in j["notes"] if n.get("kind") == "ingest"]
+        shed = [n for n in j["notes"] if n.get("kind") == "ingest_shed"]
+        assert ing and ing[-1]["shed_total"] == 3 * SLOTS
+        assert sum(n["shed"] for n in shed) == 3 * SLOTS
+        assert shed[0]["slots"] == SLOTS
+        # deterministic: the journaled counts are a pure function of the
+        # stream — a second identical run sheds identically
+        out2, _ = _run(state, cfg, tp, key, _queue_for(cfg, src))
+        _assert_states_equal(out, out2)
+        # chip never blocked: no coast markers anywhere
+        assert not [n for n in j["notes"]
+                    if n.get("kind") == "ingest_stalled"]
+        assert all(not n["coasting"] for n in ing)
+        # zero retraces: ONE replay trace serves every frame, and the
+        # second run added no engine executables either
+        assert rp.replay._cache_size() == 1
+        aot = set(compile_plan._ENGINE_AOT)
+        out3, _ = _run(state, cfg, tp, key, _queue_for(cfg, src))
+        assert set(compile_plan._ENGINE_AOT) == aot
+        assert rp.replay._cache_size() == 1
+        assert rep.retries == 0
+
+    def test_coast_mode_steps_through_producer_silence(self, small,
+                                                       tmp_path):
+        """A stream that goes silent mid-run: the run coasts (empty
+        frames, stall marker), keeps stepping to completion, and the
+        coasted trajectory equals the no-directives-after-silence run."""
+        cfg, tp, state, key = small
+        src = tmp_path / "s.ndjsonl"
+        early = [d for d in DIRECTIVES if d["tick"] < 6]
+        with open(src, "w") as f:            # no end marker: silence
+            for d in early:
+                f.write(json.dumps(d) + "\n")
+        q = _queue_for(cfg, src, stall_timeout_s=0.3, coast_poll_s=0.01)
+        out, rep = _run(state, cfg, tp, key, q)
+        src2 = tmp_path / "s2.ndjsonl"
+        write_stream(str(src2), early)       # same stream, clean EOF
+        ref, _ = _run(state, cfg, tp, key, _queue_for(cfg, src2))
+        _assert_states_equal(ref, out)
+
+    def test_broadcast_wrapper_single_process_identity(self, small,
+                                                       tmp_path):
+        """BroadcastCommands at process_count=1 hands back the inner
+        queue's frames unchanged (the rank-0 side of the multihost
+        broadcast) — and its totals mirror the frame metadata."""
+        cfg, tp, state, key = small
+        src = tmp_path / "s.ndjsonl"
+        write_stream(str(src), DIRECTIVES)
+        inner = _queue_for(cfg, src)
+        bc = cmds.BroadcastCommands(inner, slots=SLOTS)
+        out, _ = _run(state, cfg, tp, key, bc)
+        ref, _ = _run(state, cfg, tp, key, _queue_for(cfg, src))
+        _assert_states_equal(ref, out)
+        assert bc.applied_total == 6 and bc.shed_total == 0
+
+
+# ---------------------------------------------------------------------------
+# dashboard ingest view
+
+
+class TestDashboardIngest:
+    def _journal(self, tmp_path, coasting):
+        path = tmp_path / "health.jsonl"
+        now = time.time()
+        with open(path, "w") as f:
+            f.write(json.dumps({"kind": "run", "wall": now - 10,
+                                "scenario": "frontier_250k",
+                                "n_peers": 128, "n_topics": 1,
+                                "flags_version": 1}) + "\n")
+            if coasting:
+                f.write(json.dumps(
+                    {"kind": "ingest_stalled", "wall": now - 2, "tick": 6,
+                     "offset": 1234, "source": "/shared/live.ndjsonl",
+                     "resume_cmd": "python scripts/directive_producer.py "
+                                   "--stream <input> --out "
+                                   "/shared/live.ndjsonl "
+                                   "--from-offset 1234"}) + "\n")
+            f.write(json.dumps(
+                {"kind": "ingest", "wall": now - 1, "tick": 8,
+                 "directives": 0 if coasting else 3, "shed": 0,
+                 "shed_total": 5, "refused_total": 2, "queue_depth": 1,
+                 "lag_ticks": 0, "offset": 1234,
+                 "coasting": coasting}) + "\n")
+        return str(path)
+
+    def test_snapshot_attaches_ingest_vitals(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import dashboard
+        finally:
+            sys.path.pop(0)
+        snap = dashboard.snapshot(self._journal(tmp_path, coasting=False))
+        ing = snap["ingest"]
+        assert ing["shed_total"] == 5 and ing["offset"] == 1234
+        assert not ing.get("coasting")
+        text = dashboard.render(snap)
+        assert "ingest" in text and "shed 5" in text
+        assert "COASTING" not in text
+
+    def test_coasting_banner_carries_resume_cmd(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import dashboard
+        finally:
+            sys.path.pop(0)
+        snap = dashboard.snapshot(self._journal(tmp_path, coasting=True))
+        assert snap["ingest"]["coasting"]
+        assert snap["ingest"]["resume_cmd"].startswith(
+            "python scripts/directive_producer.py")
+        text = dashboard.render(snap)
+        assert "COASTING" in text
+        assert "--from-offset 1234" in text
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance test: 2-process run + external producer subprocess
+
+
+MH_TICKS, MH_CHUNK, MH_SEED, MH_N = 16, 2, 7, 128
+
+MH_STREAM = [
+    {"op": "publish", "tick": 1, "peer": 3, "topic": 0},
+    {"op": "join", "tick": 3, "peer": 9, "topic": 0},
+    # --- producer parks/dies here; the run coasts through [4, 12) ---
+    {"op": "publish", "tick": 13, "peer": 5, "topic": 0},
+    {"op": "attack", "tick": 15, "kind": "storm", "topic": 0,
+     "peers": [20, 21]},
+]
+
+
+def _mh_env(**extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)      # conftest's 8-device flag must not leak
+    env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="", **extra)
+    return env
+
+
+def _mh_cmd(run_dir, final, source, stall="1.0", coast="0.5",
+            procs="2"):
+    return [sys.executable,
+            os.path.join(REPO, "scripts", "mh_supervisor.py"),
+            "--procs", procs, "--scenario", "frontier_250k",
+            "--n", str(MH_N), "--ticks", str(MH_TICKS),
+            "--seed", str(MH_SEED), "--chunk-ticks", str(MH_CHUNK),
+            "--run-dir", str(run_dir), "--max-relaunches", "2",
+            "--backoff-base-s", "0.05", "--dump-state", str(final),
+            "--health", str(run_dir / "health.jsonl"),
+            "--source", str(source), "--directive-slots", "8",
+            "--ingest-stall-timeout", stall,
+            "--ingest-coast-poll", coast]
+
+
+@pytest.fixture(scope="module")
+def mh_reference(tmp_path_factory):
+    """The same stream ingested uninterrupted, single process — the
+    trajectory both acceptance legs must reproduce bit for bit (the
+    1-proc == 2-proc contract is tests/test_multihost.py's pin; the
+    directive frames apply at the same chunk boundaries either way)."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.parallel import multihost
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    from go_libp2p_pubsub_tpu.sim.supervisor import (SupervisorConfig,
+                                                     supervised_run)
+    d = tmp_path_factory.mktemp("ref")
+    src = d / "full.ndjsonl"
+    write_stream(str(src), MH_STREAM)
+    cfg, tp, topo, subscribed = scenarios.frontier_spec(MH_N)
+    st = multihost.init_state_local(cfg, topo, 0, 1,
+                                    subscribed=subscribed)
+    q = CommandQueue(str(src), n_peers=cfg.n_peers,
+                     n_topics=cfg.n_topics, msg_window=cfg.msg_window,
+                     slots=8, stall_timeout_s=60.0)
+    sup = SupervisorConfig(chunk_ticks=MH_CHUNK, commands=q,
+                           backoff_base_s=0.0, sleep=lambda s: None)
+    try:
+        out, _ = supervised_run(st, cfg, tp,
+                                jax.random.PRNGKey(MH_SEED), MH_TICKS,
+                                sup)
+    finally:
+        q.close()
+    return out
+
+
+def _assert_dump_equals(final, ref):
+    got = np.load(final)
+    for f in ref._fields:
+        assert np.array_equal(np.asarray(getattr(ref, f)), got[f]), f
+
+
+@pytest.mark.slow
+def test_mh_producer_sigkill_coast_restart_bit_exact(tmp_path,
+                                                     mh_reference):
+    """THE ISSUE 19 acceptance leg: a real supervised 2-process CPU run
+    fed by an external producer subprocess. The producer is SIGKILLed
+    mid-window → the run coasts and journals ``ingest_stalled`` with the
+    stamped offset → a new producer resumes the feed from that offset →
+    the run journals ``ingest_resumed`` and finishes bit-exact vs the
+    same stream ingested uninterrupted."""
+    run_dir = tmp_path / "mh"
+    run_dir.mkdir()
+    final = tmp_path / "final.npz"
+    stream = tmp_path / "full.ndjsonl"
+    write_stream(str(stream), MH_STREAM)
+    live = tmp_path / "live.ndjsonl"
+    health = run_dir / "health.jsonl"
+
+    producer_cmd = [sys.executable,
+                    os.path.join(REPO, "scripts", "directive_producer.py"),
+                    "--stream", str(stream), "--out", str(live)]
+    # feed the two early lines, then park (SIGKILL fodder)
+    prod = subprocess.Popen(producer_cmd + ["--lines", "2"])
+    run = subprocess.Popen(
+        _mh_cmd(run_dir, final, live),
+        env=_mh_env(GRAFT_MH_PEER_TIMEOUT_S="8", GRAFT_MH_ABORT_GRACE_S="4",
+                    GRAFT_MH_BEAT_INTERVAL_S="0.5"),
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        # wait for the run to notice the silence and journal the stall
+        stall = None
+        deadline = time.monotonic() + 240
+        while stall is None and time.monotonic() < deadline:
+            assert run.poll() is None, run.communicate()[0]
+            if health.exists():
+                for ln in health.read_text().splitlines():
+                    try:
+                        d = json.loads(ln)
+                    except json.JSONDecodeError:
+                        continue
+                    if d.get("kind") == "ingest_stalled":
+                        stall = d
+                        break
+            time.sleep(0.05)
+        assert stall is not None, "run never journaled ingest_stalled"
+        prod.kill()                     # SIGKILL the parked producer
+        prod.wait(timeout=30)
+        # restart the producer exactly as the COASTING banner instructs
+        prod2 = subprocess.run(
+            producer_cmd + ["--from-offset", str(stall["offset"])],
+            timeout=60)
+        assert prod2.returncode == 0
+        out, _ = run.communicate(timeout=420)
+        assert run.returncode == 0, out
+    finally:
+        for p in (prod, run):
+            if p.poll() is None:
+                p.kill()
+
+    notes = [json.loads(ln) for ln in health.read_text().splitlines()
+             if ln.strip()]
+    kinds = [n.get("kind") for n in notes]
+    assert "ingest_stalled" in kinds and "ingest_resumed" in kinds
+    assert stall["resume_cmd"].endswith(
+        f"--out {live} --from-offset {stall['offset']}")
+    # the run COASTED: at least one ingest marker flagged the mode
+    ing = [n for n in notes if n.get("kind") == "ingest"]
+    assert any(n["coasting"] for n in ing)
+    assert not ing[-1]["coasting"] and ing[-1]["shed_total"] == 0
+    _assert_dump_equals(final, mh_reference)
+
+
+@pytest.mark.slow
+def test_mh_rank_sigkill_relaunch_ingest_exactly_once(tmp_path,
+                                                      mh_reference):
+    """ISSUE 19 rank-SIGKILL leg: rank 1 of the 2-process run SIGKILLs
+    itself (GRAFT_CHAOS) mid-stream; the group supervisor relaunches and
+    the resumed rank 0 seeks its queue to the checkpoint's stamped
+    ``stream_offset`` — the early directives (consumed before the kill)
+    apply exactly once, and the final state is bit-exact vs the
+    uninterrupted ingestion of the same stream."""
+    run_dir = tmp_path / "mh"
+    run_dir.mkdir()
+    final = tmp_path / "final.npz"
+    stream = tmp_path / "full.ndjsonl"
+    write_stream(str(stream), MH_STREAM)
+
+    proc = subprocess.run(
+        _mh_cmd(run_dir, final, stream, stall="30", coast="0.05",
+                procs="2,2"),
+        env=_mh_env(GRAFT_CHAOS="kill@1:8", GRAFT_MH_PEER_TIMEOUT_S="6",
+                    GRAFT_MH_ABORT_GRACE_S="3",
+                    GRAFT_MH_BEAT_INTERVAL_S="0.5"),
+        cwd=REPO, capture_output=True, text=True, timeout=560)
+    journal = [json.loads(ln)
+               for ln in (run_dir / "mh_journal.jsonl").read_text()
+               .splitlines()]
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, journal)
+    # the relaunch really happened
+    assert any(r["kind"] == "mh_failure" for r in journal)
+    assert len([r for r in journal if r["kind"] == "mh_attempt"]) >= 2
+    # the surviving checkpoint sidecar carries the ingestion cursor
+    from go_libp2p_pubsub_tpu.sim import checkpoint
+    ck = run_dir / "ckpt"
+    stamped = [checkpoint.sidecar_meta(str(ck / p)[:-len(".npz")])
+               .get("stream_offset")
+               for p in os.listdir(ck) if p.endswith(".npz")]
+    assert stamped and all(s is not None for s in stamped)
+    # exactly-once across the group relaunch: bit-exact final state
+    _assert_dump_equals(final, mh_reference)

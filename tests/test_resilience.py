@@ -539,6 +539,7 @@ def _reference_state(ticks: int):
     return run_keys(st, cfg, tp, keys)
 
 
+@pytest.mark.slow
 def test_mh_supervisor_sigkill_relaunch_elastic_bit_exact(tmp_path):
     """ISSUE 14 acceptance: rank 1 of a 2-process CPU run SIGKILLs itself
     (GRAFT_CHAOS) at the speculation of chunk [4,6) — after the t=2
