@@ -196,7 +196,8 @@ NAMES = ["1k_single_topic", "fleet_256x1k", "10k_beacon",
          "powerlaw_100k", "powerlaw_1m", "powerlaw_10m",
          "heavytail_eclipse",
          "powerlaw_100k_mh", "powerlaw_10m_mh",
-         "ingest_1k", "ingest_10k", "headline"]
+         "ingest_1k", "ingest_10k",
+         "verdict_1k", "verdict_10k", "headline"]
 # execution order puts headline FIRST (banked before anything can time
 # out — losing it cost round 5 its record, VERDICT r5 weak #2) and its
 # line is re-emitted LAST so the driver's single-line stdout parse still
@@ -239,7 +240,12 @@ TICKS_DEFAULT = {"1k_single_topic": 300, "10k_beacon": 60,
                  "powerlaw_100k_mh": 10, "powerlaw_10m_mh": 2,
                  # live command plane (ISSUE 19): windows long enough for
                  # a >=4-chunk supervised cadence with boundary drains
-                 "ingest_1k": 120, "ingest_10k": 24}
+                 "ingest_1k": 120, "ingest_10k": 24,
+                 # live contract verdict plane (ISSUE 20): same cadence
+                 # as the ingest pair — >=4 chunk boundaries so the
+                 # per-boundary monitor fold is amortized the way a real
+                 # supervised stream amortizes it
+                 "verdict_1k": 120, "verdict_10k": 24}
 
 
 def _fleet_b() -> int:
@@ -745,6 +751,112 @@ def bench_ingest(name: str, ticks: int, repeats: int) -> str:
     return line
 
 
+# full peer counts of the verdict-plane pair (ISSUE 20) — parent-safe
+# like INGEST_FULL_N; capped runs are labeled by what ran
+VERDICT_FULL_N = {"verdict_1k": 1024, "verdict_10k": 10_000}
+
+
+def bench_verdict(name: str, ticks: int, repeats: int) -> str:
+    """Live contract verdict plane overhead (ISSUE 20): the SAME
+    supervised telemetry window run twice — journaling only (contracts
+    off) vs carrying one streaming monitor of EACH kind
+    (sim/adversary.py ContractMonitors, verdict notes journaled at every
+    status transition). The fold is host-side at chunk confirm time,
+    off the chip's critical path, so the A/B prices exactly what the
+    verdict plane adds: the per-row monitor folds plus the transition
+    notes. ``value`` is the monitored hb/s; the parity assert re-judges
+    the journaled rows full-batch where the number is banked — a
+    monitor that drifted from its contract cannot bank a line."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    from go_libp2p_pubsub_tpu.sim import adversary, scenarios, telemetry
+    from go_libp2p_pubsub_tpu.sim.supervisor import (SupervisorConfig,
+                                                     supervised_run)
+
+    n = _cap_peers(VERDICT_FULL_N[name])
+    cfg, tp, st = scenarios.single_topic_1k(n_peers=n) \
+        if name == "verdict_1k" else scenarios.beacon_10k(n_peers=n)
+    key = jax.random.PRNGKey(7)
+    chunk = max(1, ticks // 4)
+    # one monitor of each kind, shaped to stay live over the whole
+    # window (every row folds into all three — the worst-case fold)
+    contracts = (
+        adversary.DeliveryFloor(floor=0.0, start=0),
+        adversary.RecoveryCeiling(after=0, within=ticks + 1, floor=0.0),
+        adversary.ScoreResponse(by=ticks * 2, attacker_frac=0.5),
+    )
+    tmp = tempfile.mkdtemp(prefix="graft_verdict_bench_")
+    rtt = _fetch_rtt()
+
+    def run_once(leg, monitored):
+        health = os.path.join(tmp, f"{leg}.jsonl")
+        if os.path.exists(health):
+            os.remove(health)
+        sup = SupervisorConfig(
+            chunk_ticks=chunk, max_retries=0, backoff_base_s=0.0,
+            health_path=health,
+            contracts=contracts if monitored else ())
+        out, _rep = supervised_run(st, cfg, tp, key, ticks, sup)
+        np.asarray(out.tick)
+        return health
+
+    run_once("warm", True)      # compile + warm both code paths
+    legs = {}
+    for leg, monitored in (("unmonitored", False), ("monitored", True)):
+        hb = []
+        health = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            health = run_once(leg, monitored)
+            raw = time.perf_counter() - t0
+            dt = max(raw - rtt, raw * 0.05)
+            hb.append(ticks / dt)
+        legs[leg] = {"hbps": round(statistics.median(hb), 2),
+                     "health": health}
+    # parity priced where the number is banked: the monitors' journaled
+    # final verdicts must equal the full-batch evaluation of the same
+    # journaled rows — and at least one transition note must exist
+    j = telemetry.read_journal(legs["monitored"]["health"])
+    notes = [x for x in j["notes"] if x.get("kind") == "contract_verdict"]
+    assert notes, "monitored leg journaled no contract_verdict notes"
+    latest = {}
+    for v in notes:
+        if v["contract"] not in latest \
+                or v["seq"] >= latest[v["contract"]]["seq"]:
+            latest[v["contract"]] = v
+    batch = adversary.evaluate_contracts(contracts, j["rows"], final=True)
+    assert [latest[i]["status"] for i in range(len(contracts))] \
+        == [r.status for r in batch], "monitor verdicts drifted from batch"
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    mon, unmon = legs["monitored"]["hbps"], legs["unmonitored"]["hbps"]
+    platform = jax.devices()[0].platform
+    line = json.dumps({
+        "metric": f"network_heartbeats_per_sec@{_label(name)}[{platform}]",
+        "value": mon,
+        "unit": "heartbeats/s",
+        "platform": platform,
+        "vs_baseline": round(mon / TARGET_HBPS, 4),
+        "repeats": repeats,
+        "ticks_per_window": ticks,
+        "fetch_rtt_ms": round(rtt * 1e3, 1),
+        "n_peers": cfg.n_peers,
+        "chunk_ticks": chunk,
+        "n_contracts": len(contracts),
+        "monitored_hbps": mon,
+        "unmonitored_hbps": unmon,
+        "verdict_overhead_pct":
+            round((unmon / mon - 1.0) * 100.0, 2) if mon else None,
+        "verdict_notes": len(notes),
+        **_memory_record(cfg),
+    })
+    print(line, flush=True)
+    return line
+
+
 def bench_bucketed(name: str, ticks: int, repeats: int) -> str:
     """Heavy-tailed underlay lines (sim/bucketed.py): the degree-bucketed
     execution path measured through ``bucketed_run``, with the graph's
@@ -949,6 +1061,11 @@ def run_scenario(name: str) -> str | None:
         # loop with boundary directive drains; sweep knobs don't apply
         return bench_ingest(name, ticks, repeats)
 
+    if name in VERDICT_FULL_N:
+        # the verdict-plane pair (ISSUE 20) rides the supervised loop
+        # with streaming contract monitors; sweep knobs don't apply
+        return bench_verdict(name, ticks, repeats)
+
     if name in POWERLAW_FULL_N:
         # the heavy-tail family rides the bucketed execution path
         # (sim/bucketed.bucketed_run); the kernel-mode sweep knobs don't
@@ -1036,7 +1153,7 @@ def run_scenario(name: str) -> str | None:
                             "telemetry_10k", "supervised_overlap_1k",
                             "supervised_overlap_10k"} \
         | set(POWERLAW_FULL_N) | set(POWERLAW_MH_FULL_N) \
-        | set(INGEST_FULL_N) == set(NAMES), \
+        | set(INGEST_FULL_N) | set(VERDICT_FULL_N) == set(NAMES), \
         "scenario registry drifted from NAMES"
     assert FRONTIER_FULL_N == scenarios.FRONTIER_NS, \
         "bench FRONTIER_FULL_N drifted from scenarios.FRONTIER_NS"
@@ -1207,6 +1324,11 @@ def _label(name: str) -> str:
     if name in INGEST_FULL_N:
         # same capped-label discipline for the live-command-plane pair
         full = INGEST_FULL_N[name]
+        n = _cap_peers(full)
+        return name if n == full else f"{name}_capped_{n // 1000}k"
+    if name in VERDICT_FULL_N:
+        # same capped-label discipline for the verdict-plane pair
+        full = VERDICT_FULL_N[name]
         n = _cap_peers(full)
         return name if n == full else f"{name}_capped_{n // 1000}k"
     return name

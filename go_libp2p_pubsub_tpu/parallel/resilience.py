@@ -55,6 +55,13 @@ import time
 # relaunch supervisor's journal names the abort cause
 EXIT_PEER_DEAD = 43
 
+# verdict-abort exit code: a live behavior contract FAILED under
+# verdict_policy="abort" and the run tore down cleanly at a chunk
+# boundary (sim/supervisor.VerdictAbort). TERMINAL for the relaunch
+# supervisor: the simulated network broke its contract — relaunching
+# would replay the same trajectory into the same breach
+EXIT_VERDICT_ABORT = 44
+
 
 class PeerDeadError(RuntimeError):
     """A peer rank's heartbeat went stale/missing: this rank must abort
@@ -253,6 +260,14 @@ class ChaosPlan:
                                 watchdog trips → coast mode)
         ingest_kill@TICK        the reader stops for good (a SIGKILLed
                                 producer that never comes back)
+        verdict_kill@TICK       rank 0 SIGKILLs itself at the first chunk
+                                boundary >= TICK that detected NEW
+                                contract-verdict transitions — between
+                                the breach and its journaled verdict
+                                (the ISSUE 20 exactly-once drill: the
+                                relaunch re-derives the verdict off the
+                                checkpoint sidecar's monitor state and
+                                journals it exactly once)
 
     Each spec fires ONCE per run directory: the marker file
     ``chaos_<action>_r<rank>_t<tick>.fired`` is written (fsync'd) BEFORE
@@ -269,8 +284,11 @@ class ChaosPlan:
         mine = [s for s in specs if s["rank"] == int(rank)]
         self.ingest_specs = [s for s in mine
                              if s["action"].startswith("ingest_")]
+        self.verdict_specs = [s for s in mine
+                              if s["action"] == "verdict_kill"]
         self.specs = [s for s in mine
-                      if not s["action"].startswith("ingest_")]
+                      if not s["action"].startswith("ingest_")
+                      and s["action"] != "verdict_kill"]
         self.rank = int(rank)
         self.run_dir = run_dir
         self._fired: set = set()
@@ -307,15 +325,22 @@ class ChaosPlan:
                     out.append({"action": "ingest_kill", "rank": 0,
                                 "tick": int(fields[0]), "seconds": 0.0})
                     continue
+                # verdict chaos pins to rank 0 like the ingest family:
+                # the journaled verdict stream is rank 0's
+                if action == "verdict_kill" and len(fields) == 1:
+                    out.append({"action": "verdict_kill", "rank": 0,
+                                "tick": int(fields[0]), "seconds": 0.0})
+                    continue
             except ValueError as e:
                 raise ValueError(
                     f"GRAFT_CHAOS entry {part!r}: {e} — expected "
                     "kill@RANK:TICK, stall@RANK:TICK:SECS, "
-                    "ingest_stall@TICK:SECS or ingest_kill@TICK") from e
+                    "ingest_stall@TICK:SECS, ingest_kill@TICK or "
+                    "verdict_kill@TICK") from e
             raise ValueError(
                 f"GRAFT_CHAOS entry {part!r}: expected kill@RANK:TICK, "
-                "stall@RANK:TICK:SECS, ingest_stall@TICK:SECS or "
-                "ingest_kill@TICK")
+                "stall@RANK:TICK:SECS, ingest_stall@TICK:SECS, "
+                "ingest_kill@TICK or verdict_kill@TICK")
         return out
 
     @classmethod
@@ -364,6 +389,18 @@ class ChaosPlan:
                 self._kill()
             else:
                 self._sleep(spec["seconds"])
+
+    def fire_verdict(self, tick: int) -> None:
+        """The verdict-plane fire point (``sim/supervisor.py``): called
+        at a chunk boundary that detected NEW contract-verdict
+        transitions, AFTER the fold and BEFORE their journal notes are
+        submitted — the exact window the exactly-once scheme must
+        survive. Same once-per-run-dir fsync'd-marker discipline."""
+        for spec in self.verdict_specs:
+            if tick < spec["tick"] \
+                    or not self._claim(spec, {"chunk_start": tick}):
+                continue
+            self._kill()
 
     def fire_ingest(self, chunk_start: int, queue) -> None:
         """The command-plane fire point (``CommandQueue.frame_for``):

@@ -56,7 +56,9 @@ is disabled — a broken assertion cannot silently pass (tier-1 pinned).
 
 from __future__ import annotations
 
+import base64
 import dataclasses
+import json
 
 import numpy as np
 
@@ -252,12 +254,63 @@ def contract_to_json(c) -> dict:
     return d
 
 
+# field-level validation schema for contract_from_json: name ->
+# (allow_none, lo, hi, int_only). Bounds are inclusive; None disables
+# that edge. Kept declarative so the fuzz surface (wrong types,
+# out-of-range windows, unknown kinds/fields) is refused BY NAME, never
+# a crash — the same discipline the PR 19 directive parser applies.
+_CONTRACT_FIELDS = {
+    "delivery_floor": {"floor": (False, 0.0, 1.0, False),
+                       "start": (False, 0, None, True),
+                       "end": (True, 0, None, True),
+                       "topic": (True, 0, None, True)},
+    "recovery_ceiling": {"after": (False, 0, None, True),
+                         "within": (False, 1, None, True),
+                         "floor": (False, 0.0, 1.0, False),
+                         "topic": (True, 0, None, True)},
+    "score_response": {"by": (False, 0, None, True),
+                       "attacker_frac": (False, 0.0, 1.0, False),
+                       "honest_max_frac": (False, 0.0, 1.0, False),
+                       "start": (False, 0, None, True)},
+}
+
+
 def contract_from_json(d: dict):
+    if not isinstance(d, dict):
+        raise ValueError(f"contract spec must be a JSON object, "
+                         f"got {type(d).__name__}")
     d = dict(d)
-    kind = d.pop("kind")
-    if kind not in CONTRACT_KINDS:
+    kind = d.pop("kind", None)
+    if not isinstance(kind, str) or kind not in CONTRACT_KINDS:
         raise ValueError(f"unknown contract kind {kind!r}; "
                          f"known: {sorted(CONTRACT_KINDS)}")
+    schema = _CONTRACT_FIELDS[kind]
+    unknown = sorted(set(d) - set(schema))
+    if unknown:
+        raise ValueError(f"contract {kind!r}: unknown field(s) {unknown}; "
+                         f"known: {sorted(schema)}")
+    for name, (allow_none, lo, hi, int_only) in schema.items():
+        if name not in d:
+            continue
+        v = d[name]
+        if v is None:
+            if allow_none:
+                continue
+            raise ValueError(f"contract {kind!r}: field {name!r} "
+                             f"must not be null")
+        if isinstance(v, bool) or \
+                not isinstance(v, int if int_only else (int, float)):
+            raise ValueError(
+                f"contract {kind!r}: field {name!r} must be "
+                f"{'an integer' if int_only else 'a number'}, got {v!r}")
+        if (lo is not None and v < lo) or (hi is not None and v > hi):
+            raise ValueError(f"contract {kind!r}: field {name!r} "
+                             f"out of range ({v!r} not in "
+                             f"[{lo}, {'inf' if hi is None else hi}])")
+    end, start = d.get("end"), d.get("start", 0)
+    if kind == "delivery_floor" and end is not None and end <= start:
+        raise ValueError(f"contract {kind!r}: empty census window "
+                         f"[{start}, {end})")
     return CONTRACT_KINDS[kind](**d)
 
 
@@ -295,6 +348,329 @@ def contracts_from_schedule(windows: list) -> tuple:
         out.append(ScoreResponse(by=max(ends) + 5 if ends else 1 << 30,
                                  attacker_frac=0.25, honest_max_frac=0.1))
     return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# streaming contract monitors (ISSUE 20): O(1)-state incremental
+# evaluators, bit-exact vs the batch evaluate() at EVERY prefix of a
+# tick-monotone row stream (status, detail string, measured dict — the
+# tier-1 parity pins in tests/test_verdict_plane.py). Monitor state is
+# JSON-serializable so checkpoint sidecars can carry it next to
+# stream_offset and a SIGKILL→relaunch resumes verdict evaluation
+# exactly-once. fold() never builds a ContractResult; status() is the
+# per-row fast path (a few comparisons) and result() is built lazily
+# only when a status transition fires.
+
+
+class DeliveryFloorMonitor:
+    """Streaming DeliveryFloor: running (min, argmin) over the census
+    window plus the row/tick counters the batch detail strings read."""
+
+    def __init__(self, contract: DeliveryFloor):
+        self.c = contract
+        self.n_rows = 0
+        self.n_win = 0
+        self.min_v: float | None = None
+        self.min_at = -1
+        self.last = -1
+
+    def fold(self, row: dict) -> None:
+        c = self.c
+        self.n_rows += 1
+        t = row["tick"]
+        if t > self.last:
+            self.last = t
+        end = c.end if c.end is not None else (1 << 30)
+        if c.start <= t < end:
+            v = _row_delivery(row, c.topic)
+            if self.min_v is None or (v, t) < (self.min_v, self.min_at):
+                self.min_v, self.min_at = v, t
+            self.n_win += 1
+
+    def status(self, final: bool = False) -> str:
+        c = self.c
+        if self.n_win == 0:
+            return "pending" if (not final and self.last < c.start) \
+                else "fail"
+        if self.min_v < c.floor:
+            return "fail"
+        if not final and c.end is not None and self.last < c.end - 1:
+            return "pending"
+        return "pass"
+
+    def result(self, final: bool = False) -> ContractResult:
+        c = self.c
+        end = c.end if c.end is not None else (1 << 30)
+        if self.n_win == 0:
+            if not final and self.last < c.start:
+                return ContractResult(c.kind, "pending",
+                                      "census window not reached", {})
+            return ContractResult(
+                c.kind, "fail",
+                f"no rows in census window [{c.start}, {end})",
+                {"rows": self.n_rows})
+        worst, at = self.min_v, self.min_at
+        return ContractResult(
+            c.kind, self.status(final),
+            f"min delivery {worst:.4f} @ tick {at} vs floor {c.floor}"
+            + (f" (topic {c.topic})" if c.topic is not None else ""),
+            {"min_delivery": round(worst, 4), "at_tick": at,
+             "floor": c.floor})
+
+    def state(self) -> dict:
+        return {"n_rows": self.n_rows, "n_win": self.n_win,
+                "min_v": self.min_v, "min_at": self.min_at,
+                "last": self.last}
+
+    def load(self, s: dict) -> None:
+        self.n_rows, self.n_win = int(s["n_rows"]), int(s["n_win"])
+        self.min_v = None if s["min_v"] is None else float(s["min_v"])
+        self.min_at, self.last = int(s["min_at"]), int(s["last"])
+
+
+class RecoveryCeilingMonitor:
+    """Streaming RecoveryCeiling: earliest post-heal tick that cleared
+    the floor, plus the last tick seen."""
+
+    def __init__(self, contract: RecoveryCeiling):
+        self.c = contract
+        self.rec: int | None = None
+        self.last = -1
+
+    def fold(self, row: dict) -> None:
+        c = self.c
+        t = row["tick"]
+        if t > self.last:
+            self.last = t
+        if t >= c.after and _row_delivery(row, c.topic) >= c.floor:
+            if self.rec is None or t < self.rec:
+                self.rec = t
+
+    def status(self, final: bool = False) -> str:
+        c = self.c
+        if self.rec is not None and self.rec - c.after <= c.within:
+            return "pass"
+        if self.last < c.after + c.within and not final:
+            return "pending"
+        return "fail"
+
+    def result(self, final: bool = False) -> ContractResult:
+        c = self.c
+        rec = self.rec
+        m = {"after": c.after, "within": c.within, "floor": c.floor,
+             "recovered_at": rec}
+        if rec is not None and rec - c.after <= c.within:
+            return ContractResult(
+                c.kind, "pass",
+                f"recovered to >= {c.floor} at tick {rec} "
+                f"({rec - c.after} ticks after heal)", m)
+        if self.last < c.after + c.within and not final:
+            return ContractResult(c.kind, "pending",
+                                  "recovery window still open", m)
+        worst = f"never (last tick {self.last})" if rec is None \
+            else f"tick {rec} ({rec - c.after} > {c.within})"
+        return ContractResult(
+            c.kind, "fail",
+            f"no recovery to >= {c.floor} within {c.within} ticks "
+            f"of {c.after}: {worst}", m)
+
+    def state(self) -> dict:
+        return {"rec": self.rec, "last": self.last}
+
+    def load(self, s: dict) -> None:
+        self.rec = None if s["rec"] is None else int(s["rec"])
+        self.last = int(s["last"])
+
+
+class ScoreResponseMonitor:
+    """Streaming ScoreResponse: earliest qualifying response tick plus
+    the first 8 honest-collateral violation ticks (the batch evaluator
+    only ever exposes ``honest_bad[:8]``, so 8 slots ARE the full
+    state for a tick-monotone stream)."""
+
+    def __init__(self, contract: ScoreResponse):
+        self.c = contract
+        self.resp: int | None = None
+        self.honest_bad: list = []
+        self.last = -1
+
+    def fold(self, row: dict) -> None:
+        c = self.c
+        t = row["tick"]
+        if t > self.last:
+            self.last = t
+        att = row.get("attacker_edges", 0)
+        if att > 0 and row.get("attacker_graylisted", 0) \
+                >= c.attacker_frac * att:
+            if self.resp is None or t < self.resp:
+                self.resp = t
+        honest_edges = max(row.get("connected_edges", 0) - att, 1)
+        if t >= c.start and row.get("honest_graylisted", 0) \
+                > c.honest_max_frac * honest_edges \
+                and len(self.honest_bad) < 8:
+            self.honest_bad.append(t)
+
+    def status(self, final: bool = False) -> str:
+        c = self.c
+        if self.honest_bad:
+            return "fail"
+        if c.attacker_frac <= 0.0:
+            return "pass"
+        if self.resp is not None and self.resp <= c.by:
+            return "pass"
+        if self.last < c.by and not final:
+            return "pending"
+        return "fail"
+
+    def result(self, final: bool = False) -> ContractResult:
+        c = self.c
+        m = {"by": c.by, "attacker_frac": c.attacker_frac,
+             "responded_at": self.resp,
+             "honest_violations": list(self.honest_bad)}
+        if self.honest_bad:
+            return ContractResult(
+                c.kind, "fail",
+                f"honest graylisting above {c.honest_max_frac:.2%} of "
+                f"honest edges at tick(s) {self.honest_bad}", m)
+        if c.attacker_frac <= 0.0:
+            return ContractResult(c.kind, "pass",
+                                  "no honest peer graylisted", m)
+        if self.resp is not None and self.resp <= c.by:
+            return ContractResult(
+                c.kind, "pass",
+                f">= {c.attacker_frac:.0%} of attacker edges "
+                f"graylisted by tick {self.resp} (<= {c.by})", m)
+        if self.last < c.by and not final:
+            return ContractResult(c.kind, "pending",
+                                  "response window still open", m)
+        return ContractResult(
+            c.kind, "fail",
+            f"attackers not graylisted to {c.attacker_frac:.0%} "
+            f"by tick {c.by} (responded_at={self.resp})", m)
+
+    def state(self) -> dict:
+        return {"resp": self.resp, "honest_bad": list(self.honest_bad),
+                "last": self.last}
+
+    def load(self, s: dict) -> None:
+        self.resp = None if s["resp"] is None else int(s["resp"])
+        self.honest_bad = [int(t) for t in s["honest_bad"]]
+        self.last = int(s["last"])
+
+
+MONITOR_KINDS = {"delivery_floor": DeliveryFloorMonitor,
+                 "recovery_ceiling": RecoveryCeilingMonitor,
+                 "score_response": ScoreResponseMonitor}
+
+
+def monitor_for(contract):
+    return MONITOR_KINDS[contract.kind](contract)
+
+
+class ContractMonitors:
+    """A contract set folded one row at a time, emitting VERDICT
+    TRANSITION events — the journaled ``contract_verdict`` stream. Each
+    event carries a deterministic id (contract index, transition seq,
+    status, decided tick); the tick is a pure function of the row
+    stream, NOT of chunking, so a relaunch that re-folds rows past its
+    checkpoint re-derives byte-identical events and read-side dedup
+    (telemetry.read_journal / the dashboard tailer) absorbs any note
+    journaled before the crash — exactly-once without a write-side
+    transaction."""
+
+    STATE_VERSION = 1
+
+    def __init__(self, contracts):
+        self.contracts = tuple(contracts)
+        self.monitors = [monitor_for(c) for c in self.contracts]
+        self.statuses = ["pending"] * len(self.monitors)
+        self.seqs = [0] * len(self.monitors)
+        self.finalized = False
+
+    def fold_rows(self, rows) -> list:
+        """Fold rows in stream order; return the transition events they
+        produced (possibly none), in firing order."""
+        events = []
+        for row in rows:
+            t = row["tick"]
+            for i, mon in enumerate(self.monitors):
+                mon.fold(row)
+                st = mon.status(final=False)
+                if st != self.statuses[i]:
+                    self.statuses[i] = st
+                    self.seqs[i] += 1
+                    events.append(self._event(i, mon.result(final=False),
+                                              t))
+        return events
+
+    def finalize(self) -> list:
+        """The true-run-end pass: resolve every still-pending contract
+        with ``final=True`` semantics (a too-short stream fails by
+        name). Idempotent across a relaunch — re-finalizing re-derives
+        the same ids, which read-side dedup absorbs."""
+        self.finalized = True
+        events = []
+        for i, mon in enumerate(self.monitors):
+            st = mon.status(final=True)
+            if st != self.statuses[i]:
+                self.statuses[i] = st
+                self.seqs[i] += 1
+                events.append(self._event(i, mon.result(final=True),
+                                          mon.last, final=True))
+        return events
+
+    def _event(self, i: int, res: ContractResult, tick, final=False):
+        seq = self.seqs[i]
+        return {"contract": i, "kind": res.kind, "seq": seq,
+                "status": res.status, "detail": res.detail,
+                "measured": res.measured, "tick": int(tick),
+                "final": bool(final),
+                "id": f"c{i}.s{seq}.{res.status}@{int(tick)}"}
+
+    def results(self, final: bool = False) -> list:
+        return [m.result(final=final) for m in self.monitors]
+
+    @property
+    def any_failed(self) -> bool:
+        return "fail" in self.statuses
+
+    # -- checkpoint-sidecar serialization ---------------------------------
+    # sidecar values must be whitespace-free (checkpoint.sidecar_meta
+    # splits the file on whitespace), hence the base64url token form
+
+    def to_state(self) -> dict:
+        return {"v": self.STATE_VERSION,
+                "contracts": contracts_to_json(self.contracts),
+                "statuses": list(self.statuses),
+                "seqs": list(self.seqs),
+                "finalized": self.finalized,
+                "monitors": [m.state() for m in self.monitors]}
+
+    @classmethod
+    def from_state(cls, state: dict, contracts=None) -> "ContractMonitors":
+        cs = contracts_from_json(state["contracts"])
+        if contracts is not None and tuple(contracts) != cs:
+            raise ValueError(
+                "checkpointed monitor state does not match the active "
+                "contract set; refusing a silent verdict reset")
+        self = cls(cs)
+        self.statuses = [str(s) for s in state["statuses"]]
+        self.seqs = [int(s) for s in state["seqs"]]
+        self.finalized = bool(state.get("finalized", False))
+        for mon, s in zip(self.monitors, state["monitors"]):
+            mon.load(s)
+        return self
+
+    def state_token(self) -> str:
+        raw = json.dumps(self.to_state(),
+                         separators=(",", ":")).encode("utf-8")
+        return base64.urlsafe_b64encode(raw).decode("ascii")
+
+    @classmethod
+    def from_token(cls, token: str, contracts=None) -> "ContractMonitors":
+        raw = base64.urlsafe_b64decode(token.encode("ascii"))
+        return cls.from_state(json.loads(raw.decode("utf-8")),
+                              contracts=contracts)
 
 
 # ---------------------------------------------------------------------------

@@ -58,6 +58,12 @@ Directive grammar (one JSON object per line)::
     {"op": "leave",   "tick": T, "peer": P, "topic": C}
     {"op": "attack",  "tick": T, "kind": "storm", "topic": C,
      "peers": [P0, P1, ...]}        # coordinated publish storm
+    {"op": "attack",  "tick": T, "kind": "eclipse",
+     "peers": [P0, ...]}            # cut targets' honest<->honest edges
+    {"op": "attack",  "tick": T, "kind": "censor",
+     "peers": [P0, ...]}            # flip peers into censoring actors
+    {"op": "compose", "tick": T, "parts": [{...}, ...]}
+                                    # several tickless parts, one boundary
     {"op": "tick", "tick": T}       # watermark only: "stream covers < T"
     {"op": "end"}                   # producer finished (clean EOF)
 
@@ -90,6 +96,14 @@ OP_NOP = 0
 OP_JOIN = 8
 OP_LEAVE = 9
 OP_PUBLISH = 10
+
+# command-plane-only op codes (ISSUE 20): deliberately OUTSIDE replay's
+# op space [0, N_OPS=14) — apply_frame masks them to NOP before the
+# replay scan (lax.switch would clamp them onto DISCONNECT otherwise)
+# and routes them through the jitted attack pass instead
+ATTACK_OP_BASE = 16
+OP_ECLIPSE = 16     # a=target peer: cut its honest<->honest edges
+OP_CENSOR = 17      # a=peer: flip it into a censoring spam actor
 
 # trace-event types that map onto live directives; everything else in
 # the L5 schema is router bookkeeping the engine derives itself
@@ -181,35 +195,109 @@ def parse_line(line, *, n_peers: int, n_topics: int,
         c = _int_field(d, "topic", 0, n_topics, op)
         return Parsed(((op, p, c),), _tick_of(d, op), "directive")
     if op == "attack":
-        kind = d.get("kind")
-        if kind != "storm":
-            raise DirectiveError(
-                f"directive 'attack': unknown kind {kind!r} (supported: "
-                "'storm' — a coordinated publish storm from the listed "
-                "peers)")
-        c = _int_field(d, "topic", 0, n_topics, "attack")
-        peers = d.get("peers")
-        if not isinstance(peers, list) or not peers:
-            raise DirectiveError(
-                "directive 'attack': field 'peers' must be a non-empty "
-                "list of peer ids")
-        if len(peers) > max_batch:
-            raise DirectiveError(
-                f"directive 'attack': batch of {len(peers)} peers exceeds "
-                f"max_batch={max_batch} — split the window into smaller "
-                "directives")
-        ops = []
-        for p in peers:
-            if not isinstance(p, int) or isinstance(p, bool) \
-                    or not 0 <= p < n_peers:
-                raise DirectiveError(
-                    f"directive 'attack': peer {p!r} out of range "
-                    f"[0, {n_peers})")
-            ops.append(("publish", p, c))
+        ops = _attack_ops(d, n_peers=n_peers, n_topics=n_topics,
+                          max_batch=max_batch)
         return Parsed(tuple(ops), _tick_of(d, "attack"), "directive")
+    if op == "compose":
+        ops = _compose_ops(d, n_peers=n_peers, n_topics=n_topics,
+                           max_batch=max_batch)
+        return Parsed(tuple(ops), _tick_of(d, "compose"), "directive")
     raise DirectiveError(
         f"directive op {op!r} unknown (supported: publish, join, leave, "
-        "attack, tick, end)")
+        "attack, compose, tick, end)")
+
+
+_ATTACK_KINDS = ("storm", "eclipse", "censor")
+
+
+def _attack_ops(d: dict, *, n_peers: int, n_topics: int,
+                max_batch: int) -> list:
+    """The ``attack`` directive body shared by the top-level line and
+    ``compose`` parts: kind + peers → primitive ops, every malformation
+    refused BY NAME."""
+    kind = d.get("kind")
+    if kind not in _ATTACK_KINDS:
+        raise DirectiveError(
+            f"directive 'attack': unknown kind {kind!r} (supported: "
+            "'storm' — a coordinated publish storm from the listed "
+            "peers; 'eclipse' — cut the listed targets' honest edges; "
+            "'censor' — flip the listed peers into censoring spam "
+            "actors; combine kinds with op 'compose')")
+    if kind == "storm":
+        c = _int_field(d, "topic", 0, n_topics, "attack")
+    else:
+        if "topic" in d:
+            raise DirectiveError(
+                f"directive 'attack': kind {kind!r} takes no 'topic' "
+                "field (it acts on peers, not a topic)")
+        c = 0
+    peers = d.get("peers")
+    if not isinstance(peers, list) or not peers:
+        raise DirectiveError(
+            "directive 'attack': field 'peers' must be a non-empty "
+            "list of peer ids")
+    if len(peers) > max_batch:
+        raise DirectiveError(
+            f"directive 'attack': batch of {len(peers)} peers exceeds "
+            f"max_batch={max_batch} — split the window into smaller "
+            "directives")
+    prim = {"storm": "publish", "eclipse": "eclipse",
+            "censor": "censor"}[kind]
+    ops = []
+    for p in peers:
+        if not isinstance(p, int) or isinstance(p, bool) \
+                or not 0 <= p < n_peers:
+            raise DirectiveError(
+                f"directive 'attack': peer {p!r} out of range "
+                f"[0, {n_peers})")
+        ops.append((prim, p, c))
+    return ops
+
+
+def _compose_ops(d: dict, *, n_peers: int, n_topics: int,
+                 max_batch: int) -> list:
+    """The ``compose`` form (ISSUE 20): one timed line carrying several
+    directive parts that land at the SAME boundary — the composed attack
+    scenarios ROADMAP item 2 names (eclipse+censorship on one region,
+    storms against the gater's RED admission). Parts are ordinary
+    directive objects WITHOUT their own tick; nesting is refused."""
+    parts = d.get("parts")
+    if not isinstance(parts, list) or not parts:
+        raise DirectiveError(
+            "directive 'compose': field 'parts' must be a non-empty "
+            "list of directive objects")
+    ops: list = []
+    for i, part in enumerate(parts):
+        if not isinstance(part, dict):
+            raise DirectiveError(
+                f"directive 'compose': part {i} must be a JSON object, "
+                f"got {type(part).__name__}")
+        if "tick" in part:
+            raise DirectiveError(
+                f"directive 'compose': part {i} must not carry its own "
+                "tick — the compose line's tick times every part")
+        pop = part.get("op")
+        if pop == "compose":
+            raise DirectiveError(
+                "directive 'compose': parts cannot nest another compose")
+        if pop in ("publish", "join", "leave"):
+            p = _int_field(part, "peer", 0, n_peers, pop)
+            c = _int_field(part, "topic", 0, n_topics, pop)
+            ops.append((pop, p, c))
+        elif pop == "attack":
+            ops.extend(_attack_ops(part, n_peers=n_peers,
+                                   n_topics=n_topics,
+                                   max_batch=max_batch))
+        else:
+            raise DirectiveError(
+                f"directive 'compose': part {i} op {pop!r} unknown "
+                "(supported parts: publish, join, leave, attack)")
+    if len(ops) > max_batch:
+        raise DirectiveError(
+            f"directive 'compose': {len(ops)} primitive ops exceed "
+            f"max_batch={max_batch} — split the scenario into smaller "
+            "compose lines")
+    return ops
 
 
 def _parse_trace_event(d: dict, *, n_peers: int, n_topics: int,
@@ -312,13 +400,81 @@ def apply_frame(state, cfg, tp, frame: Frame):
     key — use the BASE config (not the degrade ladder's exec config) so
     the apply compiles exactly once per run. Works unchanged on sharded
     multihost states: the ops index global peer rows and XLA keeps the
-    scatter/gather rank-symmetric."""
+    scatter/gather rank-symmetric.
+
+    Attack lanes (``op >= ATTACK_OP_BASE``) live OUTSIDE replay's op
+    space — lax.switch would clamp them onto DISCONNECT — so they are
+    masked to NOP before the replay scan and routed through a separate
+    jitted attack pass. The mask + extra dispatch is priced only on
+    frames that actually carry attack ops, keeping the common path at
+    ONE replay trace."""
     import jax.numpy as jnp
 
     from ..trace.replay import replay
-    return replay(state, cfg, tp, jnp.asarray(frame.op),
-                  jnp.asarray(frame.a), jnp.asarray(frame.b),
-                  jnp.asarray(frame.c))
+    op_h = np.asarray(frame.op)
+    has_attack = bool((op_h >= ATTACK_OP_BASE).any())
+    rep_op = np.where(op_h >= ATTACK_OP_BASE,
+                      np.int32(OP_NOP), op_h) if has_attack else frame.op
+    state = replay(state, cfg, tp, jnp.asarray(rep_op),
+                   jnp.asarray(frame.a), jnp.asarray(frame.b),
+                   jnp.asarray(frame.c))
+    if has_attack:
+        state = _attack_apply_fn()(state, cfg, tp, jnp.asarray(op_h),
+                                   jnp.asarray(frame.a))
+    return state
+
+
+_attack_jit = None
+
+
+def _attack_apply_fn():
+    """Lazily-built jitted attack pass for OP_ECLIPSE/OP_CENSOR lanes.
+
+    Censor flips the listed peers into spam actors (``state.malicious``
+    — they answer no IWANTs and are counted by faults.attacker_mask, so
+    ScoreResponse contracts see them). Eclipse cuts every honest<->
+    honest edge crossing the target boundary through churn's
+    take_edges_down — the same edge-symmetric construction as
+    faults.edge_cut_mask, but driven by the directive's explicit peer
+    list instead of a prefix fraction. Sybil edges stay up: an eclipsed
+    peer keeps its attacker links, the classic eclipse topology."""
+    global _attack_jit
+    if _attack_jit is None:
+        from functools import partial
+
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.churn import take_edges_down
+        from .invariants import FAULT_CENSOR, FAULT_ECLIPSE
+
+        @partial(jax.jit, static_argnames=("cfg",))
+        def fn(state, cfg, tp, op, a):
+            n = state.neighbors.shape[0]
+            lanes = jnp.clip(a, 0, n - 1)
+            # scatter-max: NOP lanes carry False and cannot pollute
+            tgt = jnp.zeros(n, bool).at[lanes].max(op == OP_ECLIPSE)
+            coh = jnp.zeros(n, bool).at[lanes].max(op == OP_CENSOR)
+            any_ecl = tgt.any()
+            any_cen = coh.any()
+            malicious = state.malicious | coh
+            honest = ~malicious
+            known = (state.neighbors >= 0) & (state.reverse_slot >= 0)
+            nbr = jnp.clip(state.neighbors, 0, n - 1)
+            cross = ((tgt[:, None] ^ tgt[nbr])
+                     & honest[:, None] & honest[nbr] & known)
+            go_down = cross & state.connected & any_ecl
+            state = state._replace(malicious=malicious)
+            state = take_edges_down(state, cfg, tp, go_down)
+            flags = (state.fault_flags
+                     | jnp.where(any_ecl, jnp.uint32(FAULT_ECLIPSE),
+                                 jnp.uint32(0))
+                     | jnp.where(any_cen, jnp.uint32(FAULT_CENSOR),
+                                 jnp.uint32(0)))
+            return state._replace(fault_flags=flags)
+
+        _attack_jit = fn
+    return _attack_jit
 
 
 class _Entry(NamedTuple):
@@ -642,6 +798,12 @@ class CommandQueue:
                 # engine's own msg-ring semantics
                 op_b = (chunk_start * self.slots + i) % self.msg_window
                 b[i] = op_b
+            elif kind == "eclipse":
+                op[i] = OP_ECLIPSE
+                b[i] = -1
+            elif kind == "censor":
+                op[i] = OP_CENSOR
+                b[i] = -1
             else:
                 op[i] = OP_JOIN if kind == "join" else OP_LEAVE
                 b[i] = -1

@@ -78,7 +78,8 @@ promise timeouts, gossipsub v1.1 hardening).
 Env knobs (``SupervisorConfig.from_env``): ``GRAFT_CHUNK_TICKS``,
 ``GRAFT_DEADLINE_S``, ``GRAFT_CRASH_DIR``, ``GRAFT_CHECKPOINT_DIR``,
 ``GRAFT_HEALTH_STREAM``, ``GRAFT_ASYNC_CHUNKS`` (``0`` disables the
-pipeline), ``GRAFT_WRITER_QUEUE``.
+pipeline), ``GRAFT_WRITER_QUEUE``, ``GRAFT_VERDICT_POLICY`` (the live
+contract-verdict FAIL response: journal | snapshot | abort).
 
 The fleet plane (sim/fleet.py) builds its batched-run supervision on the
 SAME primitives — ``SupervisorConfig``/``SupervisorReport``, the
@@ -137,6 +138,23 @@ class SupervisorCrash(RuntimeError):
 
 class ChunkDeadline(RuntimeError):
     """A chunk overran its wall-clock deadline (transient: retried)."""
+
+
+class VerdictAbort(RuntimeError):
+    """A live behavior contract FAILED under ``verdict_policy="abort"``:
+    the run tore down cleanly at the chunk boundary that detected the
+    breach (checkpoint written, every verdict note drained to the
+    journal). ``event`` is the failing verdict event (contract index,
+    kind, breach tick, detail), ``report`` the run log up to the
+    teardown. Deliberately NOT a SupervisorCrash: nothing malfunctioned
+    — the simulated network broke its contract and the supervisor
+    responded as configured."""
+
+    def __init__(self, msg: str, event: dict | None = None,
+                 report: "SupervisorReport | None" = None):
+        super().__init__(msg)
+        self.event = event
+        self.report = report
 
 
 @dataclasses.dataclass
@@ -241,6 +259,32 @@ class SupervisorConfig:
     # donated-input catch-up replays from keys alone and would lose the
     # injected directives.
     commands: object | None = None
+    # --- live contract verdict plane (sim/adversary.py monitors) ---
+    # behavior contracts evaluated over the LIVE telemetry stream: each
+    # confirmed chunk's rows fold into O(1)-state ContractMonitors on
+    # the main thread (host-side — the fold never touches the chip's
+    # critical path) and every status transition journals a
+    # `contract_verdict` note through the SAME FIFO writer, BEFORE the
+    # boundary's checkpoint save. The monitor state rides the checkpoint
+    # sidecar (``monitors=``), so a SIGKILL→relaunch re-derives at most
+    # the not-yet-checkpointed transitions — whose deterministic ids the
+    # journal readers dedup: each verdict lands exactly once, no
+    # double-fires, no silently skipped window. Requires the telemetry
+    # lane (health_path) — refused by name otherwise.
+    contracts: tuple = ()
+    # FAIL response policy — never a silent continue, never a retrace:
+    #   "journal"  (default) verdict + contract_alarm note; the
+    #              dashboard raises a banner off the journaled stream
+    #   "snapshot" force an off-cadence checkpoint capturing the breach
+    #              state (named note when no checkpoint_dir is set)
+    #   "abort"    clean named teardown at the boundary that detected
+    #              the breach: checkpoint + verdict_abort note, then
+    #              raise VerdictAbort. Env: GRAFT_VERDICT_POLICY.
+    verdict_policy: str = "journal"
+    # parallel/resilience.ChaosPlan (or any object with fire_verdict):
+    # the verdict_kill@TICK drill fires between detecting a transition
+    # and journaling its note
+    chaos: object | None = None
     # rungs of the degrade ladder applied BEFORE the first chunk. The
     # relaunch supervisor (scripts/mh_supervisor.py) records the agreed
     # rung in its run journal and hands it to every rank via
@@ -270,6 +314,8 @@ class SupervisorConfig:
             kw["writer_queue"] = int(os.environ["GRAFT_WRITER_QUEUE"])
         if os.environ.get("GRAFT_MH_RUNG"):
             kw["initial_degrade"] = int(os.environ["GRAFT_MH_RUNG"])
+        if os.environ.get("GRAFT_VERDICT_POLICY"):
+            kw["verdict_policy"] = os.environ["GRAFT_VERDICT_POLICY"]
         kw.update(overrides)
         return SupervisorConfig(**kw)
 
@@ -796,6 +842,36 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
         ingest.start(ing_off)
         report.log("ingest_start", offset=ing_off)
 
+    # live contract verdict plane: O(1)-state monitors folding each
+    # confirmed chunk's telemetry rows (SupervisorConfig.contracts). On
+    # resume the sidecar's ``monitors=`` token restores the verdict
+    # state exactly where the checkpoint left it — every transition the
+    # checkpointed run already journaled is past those seq counters, so
+    # the relaunch re-derives only the not-yet-durable verdicts.
+    monitors = None
+    if sup.contracts:
+        from .adversary import ContractMonitors
+        if sup.verdict_policy not in ("journal", "snapshot", "abort"):
+            raise ValueError(
+                f"verdict_policy {sup.verdict_policy!r} unknown "
+                "(supported: 'journal', 'snapshot', 'abort')")
+        if sup.health_path is None and not traced:
+            raise ValueError(
+                "live contracts need the telemetry lane: set health_path "
+                "(GRAFT_HEALTH_STREAM) so chunks carry the rows the "
+                "monitors fold")
+        monitors = ContractMonitors(tuple(sup.contracts))
+        if report.resumed_from:
+            tok = checkpoint.sidecar_meta(report.resumed_from) \
+                .get("monitors")
+            if tok:
+                # a contract-set mismatch REFUSES here (from_token) —
+                # never a silent verdict reset
+                monitors = ContractMonitors.from_token(
+                    tok, tuple(sup.contracts))
+                report.log("verdict_resume",
+                           statuses=list(monitors.statuses))
+
     def beat(tick: int, chunk: int) -> None:
         # liveness progress stamp (parallel/resilience.RankLiveness): a
         # shared-fs hiccup must never fail the run itself — the beater
@@ -1014,13 +1090,80 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
                 shed_total=f.shed_total, refused_total=f.refused_total,
                 queue_depth=f.depth, lag_ticks=f.lag, offset=f.offset,
                 coasting=f.coasting))
+        # ---- live contract verdicts: fold THIS chunk's rows into the
+        # monitors (host-side, main thread — the device is already
+        # running the next chunk), journal every status transition, and
+        # arm the configured FAIL response. Ordering is the exactly-once
+        # story: verdict notes enter the FIFO writer BEFORE the
+        # boundary's checkpoint save, so a checkpoint whose sidecar says
+        # "these verdicts happened" can only exist AFTER their notes
+        # were durably journaled; a kill in between re-derives the same
+        # transitions (same rows, same seqs → same deterministic ids)
+        # and the readers dedup.
+        force_ckpt = False
+        abort_ev = None
+        if monitors is not None:
+            rows = None
+            if p.records is not None:
+                from .telemetry import records_to_rows, rows_to_dicts
+                mat, cols = records_to_rows(p.records)
+                rows = rows_to_dicts(mat, cols)
+            elif traced and p.health:
+                rows = list(p.health)
+            new_events = monitors.fold_rows(rows) if rows else []
+            if done >= n_ticks:
+                # TRUE run end only (a bounded window resumes later):
+                # the stream is final — pending contracts settle, the
+                # pending→fail transitions included
+                new_events = new_events + monitors.finalize()
+            if new_events and sup.chaos is not None:
+                # verdict_kill@TICK drill: die between the breach and
+                # its journaled verdict (parallel/resilience.ChaosPlan)
+                fire = getattr(sup.chaos, "fire_verdict", None)
+                if fire is not None:
+                    fire(start_tick + done)
+            for ev in new_events:
+                report.log("contract_verdict", contract=ev["contract"],
+                           kind=ev["kind"], status=ev["status"],
+                           tick=ev["tick"], id=ev["id"])
+                if journal is not None:
+                    # the event's contract kind travels as contract_kind
+                    # in the note: "kind" is the note's own type tag
+                    writer.submit(lambda e=dict(ev): journal.note(
+                        "contract_verdict",
+                        **{("contract_kind" if k == "kind" else k): v
+                           for k, v in e.items()}))
+            failed = [ev for ev in new_events if ev["status"] == "fail"]
+            if failed:
+                # never a silent continue: every policy leaves a named
+                # trail, and only "abort" stops the run
+                if sup.verdict_policy == "abort":
+                    abort_ev = dict(failed[0])
+                    force_ckpt = True   # breach state lands durably
+                elif sup.verdict_policy == "snapshot":
+                    force_ckpt = True   # off-cadence breach checkpoint
+                    if not sup.checkpoint_dir and journal is not None:
+                        writer.submit(lambda e=dict(failed[0]):
+                                      journal.note(
+                            "contract_snapshot_skipped",
+                            reason="no checkpoint_dir",
+                            contract=e["contract"],
+                            contract_kind=e["kind"], tick=e["tick"]))
+                elif journal is not None:       # "journal"
+                    for ev in failed:
+                        writer.submit(lambda e=dict(ev): journal.note(
+                            "contract_alarm", policy="journal",
+                            contract=e["contract"],
+                            contract_kind=e["kind"], tick=e["tick"],
+                            id=e["id"], detail=e["detail"]))
         window_end = sup.max_chunks is not None \
             and report.chunks_run >= sup.max_chunks and done < n_ticks
         # a window end is ALWAYS a boundary: the max_chunks contract says
         # "stop cleanly (checkpoint written if a dir is set)" — without
         # this, a stop off the checkpoint cadence would discard the whole
         # window's progress on resume
-        at_boundary = done >= next_ckpt or done >= n_ticks or window_end
+        at_boundary = done >= next_ckpt or done >= n_ticks or window_end \
+            or force_ckpt
         if at_boundary:
             pause_t0 = time.perf_counter()
             # a boundary output is never donated (speculation held its
@@ -1040,11 +1183,18 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
                 report.checkpoints.append(path)
                 report.log("checkpoint", tick=start_tick + done, path=path)
 
-                # exactly-once stamp: the consumed stream offset as of
-                # THIS chunk's frame rides the sidecar, so a relaunch
-                # replays ingestion from precisely here
-                extra = {"stream_offset": fr.offset} \
-                    if fr is not None else None
+                # exactly-once stamps: the consumed stream offset as of
+                # THIS chunk's frame and the verdict-monitor state AFTER
+                # this chunk's fold ride the sidecar, so a relaunch
+                # replays ingestion AND verdict evaluation from
+                # precisely here (the token is whitespace-free base64 —
+                # sidecar_meta splits on whitespace)
+                extra = {}
+                if fr is not None:
+                    extra["stream_offset"] = fr.offset
+                if monitors is not None:
+                    extra["monitors"] = monitors.state_token()
+                extra = extra or None
 
                 def save(to_save=to_save, path=path, extra=extra):
                     os.makedirs(sup.checkpoint_dir, exist_ok=True)
@@ -1071,6 +1221,26 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
             report.log("window_end", chunks=report.chunks_run,
                        tick=start_tick + done)
             window_end_hit = True
+        if abort_ev is not None:
+            # policy "abort": THIS boundary is the safe point — the
+            # breach checkpoint was submitted above, the named teardown
+            # note carries the failing contract + breach tick, and the
+            # drain makes both durable before the raise. Rank-symmetric
+            # under multihost: telemetry records are replicated, every
+            # rank folded the same rows and raises here together.
+            if journal is not None:
+                writer.submit(lambda e=dict(abort_ev): journal.note(
+                    "verdict_abort", policy="abort",
+                    contract=e["contract"], contract_kind=e["kind"],
+                    tick=e["tick"], id=e["id"], detail=e["detail"]))
+            writer.drain(raise_errors=False)
+            report.log("verdict_abort", contract=abort_ev["contract"],
+                       kind=abort_ev["kind"], tick=abort_ev["tick"])
+            raise VerdictAbort(
+                f"contract {abort_ev['contract']} "
+                f"({abort_ev['kind']}) FAILED at tick "
+                f"{abort_ev['tick']} under verdict_policy='abort': "
+                f"{abort_ev['detail']}", event=abort_ev, report=report)
 
     try:
         while done < n_ticks and not window_end_hit:
@@ -1143,8 +1313,11 @@ def supervised_run(state: SimState, cfg: SimConfig, tp: TopicParams,
                 s_info = {"chunk_start": start_tick + p_end,
                           "chunk_ticks": s_ticks, "attempt": 0,
                           "degrade_level": report.degrade_level}
+                # live contracts can force an off-cadence breach
+                # checkpoint at ANY confirm (verdict_policy snapshot/
+                # abort), so no chunk output is safe to donate away
                 donate = not p_boundary and sup.run_fn is None \
-                    and sup.commands is None
+                    and sup.commands is None and not sup.contracts
                 try:
                     spec = dispatch(pend.out, p_end, s_ticks, s_info,
                                     donate=donate)

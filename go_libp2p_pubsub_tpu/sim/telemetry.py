@@ -460,9 +460,14 @@ def read_journal(path: str) -> dict:
     """Tolerant journal read: ``{"runs", "chunks", "notes", "rows"}``.
     Torn tail lines (kill mid-append) are skipped; health rows dedup by
     ``(member, tick)`` with the LAST occurrence winning (a resumed run
-    legitimately re-streams ticks after its restore point)."""
+    legitimately re-streams ticks after its restore point). The same
+    last-wins discipline dedups ``contract_verdict`` notes by their
+    deterministic id — a relaunch that re-derives a verdict already
+    journaled before the crash (ISSUE 20 exactly-once) collapses to one
+    note, in first-fired order."""
     runs, chunks, notes = [], [], []
     rows: dict = {}
+    verdict_ids: dict = {}
     if not os.path.exists(path):
         return {"runs": runs, "chunks": chunks, "notes": notes, "rows": []}
     with open(path, "rb") as f:
@@ -478,6 +483,13 @@ def read_journal(path: str) -> dict:
                 runs.append(d)
             elif kind == "chunk":
                 chunks.append(d)
+            elif kind == "contract_verdict" and d.get("id") is not None:
+                vid = d["id"]
+                if vid in verdict_ids:
+                    notes[verdict_ids[vid]] = d     # keep first position
+                else:
+                    verdict_ids[vid] = len(notes)
+                    notes.append(d)
             else:
                 notes.append(d)
     ordered = sorted(rows.values(),

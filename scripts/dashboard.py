@@ -16,6 +16,12 @@ item 5 asks for:
   VIOLATION bits here the moment the chunk that lit them lands)
 - checkpoint ticks and crash markers (post-mortem starts here: the crash
   line names the dump directory ``scripts/replay_crash.py`` replays)
+- live contract verdicts (ISSUE 20): rendered from the run's journaled
+  ``contract_verdict`` notes when the supervisor carries monitors —
+  O(new bytes), deduped by deterministic id — with a CONTRACT BREACH /
+  VERDICT ABORT banner on failure; runs that stamp contracts but journal
+  no verdicts fall back to the tailer's own incremental monitors, and
+  pre-PR journals to full re-evaluation over the visible rows
 - fleet journals: per-member summary (worst delivery / tripped flags)
 - multihost journals: per-rank heartbeat age, relaunch count, degrade
   rung, and a DEAD-RANK banner with the mh_supervisor resume command
@@ -136,6 +142,15 @@ class _Tailer:
         self.chunk_count = 0
         self.notes = collections.deque(maxlen=256)
         self.rows = collections.OrderedDict()
+        # live contract verdict plane (ISSUE 20): journaled
+        # contract_verdict notes dedup by their deterministic id (a
+        # relaunch may re-derive a transition the killed run already
+        # journaled — it must render exactly once), and when a run
+        # stamps contracts but journals no verdicts (pre-PR journals)
+        # the tailer folds rows into its own incremental monitors —
+        # O(1) per row instead of the old O(all rows) per refresh
+        self.verdicts: dict = {}
+        self._mon: tuple | None = None
 
     def poll(self) -> None:
         try:
@@ -164,17 +179,50 @@ class _Tailer:
                 self.rows[key] = d
                 while len(self.rows) > self.MAX_ROWS:
                     self.rows.popitem(last=False)
+                self._fold_live(d)
             elif kind == "run":
                 self.runs = self.runs[-7:] + [d]
             elif kind == "chunk":
                 self.chunks.append(d)
                 self.chunk_count += 1
+            elif kind == "contract_verdict" and d.get("id"):
+                self.verdicts.setdefault(d["id"], d)
             else:
                 self.notes.append(d)
+
+    def _fold_live(self, row: dict) -> None:
+        """Tailer-side incremental contract monitors: the live fallback
+        for journals whose run stamps ``contracts`` but whose supervisor
+        journals no verdict notes. One O(1) fold per NEW row — resume
+        overlap (a re-sent tick) and fleet journals (per-member streams
+        need the batch path) are skipped."""
+        if self.verdicts or row.get("member", -1) != -1:
+            return
+        run = self.runs[-1] if self.runs else None
+        specs = run.get("contracts") if run else None
+        if not specs:
+            return
+        key = json.dumps(specs, sort_keys=True)
+        if self._mon is None or self._mon[0] != key:
+            try:
+                from go_libp2p_pubsub_tpu.sim import adversary
+                mons = adversary.ContractMonitors(
+                    adversary.contracts_from_json(specs))
+            except Exception:
+                mons = None     # render falls back to batch evaluation
+            self._mon = (key, mons, -1)
+        key0, mons, last = self._mon
+        tick = row.get("tick", -1)
+        if mons is None or tick <= last:
+            return
+        mons.fold_rows([row])
+        self._mon = (key0, mons, tick)
 
     def journal(self) -> dict:
         return {"runs": self.runs, "chunks": list(self.chunks),
                 "notes": list(self.notes),
+                "verdicts": list(self.verdicts.values()),
+                "live_monitors": self._mon[1] if self._mon else None,
                 "rows": sorted(self.rows.values(),
                                key=lambda r: (r.get("tick", 0),
                                               r.get("member", -1))),
@@ -253,6 +301,7 @@ def _snapshot_of(j: dict, path: str) -> dict:
         snap["fault_flags"] = None
     snap["fault_flag_names"] = _decode_flags(snap["fault_flags"],
                                              version=run.get("flags_version"))
+    _attach_verdicts(snap, j, current)
     _attach_attacks(snap, run, rows)
     # recent trend for the sparkline: mean delivery per tick
     trend: dict = {}
@@ -388,6 +437,56 @@ def _render_launcher(snap: dict, out: list) -> None:
         out.append(line)
 
 
+def _attach_verdicts(snap: dict, j: dict, current: list) -> None:
+    """Live contract verdict view (ISSUE 20), in preference order:
+
+    1. journaled ``contract_verdict`` notes (the supervisor's monitors
+       already judged the stream — O(new bytes): latest status per
+       contract by seq, deduped by deterministic id by the tailer /
+       ``telemetry.read_journal``);
+    2. the tailer's own incremental monitors (runs that stamp contracts
+       but journal no verdicts);
+    3. nothing here — ``_attach_attacks`` falls back to full
+       re-evaluation over the visible rows (pre-PR journals, fleet).
+
+    Also surfaces the ``verdict_abort``/``contract_alarm`` teardown and
+    breach markers for the render banners."""
+    verd = j.get("verdicts")
+    if verd is None:        # read_journal path: notes, already deduped
+        verd = [n for n in j["notes"]
+                if n.get("kind") == "contract_verdict"]
+    if verd:
+        latest: dict = {}
+        for v in verd:
+            i = v.get("contract", 0)
+            if i not in latest or v.get("seq", 0) >= \
+                    latest[i].get("seq", 0):
+                latest[i] = v
+        snap["contracts"] = [
+            # note dicts carry the contract's kind as contract_kind
+            # ("kind" is the note's own type tag, contract_verdict)
+            {"kind": v.get("contract_kind"), "status": v.get("status"),
+             "detail": v.get("detail"), "tick": v.get("tick"),
+             "source": "journal"}
+            for _i, v in sorted(latest.items())]
+    else:
+        mons = j.get("live_monitors")
+        if mons is not None:
+            snap["contracts"] = [
+                {"kind": r.kind, "status": r.status, "detail": r.detail,
+                 "source": "monitor"}
+                for r in mons.results(final=bool(snap.get("done")))]
+    abort = next((n for n in reversed(current)
+                  if n.get("kind") == "verdict_abort"), None)
+    if abort is not None:
+        snap["verdict_abort"] = {
+            "contract": abort.get("contract"),
+            "kind": abort.get("contract_kind"),
+            "tick": abort.get("tick"), "detail": abort.get("detail")}
+    if any(n.get("kind") == "contract_alarm" for n in current):
+        snap["contract_alarm"] = True
+
+
 def _attach_attacks(snap: dict, run: dict, rows: list) -> None:
     """Attack-scenario view (ISSUE 10): the run header stamps its
     ``attack_windows`` schedule (sim/telemetry.py header) and optionally
@@ -406,6 +505,10 @@ def _attach_attacks(snap: dict, run: dict, rows: list) -> None:
                                        and (w["end"] is None
                                             or tick < w["end"])))
                        for w in windows]
+    if "contracts" in snap:
+        # the verdict plane already judged the stream (journaled notes or
+        # the tailer's incremental monitors) — never re-evaluate O(rows)
+        return
     final = bool(snap.get("done") or snap.get("crashes"))
     try:
         from go_libp2p_pubsub_tpu.sim import adversary
@@ -588,6 +691,18 @@ def render(snap: dict) -> str:
             c["status"]] if c["status"] in ("pass", "fail", "pending") \
             else c["status"]
         out.append(f"  contract {c['kind']}: {mark} — {c['detail']}")
+    if any(c.get("status") == "fail" for c in snap.get("contracts", [])) \
+            and not snap.get("verdict_abort"):
+        out.append("  CONTRACT BREACH: a live contract FAILED — verdict "
+                   "journaled at the chunk boundary (run continues under "
+                   "its verdict policy)")
+    if snap.get("verdict_abort"):
+        va = snap["verdict_abort"]
+        out.append(f"  VERDICT ABORT: contract {va.get('kind')} FAILED @ "
+                   f"tick {va.get('tick')} — run tore down at the chunk "
+                   "boundary; restore from the last checkpoint")
+        if va.get("detail"):
+            out.append(f"    {va['detail']}")
     if snap.get("checkpoints"):
         out.append("  checkpoints @ " + ", ".join(
             str(t) for t in snap["checkpoints"][-4:]))

@@ -25,6 +25,19 @@ watchdog trips, the run coasts, and the drill restarts the producer from
 the stamped offset). ``--from-offset`` is a byte offset into ``--out``
 mirroring ``--stream`` byte-for-byte — the copy seeks the INPUT to the
 same offset and truncates any torn tail beyond it in the output.
+
+``--scenario`` (ISSUE 20) emits a CANONICAL composed attack stream to
+``--out`` instead of copying one — the two composed scenarios ROADMAP
+item 2 names, ready to feed a run's ``--source`` (optionally through a
+second producer invocation for the rate/park drills):
+
+    # eclipse + censorship landing on one region at tick 4
+    python scripts/directive_producer.py --scenario eclipse_censor \
+        --out /shared/live.ndjsonl --at 4 --region 8 --attackers 8
+
+    # publish storms hammering the gater's RED admission for 3 ticks
+    python scripts/directive_producer.py --scenario storm_red \
+        --out /shared/live.ndjsonl --at 4 --attackers 32 --bursts 3
 """
 
 import argparse
@@ -32,11 +45,59 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def scenario_directives(name: str, *, at: int, region: int,
+                        attackers: int, bursts: int) -> list:
+    """The canonical composed streams (sim/commands.py grammar). Pure —
+    tests pin the exact shapes."""
+    if name == "eclipse_censor":
+        # one timed compose line: the region [0, region) loses its
+        # honest edges while the cohort [region, region+attackers)
+        # flips into censoring spam actors — both land at ONE boundary
+        return [
+            {"op": "tick", "tick": at},
+            {"op": "compose", "tick": at, "parts": [
+                {"op": "attack", "kind": "eclipse",
+                 "peers": list(range(region))},
+                {"op": "attack", "kind": "censor",
+                 "peers": list(range(region, region + attackers))},
+            ]},
+        ]
+    if name == "storm_red":
+        # coordinated publish storms, one burst per tick: offered load
+        # beyond the run's --directive-slots budget is exactly what the
+        # gater's RED admission sheds deterministically (journaled
+        # ingest_shed, never a retrace)
+        out = [{"op": "tick", "tick": at}]
+        for b in range(bursts):
+            out.append({"op": "attack", "tick": at + b, "kind": "storm",
+                        "topic": 0, "peers": list(range(attackers))})
+        return out
+    raise ValueError(
+        f"--scenario {name!r} unknown (supported: eclipse_censor, "
+        "storm_red)")
+
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--stream", required=True,
-                    help="input NDJSON directive/trace file to feed from")
+    ap.add_argument("--stream", default=None,
+                    help="input NDJSON directive/trace file to feed from "
+                         "(exactly one of --stream/--scenario)")
+    ap.add_argument("--scenario", default=None,
+                    choices=["eclipse_censor", "storm_red"],
+                    help="emit a canonical composed attack stream to "
+                         "--out instead of copying --stream")
+    ap.add_argument("--at", type=int, default=4,
+                    help="--scenario: tick the composed attack lands at")
+    ap.add_argument("--region", type=int, default=8,
+                    help="--scenario eclipse_censor: eclipsed-region size")
+    ap.add_argument("--attackers", type=int, default=8,
+                    help="--scenario: attacker cohort size")
+    ap.add_argument("--bursts", type=int, default=3,
+                    help="--scenario storm_red: storm lines (one per "
+                         "tick)")
     ap.add_argument("--out", required=True,
                     help="the run's --source file (appended, fsync'd "
                          "per line)")
@@ -49,6 +110,18 @@ def main() -> int:
                     help="stop after N lines and sleep forever (chaos "
                          "drills SIGKILL the parked process)")
     args = ap.parse_args()
+
+    if (args.stream is None) == (args.scenario is None):
+        ap.error("exactly one of --stream / --scenario is required")
+    if args.scenario:
+        from go_libp2p_pubsub_tpu.sim.commands import write_stream
+        directives = scenario_directives(
+            args.scenario, at=args.at, region=args.region,
+            attackers=args.attackers, bursts=args.bursts)
+        write_stream(args.out, directives, end=True)
+        print(f"[producer] scenario {args.scenario}: "
+              f"{len(directives) + 1} lines -> {args.out}", flush=True)
+        return 0
 
     delay = 1.0 / args.rate if args.rate > 0 else 0.0
     written = 0

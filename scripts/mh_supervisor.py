@@ -167,6 +167,12 @@ def _launch_rank(args, rank: int, procs: int, coordinator: str,
                 "--directive-slots", str(args.directive_slots),
                 "--ingest-stall-timeout", str(args.ingest_stall_timeout),
                 "--ingest-coast-poll", str(args.ingest_coast_poll)]
+    if args.contracts:
+        # EVERY rank folds the verdict monitors (the abort policy must
+        # fire rank-symmetrically); only rank 0 journals the notes
+        cmd += ["--contracts", args.contracts]
+    if args.verdict_policy:
+        cmd += ["--verdict-policy", args.verdict_policy]
     if rank == 0:
         if args.dump_state:
             cmd += ["--dump-state", args.dump_state]
@@ -255,6 +261,19 @@ def main() -> int:
     ap.add_argument("--directive-slots", type=int, default=64)
     ap.add_argument("--ingest-stall-timeout", type=float, default=10.0)
     ap.add_argument("--ingest-coast-poll", type=float, default=0.05)
+    ap.add_argument("--contracts", default=None,
+                    help="live contract specs (JSON list), forwarded to "
+                         "every rank (run_multihost.py --contracts); the "
+                         "checkpoint sidecar's monitor state makes "
+                         "verdict journaling exactly-once across "
+                         "relaunches")
+    ap.add_argument("--verdict-policy", default=None,
+                    choices=["journal", "snapshot", "abort"],
+                    help="forwarded FAIL response; under 'abort' a "
+                         "breach exits every rank with code 44, which "
+                         "this driver treats as TERMINAL (mh_verdict_"
+                         "abort journal line, no relaunch — the "
+                         "trajectory would replay into the same breach)")
     args = ap.parse_args()
 
     try:
@@ -322,9 +341,19 @@ def main() -> int:
             first_exit0: float | None = None
             last_progress = time.time()
             last_ticks = _heartbeat_ticks(run_dir, procs_n)
+            verdict_abort = False
             while failure is None:
                 time.sleep(0.25)
                 codes = [p.poll() for p, _ in group]
+                if any(c == resilience.EXIT_VERDICT_ABORT for c in codes):
+                    # TERMINAL, not a crash: a live behavior contract
+                    # failed under verdict_policy=abort and the group
+                    # tore itself down cleanly at a chunk boundary.
+                    # Relaunching would replay the same checkpointed
+                    # trajectory into the same breach — don't.
+                    verdict_abort = True
+                    failure = "verdict_abort"
+                    break
                 if any(c is not None and c != 0 for c in codes):
                     failure = "rank_exit " + " ".join(
                         f"r{r}={c}" for r, c in enumerate(codes)
@@ -358,6 +387,14 @@ def main() -> int:
                               "relaunches": attempt, "rung": rung}),
                   flush=True)
             return 0
+        if verdict_abort:
+            journal.record(kind="mh_verdict_abort", attempt=attempt,
+                           exit_code=resilience.EXIT_VERDICT_ABORT)
+            print(json.dumps({"mh": "verdict_abort", "attempt": attempt,
+                              "exit_code":
+                                  resilience.EXIT_VERDICT_ABORT}),
+                  flush=True)
+            return resilience.EXIT_VERDICT_ABORT
 
         tick_after = _newest_ckpt_tick(ckpt_dir)
         made_progress = (tick_after or -1) > (tick_before or -1)

@@ -138,6 +138,22 @@ def main() -> None:
                     help="per-boundary pacing sleep while coasting, so a "
                          "stalled run cannot sprint arbitrarily far from "
                          "its stream before the producer restarts")
+    ap.add_argument("--contracts", default=None,
+                    help="live contract verdict plane (sim/adversary.py): "
+                         "inline JSON list of contract specs evaluated "
+                         "over the streamed telemetry at every chunk "
+                         "boundary; status transitions journal "
+                         "contract_verdict notes exactly-once across "
+                         "relaunches. Requires --health. Example: "
+                         "'[{\"kind\": \"delivery_floor\", \"floor\": "
+                         "0.9, \"start\": 0}]'")
+    ap.add_argument("--verdict-policy", default=None,
+                    choices=["journal", "snapshot", "abort"],
+                    help="FAIL response (or $GRAFT_VERDICT_POLICY): "
+                         "journal an alarm (default), snapshot an "
+                         "off-cadence breach checkpoint, or abort — "
+                         "clean named teardown at the breach boundary "
+                         "(exit code 44, terminal for mh_supervisor.py)")
     args = ap.parse_args()
 
     from go_libp2p_pubsub_tpu.parallel import multihost, resilience
@@ -337,10 +353,35 @@ def main() -> None:
         health_meta.update(ingest_source=os.path.abspath(args.source),
                            directive_slots=args.directive_slots)
 
+    # live contract verdict plane: every rank folds the same replicated
+    # telemetry rows (the abort policy must be rank-symmetric); only
+    # rank 0 journals the verdict notes. The declared contracts also
+    # stamp into the health header so the dashboard evaluates the RUN's
+    # contracts, not schedule defaults
+    contracts = ()
+    if args.contracts:
+        from go_libp2p_pubsub_tpu.sim import adversary
+        try:
+            specs = json.loads(args.contracts)
+        except json.JSONDecodeError as e:
+            raise SystemExit(f"--contracts: not valid JSON ({e})")
+        if not isinstance(specs, list):
+            raise SystemExit("--contracts: expected a JSON LIST of "
+                             "contract objects")
+        try:
+            contracts = adversary.contracts_from_json(specs)
+        except ValueError as e:
+            raise SystemExit(f"--contracts: {e}")
+        health_meta["contracts"] = adversary.contracts_to_json(contracts)
+
     sup = SupervisorConfig.from_env(
         scenario=args.scenario,
         run_fn=run_fn,
         commands=commands,
+        contracts=contracts,
+        chaos=chaos,
+        **({"verdict_policy": args.verdict_policy}
+           if args.verdict_policy else {}),
         state_to_host=multihost.gather_state,
         state_from_host=state_from_host,
         write_files=coord,
@@ -353,13 +394,33 @@ def main() -> None:
            if args.checkpoint_dir else {}),
     )
 
+    from go_libp2p_pubsub_tpu.sim.supervisor import VerdictAbort
     try:
         t0 = time.perf_counter()
-        state, report = supervised_run(state, cfg, tp,
-                                       jax.random.PRNGKey(args.seed),
-                                       args.ticks, sup,
-                                       _chunk_hook=chaos.fire
-                                       if chaos is not None else None)
+        try:
+            state, report = supervised_run(state, cfg, tp,
+                                           jax.random.PRNGKey(args.seed),
+                                           args.ticks, sup,
+                                           _chunk_hook=chaos.fire
+                                           if chaos is not None else None)
+        except VerdictAbort as e:
+            # clean named teardown: every verdict note already drained
+            # to the journal before the raise. All ranks raise together
+            # (the fold is rank-symmetric), so no collective is left
+            # half-entered; the distinct exit code tells the relaunch
+            # supervisor this is TERMINAL, not a crash to relaunch past
+            if coord:
+                line = {"info": "verdict_abort", **(e.event or {}),
+                        "exit_code": resilience.EXIT_VERDICT_ABORT}
+                print(json.dumps(line), flush=True)
+                if args.journal:
+                    with open(args.journal, "a") as f:
+                        f.write(json.dumps(line) + "\n")
+                        f.flush()
+                        os.fsync(f.fileno())
+            if liveness is not None:
+                liveness.finish()
+            sys.exit(resilience.EXIT_VERDICT_ABORT)
         wall = time.perf_counter() - t0
 
         # final host-complete copy: collective gather on every rank,

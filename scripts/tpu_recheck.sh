@@ -23,6 +23,7 @@ for step in "supervisor_smoke:python scripts/supervisor_smoke.py" \
             "telemetry:env BENCH_SCENARIOS=telemetry_1k,telemetry_10k python bench.py" \
             "bench_overlap:env BENCH_SCENARIOS=supervised_overlap_1k,supervised_overlap_10k python bench.py" \
             "bench_ingest:env BENCH_SCENARIOS=ingest_1k,ingest_10k python bench.py" \
+            "bench_verdicts:env BENCH_SCENARIOS=verdict_1k,verdict_10k python bench.py" \
             "bench_attacks:env BENCH_SCENARIOS=eclipse_50k,flashcrowd_50k python bench.py" \
             "bench_powerlaw:env BENCH_SCENARIOS=powerlaw_100k,powerlaw_1m,heavytail_eclipse GRAFT_DEADLINE_S=900 GRAFT_HBM_BUDGET=16GiB python bench.py" \
             "bench_powerlaw_mh:env BENCH_SCENARIOS=powerlaw_100k_mh,powerlaw_10m_mh GRAFT_DEADLINE_S=900 GRAFT_HBM_BUDGET=16GiB python bench.py" \

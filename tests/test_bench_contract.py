@@ -57,7 +57,8 @@ def test_full_suite_fits_budget_at_reduced_n():
     contract scale; the frontier family (ISSUE 8), the tracing-overhead
     pair (ISSUE 9), the attack pair (ISSUE 10), the heavy-tail family
     (ISSUE 15), the row-sharded bucketed family (ISSUE 16) and the
-    live-command-plane pair (ISSUE 19) ride the same BENCH_MAX_N cap
+    live-command-plane pair (ISSUE 19) and the verdict-plane pair
+    (ISSUE 20) ride the same BENCH_MAX_N cap
     with capped-N labels — reduced runs can never bank under the full
     labels."""
     budget = 900
@@ -68,8 +69,8 @@ def test_full_suite_fits_budget_at_reduced_n():
         timeout=budget + 120)
     assert res.returncode == 0, res.stderr[-500:]
     assert elapsed < budget, f"suite blew the budget: {elapsed:.0f}s"
-    # 28 configs + the headline re-emit
-    assert len(metrics) == 29, [m["metric"] for m in metrics]
+    # 30 configs + the headline re-emit
+    assert len(metrics) == 31, [m["metric"] for m in metrics]
     for m in metrics:
         assert m["value"] > 0, m
         # every record carries the memory accounting (ISSUE 8 satellite)
@@ -93,7 +94,8 @@ def test_full_suite_fits_budget_at_reduced_n():
                      "heavytail_eclipse_capped_0k",
                      "powerlaw_100k_mh_capped_0k",
                      "powerlaw_10m_mh_capped_0k",
-                     "ingest_1k_capped_0k", "ingest_10k_capped_0k"}
+                     "ingest_1k_capped_0k", "ingest_10k_capped_0k",
+                     "verdict_1k_capped_0k", "verdict_10k_capped_0k"}
     fleet = next(m for m in metrics if "fleet_4x0k" in m["metric"])
     assert fleet["fleet_size"] == 4
     assert fleet["per_member_hbps"] > 0
@@ -123,6 +125,12 @@ def test_full_suite_fits_budget_at_reduced_n():
     assert ing["overload"]["shed"] > 0
     assert ing["overload"]["applied"] + ing["overload"]["shed"] \
         == ing["overload"]["offered_total"]
+    # the verdict-plane line (ISSUE 20): both A/B legs present and at
+    # least one journaled verdict transition rode the banked run — the
+    # in-bench parity assert already re-judged the rows full-batch
+    ver = next(m for m in metrics if "verdict_1k" in m["metric"])
+    assert ver["monitored_hbps"] > 0 and ver["unmonitored_hbps"] > 0
+    assert ver["n_contracts"] == 3 and ver["verdict_notes"] > 0
     # the heavy-tail line (ISSUE 15): the degree shape and bucket
     # partition travel with every banked number
     pl = next(m for m in metrics if "powerlaw_100k_capped" in m["metric"])

@@ -61,6 +61,62 @@ class TestDirectiveParser:
         assert p.ops == (("publish", 1, 1), ("publish", 2, 1),
                          ("publish", 3, 1))
 
+    def test_attack_eclipse_censor_expand_to_peer_ops(self):
+        """ISSUE 20 attack kinds: peer-targeted ops (no topic lane)."""
+        e = self._parse('{"op":"attack","tick":2,"kind":"eclipse",'
+                        '"peers":[4,5]}')
+        assert e.ops == (("eclipse", 4, 0), ("eclipse", 5, 0))
+        c = self._parse('{"op":"attack","kind":"censor","peers":[9]}')
+        assert c.ops == (("censor", 9, 0),) and c.tick == -1
+
+    def test_compose_mixes_parts_at_one_boundary(self):
+        """The compose form: one timed line, several parts, every
+        primitive op timed by the compose line's tick."""
+        p = self._parse(json.dumps({"op": "compose", "tick": 4, "parts": [
+            {"op": "attack", "kind": "eclipse", "peers": [0, 1]},
+            {"op": "attack", "kind": "censor", "peers": [2]},
+            {"op": "publish", "peer": 3, "topic": 1},
+            {"op": "join", "peer": 4, "topic": 0},
+        ]}))
+        assert p.tick == 4 and p.kind == "directive"
+        assert p.ops == (("eclipse", 0, 0), ("eclipse", 1, 0),
+                         ("censor", 2, 0), ("publish", 3, 1),
+                         ("join", 4, 0))
+
+    @pytest.mark.parametrize("line,name", [
+        ('{"op":"attack","kind":"eclipse","topic":0,"peers":[1]}',
+         "takes no 'topic'"),
+        ('{"op":"attack","kind":"censor","topic":1,"peers":[1]}',
+         "takes no 'topic'"),
+        ('{"op":"attack","kind":"eclipse","peers":[64]}', "out of range"),
+        ('{"op":"attack","kind":"censor","peers":[true]}', "out of range"),
+        # the unknown-kind refusal advertises the compose escape hatch
+        ('{"op":"attack","kind":"partition","peers":[1]}', "compose"),
+        ('{"op":"compose","tick":1,"parts":[]}', "non-empty"),
+        ('{"op":"compose","tick":1,"parts":"x"}', "non-empty"),
+        ('{"op":"compose","tick":1,"parts":[7]}', "JSON object"),
+        ('{"op":"compose","tick":1,"parts":[{"op":"publish","tick":2,'
+         '"peer":1,"topic":0}]}', "must not carry its own tick"),
+        ('{"op":"compose","tick":1,"parts":[{"op":"compose",'
+         '"parts":[]}]}', "cannot nest"),
+        ('{"op":"compose","tick":1,"parts":[{"op":"tick"}]}',
+         "part 0 op 'tick' unknown"),
+        ('{"op":"compose","tick":1,"parts":[{"op":"end"}]}',
+         "part 0 op 'end' unknown"),
+    ])
+    def test_composed_attacks_refused_by_name(self, line, name):
+        with pytest.raises(DirectiveError, match=name):
+            self._parse(line)
+
+    def test_compose_oversized_total_refused(self):
+        parts = [{"op": "attack", "kind": "eclipse",
+                  "peers": list(range(6))},
+                 {"op": "attack", "kind": "censor",
+                  "peers": list(range(6, 12))}]
+        with pytest.raises(DirectiveError, match="max_batch"):
+            self._parse(json.dumps({"op": "compose", "tick": 0,
+                                    "parts": parts}), max_batch=10)
+
     def test_watermark_and_end(self):
         assert self._parse('{"op":"tick","tick":9}').kind == "tick"
         assert self._parse('{"op":"end"}').kind == "end"
@@ -114,11 +170,13 @@ class TestDirectiveParser:
         types/values — same contract."""
         rng = random.Random(7)
         vals = [None, True, -1, 0, 63, 64, 10**12, 0.5, "x", [], {},
-                [1, 2], {"a": 1}]
+                [1, 2], {"a": 1}, [{"op": "attack"}],
+                [{"op": "compose", "parts": []}],
+                [{"op": "attack", "kind": "censor", "peers": [0]}] * 3]
         keys = ["op", "tick", "peer", "topic", "kind", "peers", "type",
-                "timestamp", "peerID"]
-        ops = ["publish", "join", "leave", "attack", "tick", "end",
-               "nonsense", 7, None]
+                "timestamp", "peerID", "parts"]
+        ops = ["publish", "join", "leave", "attack", "compose", "tick",
+               "end", "nonsense", 7, None]
         for _ in range(500):
             d = {k: rng.choice(vals)
                  for k in rng.sample(keys, rng.randrange(0, len(keys)))}
@@ -163,6 +221,17 @@ class TestDirectiveParser:
         assert (cmds.OP_NOP, cmds.OP_JOIN, cmds.OP_LEAVE,
                 cmds.OP_PUBLISH) == (rp.OP_NOP, rp.OP_JOIN, rp.OP_LEAVE,
                                      rp.OP_PUBLISH)
+
+    def test_attack_op_codes_outside_replay_space(self):
+        """The ISSUE 20 attack lanes live ABOVE the replay op space:
+        apply_frame masks them to NOP before the replay trace sees the
+        frame, so the single compiled trace keeps serving every frame."""
+        import importlib
+        rp = importlib.import_module("go_libp2p_pubsub_tpu.trace.replay")
+        assert cmds.ATTACK_OP_BASE == 16
+        assert (cmds.OP_ECLIPSE, cmds.OP_CENSOR) == (16, 17)
+        assert min(cmds.OP_ECLIPSE, cmds.OP_CENSOR) > max(
+            rp.OP_NOP, rp.OP_JOIN, rp.OP_LEAVE, rp.OP_PUBLISH)
 
 
 # ---------------------------------------------------------------------------
@@ -616,6 +685,48 @@ class TestSupervisedIngest:
         ref, _ = _run(state, cfg, tp, key, _queue_for(cfg, src))
         _assert_states_equal(ref, out)
         assert bc.applied_total == 6 and bc.shed_total == 0
+
+    def test_composed_attack_lights_both_fault_bits(self, small,
+                                                    tmp_path):
+        """ISSUE 20 composed attack end to end in-process: the canonical
+        eclipse+censor stream (scripts/directive_producer.py --scenario)
+        lands at ONE boundary, the invariant sentinel lights BOTH fault
+        bits in the health rows, and the attack lanes cost zero replay
+        retraces (apply_frame masks them to NOP for the trace)."""
+        import importlib
+
+        from go_libp2p_pubsub_tpu.sim.invariants import (FAULT_CENSOR,
+                                                         FAULT_ECLIPSE)
+        from go_libp2p_pubsub_tpu.sim.telemetry import read_journal
+        rp = importlib.import_module("go_libp2p_pubsub_tpu.trace.replay")
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            from directive_producer import scenario_directives
+        finally:
+            sys.path.pop(0)
+        cfg, tp, state, key = small
+        src = tmp_path / "s.ndjsonl"
+        # region 4 + cohort 4 = 8 primitive ops: exactly the SLOTS
+        # budget, nothing shed
+        write_stream(str(src), scenario_directives(
+            "eclipse_censor", at=4, region=4, attackers=4, bursts=1),
+            end=True)
+        health = str(tmp_path / "health.jsonl")
+        out, rep = _run(state, cfg, tp, key, _queue_for(cfg, src),
+                        health_path=health)
+        j = read_journal(health)
+        flags = [int(r.get("fault_flags") or 0) for r in j["rows"]]
+        # the tick-4 directive routes to chunk [3,6): applied at its
+        # opening boundary, so the sticky bits light from tick 3 on
+        pre = [f for r, f in zip(j["rows"], flags) if r["tick"] < 3]
+        post = max(f for r, f in zip(j["rows"], flags) if r["tick"] >= 3)
+        assert not any(f & (FAULT_ECLIPSE | FAULT_CENSOR) for f in pre)
+        assert post & FAULT_ECLIPSE and post & FAULT_CENSOR
+        assert not [n for n in j["notes"] if n.get("kind") == "ingest_shed"]
+        # deterministic: the composed attack replays bit-exact
+        out2, _ = _run(state, cfg, tp, key, _queue_for(cfg, src))
+        _assert_states_equal(out, out2)
+        assert rp.replay._cache_size() == 1 and rep.retries == 0
 
 
 # ---------------------------------------------------------------------------

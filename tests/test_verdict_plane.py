@@ -1,0 +1,779 @@
+"""Live contract verdict plane (sim/adversary.py monitors +
+sim/supervisor.py supervised verdict response, ISSUE 20).
+
+Layers under test, cheapest first: per-kind streaming monitors proven
+bit-exact against the batch evaluators at EVERY row prefix (the
+pending→fail final settlement included), the contract_from_json fuzz
+(200 adversarial specs all refused BY NAME), the checkpoint-sidecar
+state token round-trip (a mid-stream save/restore continues the verdict
+stream identically; a contract-set mismatch refuses by name), the
+in-process supervised policy legs (journal / snapshot / abort — never a
+silent continue), the engineered kill→resume duplicate (the raw journal
+carries the re-derived note twice, the DEDUPED stream exactly once),
+the dashboard's journal-first render (never re-evaluating O(rows) once
+verdicts exist) — capped by THE acceptance leg: a real 2-process CPU
+run fed a composed eclipse+censor stream, rank 0 SIGKILLed between a
+breach and its journaled verdict, relaunched off the sidecar monitor
+state, finishing with the verdict note stream identical to the
+uninterrupted run (each verdict exactly once, state bit-exact).
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from go_libp2p_pubsub_tpu.sim import adversary  # noqa: E402
+from go_libp2p_pubsub_tpu.sim.adversary import (  # noqa: E402
+    ContractMonitors, DeliveryFloor, RecoveryCeiling, ScoreResponse,
+    contract_from_json, monitor_for)
+
+pytestmark = pytest.mark.verdicts
+
+
+def _rows(deliv, att_edges=0, att_gray=0, hon_gray=0, conn=100, t0=0):
+    return [{"tick": t0 + i, "member": -1, "delivery_frac_t0": d,
+             "attacker_edges": att_edges, "attacker_graylisted": g,
+             "honest_graylisted": hon_gray, "connected_edges": conn}
+            for i, (d, g) in enumerate(
+                zip(deliv, att_gray if isinstance(att_gray, list)
+                    else [att_gray] * len(deliv)))]
+
+
+# ---------------------------------------------------------------------------
+# per-kind monitors: bit-exact vs the batch evaluators at every prefix
+
+
+PARITY_CONTRACTS = [
+    DeliveryFloor(floor=0.7),
+    DeliveryFloor(floor=0.7, start=2, end=5),
+    DeliveryFloor(floor=0.5, topic=0),
+    RecoveryCeiling(after=2, within=3, floor=0.8),
+    RecoveryCeiling(after=1, within=2, floor=0.99),
+    ScoreResponse(by=3),
+    ScoreResponse(by=2, attacker_frac=0.0),
+    ScoreResponse(by=1, honest_max_frac=0.0, start=1),
+]
+
+PARITY_STREAMS = {
+    "recovers": _rows([0.9, 0.6, 0.5, 0.7, 0.95, 0.99], att_edges=10,
+                      att_gray=[0, 0, 2, 5, 6, 8]),
+    "degrades": _rows([0.95, 0.9, 0.65, 0.6, 0.55, 0.5]),
+    "honest_collateral": _rows([0.9] * 6, att_edges=10, att_gray=9,
+                               hon_gray=30, conn=100),
+    "short": _rows([0.8]),
+    "late_window": _rows([0.9, 0.9], t0=10),
+}
+
+
+class TestMonitorParity:
+    def test_prefix_parity_bit_exact(self):
+        """Every monitor kind equals its batch evaluator at EVERY row
+        prefix — status, detail string AND measured dict, under both
+        mid-stream and final semantics (the pending→fail settlement of a
+        too-short stream included)."""
+        for c in PARITY_CONTRACTS:
+            for sname, rows in PARITY_STREAMS.items():
+                mon = monitor_for(c)
+                for n in range(len(rows) + 1):
+                    if n:
+                        mon.fold(rows[n - 1])
+                    for final in (False, True):
+                        want = c.evaluate(rows[:n], final=final)
+                        got = mon.result(final=final)
+                        assert (got.status, got.detail, got.measured) \
+                            == (want.status, want.detail, want.measured), \
+                            (c, sname, n, final)
+                        assert mon.status(final=final) == want.status
+
+    def test_transition_events_deterministic(self):
+        """The event stream is a pure function of the rows: re-folding
+        from scratch re-derives byte-identical ids (the exactly-once
+        dedup key), and every id encodes its own fields."""
+        cs = (DeliveryFloor(floor=0.7),
+              RecoveryCeiling(after=2, within=3, floor=0.8),
+              ScoreResponse(by=3))
+        rows = PARITY_STREAMS["recovers"]
+        m = ContractMonitors(cs)
+        evs = m.fold_rows(rows) + m.finalize()
+        ids = [e["id"] for e in evs]
+        assert ids and len(ids) == len(set(ids))
+        for e in evs:
+            assert e["id"] == (f"c{e['contract']}.s{e['seq']}"
+                               f".{e['status']}@{e['tick']}")
+        m2 = ContractMonitors(cs)
+        assert [e["id"] for e in m2.fold_rows(rows) + m2.finalize()] == ids
+        # finalize is idempotent: a relaunch that re-finalizes re-derives
+        # nothing new once the statuses already settled
+        assert m2.finalize() == []
+
+    def test_state_token_roundtrip_mid_stream(self):
+        """Serialize mid-stream, restore, continue folding: the restored
+        monitors emit the same events and land on the same results as
+        the uninterrupted fold — and the state is JSON/sidecar-safe."""
+        cs = (DeliveryFloor(floor=0.7),
+              RecoveryCeiling(after=2, within=3, floor=0.8),
+              ScoreResponse(by=3))
+        rows = PARITY_STREAMS["recovers"]
+        a = ContractMonitors(cs)
+        a.fold_rows(rows[:3])
+        tok = a.state_token()
+        assert not set(tok) & set(" \t\n")      # sidecar-safe: no spaces
+        json.dumps(a.to_state())                # JSON-serializable state
+        b = ContractMonitors.from_token(tok, cs)
+        assert b.statuses == a.statuses and b.seqs == a.seqs
+        ea = a.fold_rows(rows[3:]) + a.finalize()
+        eb = b.fold_rows(rows[3:]) + b.finalize()
+        assert ea == eb
+        assert [r.status for r in a.results(final=True)] \
+            == [r.status for r in b.results(final=True)]
+
+    def test_contract_set_mismatch_refused(self):
+        a = ContractMonitors((DeliveryFloor(floor=0.5),))
+        tok = a.state_token()
+        with pytest.raises(ValueError,
+                           match="refusing a silent verdict reset"):
+            ContractMonitors.from_token(tok, (DeliveryFloor(floor=0.6),))
+
+
+# ---------------------------------------------------------------------------
+# contract_from_json fuzz: adversarial specs all refused BY NAME
+
+
+class TestContractJsonFuzz:
+    BASES = {
+        "delivery_floor": {"kind": "delivery_floor", "floor": 0.5},
+        "recovery_ceiling": {"kind": "recovery_ceiling", "after": 3,
+                             "within": 5},
+        "score_response": {"kind": "score_response", "by": 4},
+    }
+    FIELDS = {
+        "delivery_floor": ["floor", "start", "end", "topic"],
+        "recovery_ceiling": ["after", "within", "floor", "topic"],
+        "score_response": ["by", "attacker_frac", "honest_max_frac",
+                           "start"],
+    }
+    NON_NULLABLE = {
+        "delivery_floor": ["floor", "start"],
+        "recovery_ceiling": ["after", "within", "floor"],
+        "score_response": ["by", "attacker_frac", "honest_max_frac",
+                           "start"],
+    }
+    OUT_OF_RANGE = {
+        "delivery_floor": [("floor", 1.5), ("floor", -0.25),
+                           ("start", -1), ("end", -3), ("start", 2.5)],
+        "recovery_ceiling": [("after", -1), ("within", 0),
+                             ("floor", 2.0), ("after", 2.5)],
+        "score_response": [("by", -5), ("attacker_frac", 1.01),
+                           ("honest_max_frac", -0.5), ("start", -2),
+                           ("by", 3.5)],
+    }
+
+    def test_bases_parse(self):
+        for b in self.BASES.values():
+            assert contract_from_json(dict(b)).kind == b["kind"]
+
+    def test_fuzz_200_adversarial_specs_refused_by_name(self):
+        """200 seeded adversarial specs (bad kinds, unknown fields,
+        wrong types incl. bools, nulls on non-nullable fields, range
+        violations, non-dict specs, empty census windows): every single
+        one raises ValueError with a non-empty named message — never a
+        crash, never a silent default."""
+        rng = random.Random(20)
+        refused = 0
+        while refused < 200:
+            kind = rng.choice(list(self.BASES))
+            d = dict(self.BASES[kind])
+            mode = rng.randrange(6)
+            if mode == 0:       # unknown / malformed kind
+                d["kind"] = rng.choice(
+                    [None, 7, True, "", "delivery", "eclipse",
+                     "DELIVERY_FLOOR", ["delivery_floor"]])
+                spec = d
+            elif mode == 1:     # unknown field
+                d[rng.choice(["florr", "peers", "tick", "Kind",
+                              "stop", "window"])] = rng.choice([0, "x"])
+                spec = d
+            elif mode == 2:     # wrong type (bools excluded from ints)
+                d[rng.choice(self.FIELDS[kind])] = rng.choice(
+                    ["x", [], {}, True, False, [1]])
+                spec = d
+            elif mode == 3:     # null on a non-nullable field
+                d[rng.choice(self.NON_NULLABLE[kind])] = None
+                spec = d
+            elif mode == 4:     # out of range / float where int required
+                f, v = rng.choice(self.OUT_OF_RANGE[kind])
+                d[f] = v
+                spec = d
+            else:               # not a JSON object at all / empty window
+                spec = rng.choice(
+                    [None, 7, "spec", ["kind"], [dict(d)], True,
+                     {"kind": "delivery_floor", "floor": 0.5,
+                      "start": 5, "end": 5},
+                     {"kind": "delivery_floor", "floor": 0.5,
+                      "start": 9, "end": 2}])
+            with pytest.raises(ValueError) as ei:
+                contract_from_json(spec)
+            assert str(ei.value), spec
+            refused += 1
+
+
+# ---------------------------------------------------------------------------
+# supervised verdict response: fold at every chunk confirm, journaled
+# notes, policy on FAIL — never a silent continue
+#
+# Shapes mirror tests/test_commands.py exactly (the tier-1 suite runs
+# that module first, so every compile here is a jit-cache hit), and the
+# contracts are chosen to be deterministic INDEPENDENT of simulated
+# delivery values: with no attackers ScoreResponse(by=0) fails at tick
+# 0, DeliveryFloor(floor=0.0) passes at tick 0, and a 12-tick run can
+# never satisfy RecoveryCeiling(after=20) — the pending→fail final leg.
+
+
+CHUNK, TICKS = 3, 12
+
+C_FAIL = ScoreResponse(by=0)
+C_PASS = DeliveryFloor(floor=0.0)
+C_PEND = RecoveryCeiling(after=20, within=5)
+
+
+@pytest.fixture(scope="module")
+def small():
+    import jax
+
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    cfg, tp, state = scenarios.single_topic_1k(n_peers=128, k_slots=16,
+                                               degree=6)
+    return cfg, tp, state, jax.random.PRNGKey(42)
+
+
+def _sup(**kw):
+    from go_libp2p_pubsub_tpu.sim.supervisor import SupervisorConfig
+    kw.setdefault("chunk_ticks", CHUNK)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("sleep", lambda s: None)
+    return SupervisorConfig(**kw)
+
+
+def _notes(path, kind):
+    """Notes of one kind via telemetry.read_journal — the DEDUPED
+    read-side view (contract_verdict dedups by deterministic id)."""
+    from go_libp2p_pubsub_tpu.sim.telemetry import read_journal
+    return [n for n in read_journal(str(path))["notes"]
+            if n.get("kind") == kind]
+
+
+def _raw_notes(path, kind):
+    """Raw journal lines of one kind — duplicates included (what a
+    relaunch re-derived on top of what the killed run already wrote)."""
+    out = []
+    with open(path) as f:
+        for ln in f:
+            if not ln.strip():
+                continue
+            try:
+                d = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if d.get("kind") == kind:
+                out.append(d)
+    return out
+
+
+def _assert_states_equal(a, b):
+    for f, x, y in zip(a._fields, a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=f"field {f}")
+
+
+class TestSupervisedVerdicts:
+    def test_journal_policy_verdicts_and_alarm(self, small, tmp_path):
+        """Default policy: every transition journaled as a
+        contract_verdict note (contract kind under ``contract_kind``),
+        a FAIL leaves a contract_alarm note, and the run completes."""
+        from go_libp2p_pubsub_tpu.sim.supervisor import supervised_run
+        cfg, tp, state, key = small
+        health = tmp_path / "health.jsonl"
+        _out, rep = supervised_run(
+            state, cfg, tp, key, TICKS,
+            _sup(health_path=str(health),
+                 contracts=(C_FAIL, C_PASS, C_PEND)))
+        assert rep.chunks_run == TICKS // CHUNK
+        verd = _notes(health, "contract_verdict")
+        by_c = {}
+        for v in verd:
+            by_c.setdefault(v["contract"], []).append(v)
+        assert [(v["status"], v["tick"]) for v in by_c[0]] == [("fail", 0)]
+        assert by_c[0][0]["contract_kind"] == "score_response"
+        assert by_c[0][0]["id"] == "c0.s1.fail@0"
+        assert [(v["status"], v["tick"]) for v in by_c[1]] == [("pass", 0)]
+        # the too-short stream settles pending→fail at the TRUE run end
+        assert [(v["status"], v["final"]) for v in by_c[2]] \
+            == [("fail", True)]
+        alarms = _notes(health, "contract_alarm")
+        assert alarms and alarms[0]["policy"] == "journal"
+        assert {a["contract"] for a in alarms} == {0, 2}
+        assert not _notes(health, "verdict_abort")
+        # the per-event report log mirrors the journal
+        evs = [e for e in rep.events if e["event"] == "contract_verdict"]
+        assert sorted(e["id"] for e in evs) \
+            == sorted(v["id"] for v in verd)
+
+    def test_snapshot_policy_forces_offcadence_checkpoint(self, small,
+                                                          tmp_path):
+        """Policy ``snapshot``: the breach boundary checkpoints OFF the
+        9-tick cadence (tick 3), and the sidecar's monitor token carries
+        the post-breach verdict state."""
+        from go_libp2p_pubsub_tpu.sim import checkpoint
+        from go_libp2p_pubsub_tpu.sim.supervisor import supervised_run
+        cfg, tp, state, key = small
+        ck = tmp_path / "ck"
+        _out, rep = supervised_run(
+            state, cfg, tp, key, TICKS,
+            _sup(health_path=str(tmp_path / "health.jsonl"),
+                 contracts=(C_FAIL,), verdict_policy="snapshot",
+                 checkpoint_dir=str(ck), checkpoint_every_ticks=9,
+                 keep_checkpoints=8))
+        assert rep.chunks_run == TICKS // CHUNK     # run continued
+        names = sorted(os.listdir(ck))
+        assert "ckpt_t000000003" in names           # forced at breach
+        meta = checkpoint.sidecar_meta(str(ck / "ckpt_t000000003"))
+        mons = ContractMonitors.from_token(meta["monitors"], (C_FAIL,))
+        assert mons.statuses == ["fail"]
+
+    def test_snapshot_policy_without_dir_leaves_named_note(self, small,
+                                                           tmp_path):
+        from go_libp2p_pubsub_tpu.sim.supervisor import supervised_run
+        cfg, tp, state, key = small
+        health = tmp_path / "health.jsonl"
+        supervised_run(state, cfg, tp, key, TICKS,
+                       _sup(health_path=str(health), contracts=(C_FAIL,),
+                            verdict_policy="snapshot"))
+        skipped = _notes(health, "contract_snapshot_skipped")
+        assert skipped and skipped[0]["reason"] == "no checkpoint_dir"
+        assert skipped[0]["contract_kind"] == "score_response"
+
+    def test_abort_policy_named_teardown_and_restore(self, small,
+                                                     tmp_path):
+        """Policy ``abort``: the run tears down at the breach chunk
+        boundary with a named note carrying the failing contract and
+        breach tick — and the forced breach checkpoint restores to the
+        exact boundary state."""
+        import jax
+
+        from go_libp2p_pubsub_tpu.sim import checkpoint, engine
+        from go_libp2p_pubsub_tpu.sim.supervisor import (VerdictAbort,
+                                                         supervised_run)
+        cfg, tp, state, key = small
+        health = tmp_path / "health.jsonl"
+        ck = tmp_path / "ck"
+        with pytest.raises(VerdictAbort,
+                           match="verdict_policy='abort'") as ei:
+            supervised_run(
+                state, cfg, tp, key, TICKS,
+                _sup(health_path=str(health), contracts=(C_FAIL, C_PASS),
+                     verdict_policy="abort", checkpoint_dir=str(ck),
+                     keep_checkpoints=8))
+        e = ei.value.event
+        assert (e["contract"], e["kind"], e["tick"]) \
+            == (0, "score_response", 0)
+        # the teardown note drained durably before the raise
+        aborts = _notes(health, "verdict_abort")
+        assert len(aborts) == 1
+        assert aborts[0]["contract_kind"] == "score_response"
+        assert aborts[0]["tick"] == 0 and aborts[0]["detail"]
+        # the passing contract's verdict was journaled too, not eaten
+        # by the teardown
+        assert {v["status"] for v in _notes(health, "contract_verdict")} \
+            == {"pass", "fail"}
+        # the breach checkpoint restores cleanly to the boundary state
+        restored = checkpoint.restore(str(ck / "ckpt_t000000003"),
+                                      like=state, cfg=cfg)
+        ref = engine.run_keys(state, cfg, tp,
+                              jax.random.split(key, TICKS)[:CHUNK])
+        _assert_states_equal(ref, restored)
+
+    def test_bad_policy_refused_by_name(self, small, tmp_path):
+        from go_libp2p_pubsub_tpu.sim.supervisor import supervised_run
+        cfg, tp, state, key = small
+        with pytest.raises(ValueError, match="verdict_policy"):
+            supervised_run(
+                state, cfg, tp, key, TICKS,
+                _sup(health_path=str(tmp_path / "h.jsonl"),
+                     contracts=(C_PASS,), verdict_policy="panic"))
+
+    def test_contracts_without_telemetry_lane_refused(self, small):
+        from go_libp2p_pubsub_tpu.sim.supervisor import supervised_run
+        cfg, tp, state, key = small
+        with pytest.raises(ValueError, match="telemetry lane"):
+            supervised_run(state, cfg, tp, key, TICKS,
+                           _sup(contracts=(C_PASS,)))
+
+    def test_kill_resume_rederives_verdict_exactly_once(self, small,
+                                                        tmp_path):
+        """The engineered duplicate: DeliveryFloor(start=7) transitions
+        at the tick-9 confirm, AFTER the tick-6 checkpoint stamped the
+        pre-transition monitor state. A kill before the next chunk
+        leaves the note durable but not the post-transition state — the
+        resume re-derives the SAME deterministic id (raw journal holds
+        it twice), the deduped read-side stream exactly once, and both
+        stream and final state equal the uninterrupted run's."""
+        from go_libp2p_pubsub_tpu.sim.supervisor import supervised_run
+        cfg, tp, state, key = small
+        contracts = (DeliveryFloor(floor=0.0, start=7),)
+        ref_health = tmp_path / "ref.jsonl"
+        ref_out, _ = supervised_run(
+            state, cfg, tp, key, TICKS,
+            _sup(health_path=str(ref_health), contracts=contracts))
+        ref_ids = [(v["id"], v["status"])
+                   for v in _notes(ref_health, "contract_verdict")]
+        assert ref_ids == [("c0.s1.pass@7", "pass")]
+
+        health = tmp_path / "health.jsonl"
+        ck = tmp_path / "ck"
+
+        def kill(info):
+            if info["chunk_start"] >= 9:
+                raise KeyboardInterrupt("simulated preemption")
+
+        with pytest.raises(KeyboardInterrupt):
+            supervised_run(
+                state, cfg, tp, key, TICKS,
+                _sup(health_path=str(health), contracts=contracts,
+                     checkpoint_dir=str(ck), checkpoint_every_ticks=6,
+                     keep_checkpoints=8),
+                _chunk_hook=kill)
+        # the transition note IS on disk; the newest checkpoint (t6)
+        # predates it
+        assert [d["id"] for d in _raw_notes(health, "contract_verdict")] \
+            == ["c0.s1.pass@7"]
+
+        out, rep = supervised_run(
+            state, cfg, tp, key, TICKS,
+            _sup(health_path=str(health), contracts=contracts,
+                 checkpoint_dir=str(ck), checkpoint_every_ticks=6,
+                 keep_checkpoints=8))
+        assert rep.resumed_tick == 6
+        vr = [e for e in rep.events if e["event"] == "verdict_resume"]
+        assert vr and vr[0]["statuses"] == ["pending"]
+        raw = _raw_notes(health, "contract_verdict")
+        assert [d["id"] for d in raw] == ["c0.s1.pass@7"] * 2
+        deduped = [(v["id"], v["status"])
+                   for v in _notes(health, "contract_verdict")]
+        assert deduped == ref_ids
+        _assert_states_equal(ref_out, out)
+
+
+class TestVerdictChaos:
+    def test_parse_verdict_kill(self):
+        from go_libp2p_pubsub_tpu.parallel.resilience import ChaosPlan
+        assert ChaosPlan.parse("verdict_kill@8") == [
+            {"action": "verdict_kill", "rank": 0, "tick": 8,
+             "seconds": 0.0}]
+        with pytest.raises(ValueError, match="GRAFT_CHAOS"):
+            ChaosPlan.parse("verdict_kill@x")
+        with pytest.raises(ValueError, match="GRAFT_CHAOS"):
+            ChaosPlan.parse("verdict_kill@8:2")
+
+    def test_verdict_specs_pin_to_rank0(self, tmp_path):
+        from go_libp2p_pubsub_tpu.parallel.resilience import ChaosPlan
+        specs = ChaosPlan.parse("verdict_kill@8")
+        plan = ChaosPlan(specs, rank=0, run_dir=str(tmp_path))
+        assert len(plan.verdict_specs) == 1 and plan.specs == []
+        assert ChaosPlan(specs, rank=1).verdict_specs == []
+        # chunk-hook and ingest fire points must skip verdict specs
+        plan.fire({"chunk_start": 99})
+        assert not os.listdir(tmp_path)
+
+
+# ---------------------------------------------------------------------------
+# dashboard: journal-first verdict render, incremental-monitor fallback
+
+
+def _load_dashboard():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "graft_dashboard", os.path.join(REPO, "scripts", "dashboard.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_lines(path, lines):
+    with open(path, "w") as f:
+        for d in lines:
+            f.write(json.dumps(d) + "\n")
+    return str(path)
+
+
+class TestDashboardVerdicts:
+    CONTRACTS = (DeliveryFloor(floor=0.7),
+                 RecoveryCeiling(after=2, within=3, floor=0.8),
+                 ScoreResponse(by=3))
+
+    def _header(self, now):
+        return {"kind": "run", "wall": now - 30, "scenario": "eclipse",
+                "n_peers": 64, "n_topics": 1, "flags_version": 1,
+                "contracts": adversary.contracts_to_json(self.CONTRACTS),
+                "attack_windows": [{"start": 2, "end": 5,
+                                    "kind": "eclipse"}]}
+
+    @staticmethod
+    def _row(now, tick, d=0.9):
+        return {"kind": "health", "wall": now - 20 + tick, "tick": tick,
+                "member": -1, "delivery_frac_t0": d,
+                "attacker_edges": 0, "attacker_graylisted": 0,
+                "honest_graylisted": 0, "connected_edges": 100}
+
+    def test_journaled_verdicts_render_without_reevaluation(self,
+                                                            tmp_path):
+        """Journaled notes win: latest-seq status per contract, sourced
+        'journal', the breach banner up — and duplicate ids (a relaunch
+        re-derivation) render exactly once via the tailer's dedup."""
+        dash = _load_dashboard()
+        now = time.time()
+        dup = {"kind": "contract_verdict", "wall": now - 4, "contract": 0,
+               "contract_kind": "delivery_floor", "seq": 2,
+               "status": "fail", "tick": 4, "final": False,
+               "detail": "min delivery 0.5000 @ tick 4 vs floor 0.7",
+               "id": "c0.s2.fail@4"}
+        path = _write_lines(tmp_path / "h.jsonl", [
+            self._header(now), self._row(now, 0), self._row(now, 4, 0.5),
+            {"kind": "contract_verdict", "wall": now - 9, "contract": 0,
+             "contract_kind": "delivery_floor", "seq": 1,
+             "status": "pass", "tick": 0, "final": False,
+             "detail": "min delivery 0.9000 @ tick 0 vs floor 0.7",
+             "id": "c0.s1.pass@0"},
+            dup, dup,
+            {"kind": "contract_verdict", "wall": now - 3, "contract": 2,
+             "contract_kind": "score_response", "seq": 1,
+             "status": "pass", "tick": 3, "final": False,
+             "detail": "graylisted by tick 3", "id": "c2.s1.pass@3"},
+            {"kind": "contract_alarm", "wall": now - 3, "policy":
+             "journal", "contract": 0, "contract_kind": "delivery_floor",
+             "tick": 4, "id": "c0.s2.fail@4", "detail": "breach"},
+        ])
+        snap = dash.snapshot(path)
+        cs = {c["kind"]: c for c in snap["contracts"]}
+        assert cs["delivery_floor"]["status"] == "fail"     # latest seq
+        assert cs["delivery_floor"]["source"] == "journal"
+        assert cs["score_response"]["status"] == "pass"
+        assert snap.get("contract_alarm")
+        assert "verdict_abort" not in snap
+        text = dash.render(snap)
+        assert "CONTRACT BREACH" in text and "VERDICT ABORT" not in text
+        # tailer path: the duplicated id collapses to ONE verdict
+        t = dash._Tailer(path)
+        t.poll()
+        j = t.journal()
+        assert len(j["verdicts"]) == 3
+        live = dash._snapshot_of(j, path)
+        assert {c["kind"]: c["status"] for c in live["contracts"]} \
+            == {c["kind"]: c["status"] for c in snap["contracts"]}
+
+    def test_verdict_abort_banner(self, tmp_path):
+        dash = _load_dashboard()
+        now = time.time()
+        path = _write_lines(tmp_path / "h.jsonl", [
+            self._header(now), self._row(now, 0), self._row(now, 4, 0.5),
+            {"kind": "contract_verdict", "wall": now - 2, "contract": 0,
+             "contract_kind": "delivery_floor", "seq": 1,
+             "status": "fail", "tick": 4, "final": False,
+             "detail": "min delivery 0.5000 @ tick 4 vs floor 0.7",
+             "id": "c0.s1.fail@4"},
+            {"kind": "verdict_abort", "wall": now - 1, "policy": "abort",
+             "contract": 0, "contract_kind": "delivery_floor", "tick": 4,
+             "id": "c0.s1.fail@4",
+             "detail": "min delivery 0.5000 @ tick 4 vs floor 0.7"},
+        ])
+        snap = dash.snapshot(path)
+        va = snap["verdict_abort"]
+        assert va["kind"] == "delivery_floor" and va["tick"] == 4
+        text = dash.render(snap)
+        assert "VERDICT ABORT" in text
+        assert "restore from the last checkpoint" in text
+        assert "CONTRACT BREACH" not in text    # superseded by the abort
+
+    def test_tailer_incremental_monitors_match_batch(self, tmp_path):
+        """The live fallback (runs that stamp contracts but journal no
+        verdicts): the tailer's O(1)-per-row monitors agree with the
+        batch O(all rows) re-evaluation the --once path still does."""
+        dash = _load_dashboard()
+        now = time.time()
+        deliv = [0.9, 0.8, 0.6, 0.75, 0.85, 0.95]
+        gray = [0, 1, 3, 5, 7, 8]
+        rows = [{"kind": "health", "wall": now - 20 + i, "tick": i,
+                 "member": -1, "delivery_frac_t0": d,
+                 "attacker_edges": 10, "attacker_graylisted": g,
+                 "honest_graylisted": 0, "connected_edges": 100}
+                for i, (d, g) in enumerate(zip(deliv, gray))]
+        path = _write_lines(tmp_path / "h.jsonl",
+                            [self._header(now)] + rows)
+        batch = dash.snapshot(path)["contracts"]
+        assert batch and all("source" not in c for c in batch)
+        t = dash._Tailer(path)
+        t.poll()
+        live = dash._snapshot_of(t.journal(), path)["contracts"]
+        assert all(c["source"] == "monitor" for c in live)
+        assert {c["kind"]: c["status"] for c in live} \
+            == {c["kind"]: c["status"] for c in batch}
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance leg: 2-process run, composed attack stream, rank 0
+# SIGKILLed between a breach and its journaled verdict
+
+
+V_TICKS, V_CHUNK, V_SEED, V_N = 16, 2, 7, 128
+
+# the composed eclipse+censor stream (scripts/directive_producer.py
+# --scenario eclipse_censor --at 4 --region 8 --attackers 8)
+V_CONTRACTS = [
+    # transitions pending→pass at tick 6 — mid-attack, detected at the
+    # tick-8 confirm, exactly where verdict_kill@8 drops the rank
+    {"kind": "delivery_floor", "floor": 0.0, "start": 6},
+    # can never settle in 16 ticks: the pending→fail FINAL leg
+    {"kind": "recovery_ceiling", "after": 20, "within": 5,
+     "floor": 0.95},
+]
+
+
+def _mh_env(**extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)     # conftest's 8-device flag must not leak
+    env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="", **extra)
+    return env
+
+
+@pytest.fixture(scope="module")
+def verdict_reference(tmp_path_factory):
+    """The same composed stream + contracts run uninterrupted, single
+    process: the state AND deduped verdict-note stream the killed →
+    relaunched 2-process run must reproduce exactly once each."""
+    import jax
+
+    from go_libp2p_pubsub_tpu.parallel import multihost
+    from go_libp2p_pubsub_tpu.sim import scenarios
+    from go_libp2p_pubsub_tpu.sim.commands import CommandQueue
+    from go_libp2p_pubsub_tpu.sim.supervisor import (SupervisorConfig,
+                                                     supervised_run)
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        from directive_producer import scenario_directives
+    finally:
+        sys.path.pop(0)
+    from go_libp2p_pubsub_tpu.sim.commands import write_stream
+
+    d = tmp_path_factory.mktemp("vref")
+    src = d / "attack.ndjsonl"
+    write_stream(str(src), scenario_directives(
+        "eclipse_censor", at=4, region=8, attackers=8, bursts=3),
+        end=True)
+    health = d / "health.jsonl"
+    cfg, tp, topo, subscribed = scenarios.frontier_spec(V_N)
+    st = multihost.init_state_local(cfg, topo, 0, 1,
+                                    subscribed=subscribed)
+    q = CommandQueue(str(src), n_peers=cfg.n_peers, n_topics=cfg.n_topics,
+                     msg_window=cfg.msg_window, slots=16,
+                     stall_timeout_s=60.0)
+    sup = SupervisorConfig(
+        chunk_ticks=V_CHUNK, commands=q, backoff_base_s=0.0,
+        sleep=lambda s: None, health_path=str(health),
+        contracts=adversary.contracts_from_json(V_CONTRACTS))
+    try:
+        out, _ = supervised_run(st, cfg, tp, jax.random.PRNGKey(V_SEED),
+                                V_TICKS, sup)
+    finally:
+        q.close()
+    return out, str(src), str(health)
+
+
+@pytest.mark.slow
+def test_mh_verdict_kill_relaunch_journals_exactly_once(
+        tmp_path, verdict_reference):
+    """THE ISSUE 20 acceptance leg: a 2-process CPU run carrying live
+    contracts is fed the composed eclipse+censor stream; GRAFT_CHAOS
+    verdict_kill@8 SIGKILLs rank 0 between the DeliveryFloor breach
+    detection and its journaled verdict. The group supervisor relaunches
+    the run off the checkpoint sidecar's monitor token — the relaunch
+    re-derives the verdict, the deduped note stream is identical to the
+    uninterrupted run's (each verdict exactly once), and the final state
+    is bit-exact."""
+    ref_state, src, ref_health = verdict_reference
+    ref_ids = [(v["id"], v["status"], v["contract_kind"])
+               for v in _notes(ref_health, "contract_verdict")]
+    assert ("c0.s1.pass@6", "pass", "delivery_floor") in ref_ids
+    assert any(i[1] == "fail" and i[2] == "recovery_ceiling"
+               for i in ref_ids)
+
+    run_dir = tmp_path / "mh"
+    run_dir.mkdir()
+    final = tmp_path / "final.npz"
+    health = run_dir / "health.jsonl"
+    cmd = [sys.executable,
+           os.path.join(REPO, "scripts", "mh_supervisor.py"),
+           "--procs", "2,2", "--scenario", "frontier_250k",
+           "--n", str(V_N), "--ticks", str(V_TICKS),
+           "--seed", str(V_SEED), "--chunk-ticks", str(V_CHUNK),
+           "--run-dir", str(run_dir), "--max-relaunches", "2",
+           "--backoff-base-s", "0.05", "--dump-state", str(final),
+           "--health", str(health), "--source", src,
+           "--directive-slots", "16", "--ingest-stall-timeout", "30",
+           "--contracts", json.dumps(V_CONTRACTS),
+           "--verdict-policy", "journal"]
+    proc = subprocess.run(
+        cmd,
+        env=_mh_env(GRAFT_CHAOS="verdict_kill@8",
+                    GRAFT_MH_PEER_TIMEOUT_S="6",
+                    GRAFT_MH_ABORT_GRACE_S="3",
+                    GRAFT_MH_BEAT_INTERVAL_S="0.5"),
+        cwd=REPO, capture_output=True, text=True, timeout=560)
+    journal = [json.loads(ln)
+               for ln in (run_dir / "mh_journal.jsonl").read_text()
+               .splitlines()]
+    assert proc.returncode == 0, (proc.stdout, proc.stderr, journal)
+    # the kill really fired (durable once-per-run-dir marker) and the
+    # group really relaunched
+    assert "chaos_verdict_kill_r0_t8.fired" in os.listdir(run_dir)
+    assert any(r["kind"] == "mh_failure" for r in journal)
+    assert len([r for r in journal if r["kind"] == "mh_attempt"]) >= 2
+    # sidecars carry the verdict-monitor token next to stream_offset
+    from go_libp2p_pubsub_tpu.sim import checkpoint
+    ck = run_dir / "ckpt"
+    metas = [checkpoint.sidecar_meta(
+                str(ck / p)[:-len(".npz")] if p.endswith(".npz")
+                else str(ck / p))
+             for p in os.listdir(ck) if not p.endswith(".fingerprint")]
+    # a SIGKILL can leave one payload without its sidecar (payload lands
+    # first; restore skips it) — every SIDECAR-COMPLETE checkpoint must
+    # carry the verdict-monitor token next to the ingestion cursor
+    stamped = [m for m in metas if m]
+    assert stamped and all(m.get("monitors") and
+                           m.get("stream_offset") is not None
+                           for m in stamped)
+    # exactly-once: the deduped verdict stream equals the uninterrupted
+    # run's, and no id appears twice after read-side dedup
+    got = [(v["id"], v["status"], v["contract_kind"])
+           for v in _notes(health, "contract_verdict")]
+    assert sorted(got) == sorted(ref_ids)
+    assert len({g[0] for g in got}) == len(got)
+    # the composed attack really landed: both fault bits lit
+    from go_libp2p_pubsub_tpu.sim.invariants import (FAULT_CENSOR,
+                                                     FAULT_ECLIPSE)
+    from go_libp2p_pubsub_tpu.sim.telemetry import read_journal
+    flags = 0
+    for r in read_journal(str(health))["rows"]:
+        flags |= int(r.get("fault_flags", 0))
+    assert flags & FAULT_ECLIPSE and flags & FAULT_CENSOR
+    # bit-exact final state vs the uninterrupted reference
+    got_state = np.load(final)
+    for f in ref_state._fields:
+        assert np.array_equal(np.asarray(getattr(ref_state, f)),
+                              got_state[f]), f
